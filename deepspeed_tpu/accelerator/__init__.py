@@ -1,0 +1,12 @@
+from .abstract_accelerator import DeepSpeedAccelerator
+from .real_accelerator import get_accelerator, set_accelerator, is_current_accelerator_supported
+from .tpu_accelerator import CPU_Accelerator, TPU_Accelerator
+
+__all__ = [
+    "DeepSpeedAccelerator",
+    "get_accelerator",
+    "set_accelerator",
+    "is_current_accelerator_supported",
+    "TPU_Accelerator",
+    "CPU_Accelerator",
+]

@@ -1,0 +1,19 @@
+"""Comms-logger config — analog of reference ``deepspeed/comm/config.py``."""
+
+from __future__ import annotations
+
+from typing import List
+
+from deepspeed_tpu.runtime.config_utils import DeepSpeedConfigModel
+
+
+class CommsLoggerConfig(DeepSpeedConfigModel):
+    enabled: bool = False
+    verbose: bool = False
+    prof_all: bool = True
+    prof_ops: List[str] = []
+    debug: bool = False
+
+
+class CommsConfig(DeepSpeedConfigModel):
+    comms_logger: CommsLoggerConfig = CommsLoggerConfig()

@@ -1,0 +1,89 @@
+"""Model protocol + shared layers.
+
+The engine (like the reference ``DeepSpeedEngine`` wrapping any nn.Module,
+engine.py:181) accepts anything satisfying :class:`ModelSpec`:
+
+    params        = model.init(rng)
+    loss, metrics = model.apply(params, batch, rngs=..., train=True)
+    axes          = model.logical_axes()   # pytree matching params, or None
+
+``logical_axes`` names each parameter dimension ('hidden', 'mlp', 'heads',
+'vocab', 'expert', 'layer', ...) — the PartitionPlan maps names to mesh axes
+for TP/EP while ZeRO picks up the rest. Flax linen modules are adapted via
+:class:`FlaxModelAdapter`.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Optional, Protocol, Tuple, runtime_checkable
+
+import jax
+import jax.numpy as jnp
+
+
+@runtime_checkable
+class ModelSpec(Protocol):
+    def init(self, rng) -> Any: ...
+
+    def apply(self, params, batch, *, rngs=None, train: bool = False): ...
+
+    def logical_axes(self) -> Optional[Any]: ...
+
+
+# ------------------------------------------------------------- shared layers
+def layer_norm(x, scale, bias, eps: float = 1e-5):
+    x32 = x.astype(jnp.float32)
+    mean = jnp.mean(x32, axis=-1, keepdims=True)
+    var = jnp.mean(jnp.square(x32 - mean), axis=-1, keepdims=True)
+    y = (x32 - mean) * jax.lax.rsqrt(var + eps)
+    return (y * scale.astype(jnp.float32) + bias.astype(jnp.float32)).astype(x.dtype)
+
+
+def rms_norm(x, scale, eps: float = 1e-6):
+    x32 = x.astype(jnp.float32)
+    var = jnp.mean(jnp.square(x32), axis=-1, keepdims=True)
+    return (x32 * jax.lax.rsqrt(var + eps) * scale.astype(jnp.float32)).astype(x.dtype)
+
+
+def gelu(x):
+    return jax.nn.gelu(x, approximate=True)
+
+
+def cross_entropy_loss(logits, labels, ignore_index: int = -100):
+    """Token-level CE in fp32 with masking; returns (mean_loss, n_valid)."""
+    logits = logits.astype(jnp.float32)
+    valid = labels != ignore_index
+    safe_labels = jnp.where(valid, labels, 0)
+    logz = jax.nn.logsumexp(logits, axis=-1)
+    ll = jnp.take_along_axis(logits, safe_labels[..., None], axis=-1)[..., 0]
+    nll = (logz - ll) * valid.astype(jnp.float32)
+    n = jnp.maximum(valid.sum(), 1)
+    return nll.sum() / n, n
+
+
+def make_causal_lm_batch(input_ids):
+    """inputs/labels from one token stream: predict token t+1 from <=t."""
+    return {"input_ids": input_ids[:, :-1], "labels": input_ids[:, 1:]}
+
+
+# ---------------------------------------------------------------- flax bridge
+class FlaxModelAdapter:
+    """Wraps a flax.linen module + loss_fn into the ModelSpec protocol."""
+
+    def __init__(self, module, sample_batch, loss_fn: Callable, train_kwarg: str = "train"):
+        self.module = module
+        self.sample_batch = sample_batch
+        self.loss_fn = loss_fn
+        self.train_kwarg = train_kwarg
+
+    def init(self, rng):
+        variables = self.module.init(rng, self.sample_batch)
+        return variables["params"]
+
+    def apply(self, params, batch, *, rngs=None, train: bool = False):
+        outputs = self.module.apply({"params": params}, batch,
+                                    rngs=rngs if train else None)
+        return self.loss_fn(outputs, batch)
+
+    def logical_axes(self):
+        return None

@@ -1,0 +1,200 @@
+"""GPT-2 model family (125M default), TPU-first.
+
+Design notes (vs. the reference's per-module torch GPT-2 used in its tests
+and the fused ``csrc/transformer`` training kernel, SURVEY §2.4):
+  * all transformer blocks are *stacked* on a leading 'layer' dimension and
+    executed with ``lax.scan`` — one compiled block, L iterations; this is
+    the XLA-idiomatic form that keeps compile time flat in depth and lets
+    ZeRO-3 shard the layer dimension.
+  * activations/matmuls run in the engine's compute dtype (bf16); softmax,
+    layernorm statistics and the CE loss run in fp32.
+  * logical axis names per param dim feed the PartitionPlan (TP over 'heads'/
+    'mlp'/'vocab', ZeRO over 'layer' or the largest free dim).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.models.base import cross_entropy_loss, gelu, layer_norm
+from deepspeed_tpu.ops.attention import multihead_attention
+
+
+@dataclasses.dataclass
+class GPT2Config:
+    vocab_size: int = 50257
+    max_seq_len: int = 1024
+    num_layers: int = 12
+    hidden_size: int = 768
+    num_heads: int = 12
+    mlp_ratio: int = 4
+    dropout: float = 0.0
+    tie_embeddings: bool = True
+    eps: float = 1e-5
+
+    @property
+    def head_dim(self) -> int:
+        return self.hidden_size // self.num_heads
+
+    @property
+    def mlp_dim(self) -> int:
+        return self.hidden_size * self.mlp_ratio
+
+    @classmethod
+    def gpt2_125m(cls, **kw):
+        return cls(num_layers=12, hidden_size=768, num_heads=12, **kw)
+
+    @classmethod
+    def gpt2_350m(cls, **kw):
+        return cls(num_layers=24, hidden_size=1024, num_heads=16, **kw)
+
+    @classmethod
+    def gpt2_1b3(cls, **kw):
+        return cls(num_layers=24, hidden_size=2048, num_heads=16, **kw)
+
+    @classmethod
+    def tiny(cls, **kw):
+        kw.setdefault("vocab_size", 512)
+        kw.setdefault("max_seq_len", 128)
+        return cls(num_layers=2, hidden_size=64, num_heads=4, **kw)
+
+
+class GPT2Model:
+    """Causal-LM ModelSpec. batch = {"input_ids": [B,T] int32, "labels": [B,T]}."""
+
+    def __init__(self, config: GPT2Config, compute_dtype=jnp.bfloat16,
+                 remat: bool = False, remat_policy: Optional[str] = None):
+        self.config = config
+        self.compute_dtype = compute_dtype
+        self.remat = remat
+        self.remat_policy = remat_policy
+
+    # ------------------------------------------------------------------- init
+    def init(self, rng):
+        c = self.config
+        k = jax.random.split(rng, 8)
+        d, l, m, v = c.hidden_size, c.num_layers, c.mlp_dim, c.vocab_size
+        std = 0.02
+        init = jax.nn.initializers.normal(std)
+        params = {
+            "wte": init(k[0], (v, d), jnp.float32),
+            "wpe": init(k[1], (c.max_seq_len, d), jnp.float32),
+            "blocks": {
+                "ln1_scale": jnp.ones((l, d)), "ln1_bias": jnp.zeros((l, d)),
+                "qkv_w": init(k[2], (l, d, 3 * d), jnp.float32),
+                "qkv_b": jnp.zeros((l, 3 * d)),
+                "attn_out_w": init(k[3], (l, d, d), jnp.float32) / (2 * l) ** 0.5,
+                "attn_out_b": jnp.zeros((l, d)),
+                "ln2_scale": jnp.ones((l, d)), "ln2_bias": jnp.zeros((l, d)),
+                "mlp_fc_w": init(k[4], (l, d, m), jnp.float32),
+                "mlp_fc_b": jnp.zeros((l, m)),
+                "mlp_out_w": init(k[5], (l, m, d), jnp.float32) / (2 * l) ** 0.5,
+                "mlp_out_b": jnp.zeros((l, d)),
+            },
+            "ln_f_scale": jnp.ones((d,)), "ln_f_bias": jnp.zeros((d,)),
+        }
+        if not c.tie_embeddings:
+            params["lm_head"] = init(k[6], (d, v), jnp.float32)
+        return params
+
+    def logical_axes(self):
+        c = self.config
+        axes = {
+            "wte": ("vocab_in", "hidden"),
+            "wpe": ("seq", "hidden"),
+            "blocks": {
+                "ln1_scale": ("layer", "hidden"), "ln1_bias": ("layer", "hidden"),
+                "qkv_w": ("layer", "hidden", "heads"),
+                "qkv_b": ("layer", "heads"),
+                "attn_out_w": ("layer", "heads", "hidden"),
+                "attn_out_b": ("layer", "hidden"),
+                "ln2_scale": ("layer", "hidden"), "ln2_bias": ("layer", "hidden"),
+                "mlp_fc_w": ("layer", "hidden", "mlp"),
+                "mlp_fc_b": ("layer", "mlp"),
+                "mlp_out_w": ("layer", "mlp", "hidden"),
+                "mlp_out_b": ("layer", "hidden"),
+            },
+            "ln_f_scale": ("hidden",), "ln_f_bias": ("hidden",),
+        }
+        if not c.tie_embeddings:
+            axes["lm_head"] = ("hidden", "vocab")
+        return axes
+
+    # ------------------------------------------------------------------ layers
+    def _block(self, x, blk, rng, train: bool):
+        c = self.config
+        b, t, d = x.shape
+        h, dh = c.num_heads, c.head_dim
+        y = layer_norm(x, blk["ln1_scale"], blk["ln1_bias"], c.eps)
+        qkv = jnp.einsum("btd,de->bte", y, blk["qkv_w"].astype(y.dtype)) + \
+            blk["qkv_b"].astype(y.dtype)
+        q, k_, v_ = jnp.split(qkv, 3, axis=-1)
+        q = q.reshape(b, t, h, dh)
+        k_ = k_.reshape(b, t, h, dh)
+        v_ = v_.reshape(b, t, h, dh)
+        drop_rng = None
+        if train and c.dropout > 0.0 and rng is not None:
+            rng, drop_rng = jax.random.split(rng)
+        attn = multihead_attention(q, k_, v_, causal=True,
+                                   dropout_rate=c.dropout if train else 0.0,
+                                   dropout_rng=drop_rng)
+        attn = attn.reshape(b, t, d)
+        x = x + jnp.einsum("btd,de->bte", attn, blk["attn_out_w"].astype(x.dtype)) + \
+            blk["attn_out_b"].astype(x.dtype)
+        y = layer_norm(x, blk["ln2_scale"], blk["ln2_bias"], c.eps)
+        hmid = gelu(jnp.einsum("btd,dm->btm", y, blk["mlp_fc_w"].astype(y.dtype)) +
+                    blk["mlp_fc_b"].astype(y.dtype))
+        x = x + jnp.einsum("btm,md->btd", hmid, blk["mlp_out_w"].astype(x.dtype)) + \
+            blk["mlp_out_b"].astype(x.dtype)
+        return x
+
+    def forward_hidden(self, params, input_ids, *, rngs=None, train: bool = False):
+        c = self.config
+        b, t = input_ids.shape
+        x = params["wte"].astype(self.compute_dtype)[input_ids]
+        x = x + params["wpe"].astype(self.compute_dtype)[:t][None]
+
+        block_fn = self._block
+        if self.remat:
+            from deepspeed_tpu.runtime.activation_checkpointing import checkpoint_policy
+
+            block_fn = jax.checkpoint(block_fn, policy=checkpoint_policy(self.remat_policy),
+                                      static_argnums=(3,))
+
+        def scan_body(carry, layer_params):
+            x, rng = carry
+            if rng is not None:
+                rng, sub = jax.random.split(rng)
+            else:
+                sub = None
+            x = block_fn(x, layer_params, sub, train)
+            return (x, rng), None
+
+        rng = rngs.get("dropout") if isinstance(rngs, dict) else rngs
+        (x, _), _ = jax.lax.scan(scan_body, (x, rng), params["blocks"])
+        return layer_norm(x, params["ln_f_scale"], params["ln_f_bias"], c.eps)
+
+    def logits(self, params, hidden):
+        if self.config.tie_embeddings:
+            w = params["wte"].astype(hidden.dtype)
+            return jnp.einsum("btd,vd->btv", hidden, w)
+        return jnp.einsum("btd,dv->btv", hidden, params["lm_head"].astype(hidden.dtype))
+
+    def apply(self, params, batch, *, rngs=None, train: bool = False):
+        hidden = self.forward_hidden(params, batch["input_ids"], rngs=rngs, train=train)
+        logits = self.logits(params, hidden)
+        loss, n = cross_entropy_loss(logits, batch["labels"])
+        return loss, {"loss": loss, "ntokens": n}
+
+    # ------------------------------------------------------------------- cost
+    def flops_per_token(self) -> float:
+        """6*N approximation + attention quadratic term (training fwd+bwd)."""
+        c = self.config
+        n_params = (c.vocab_size * c.hidden_size + c.max_seq_len * c.hidden_size +
+                    c.num_layers * (4 * c.hidden_size ** 2 + 2 * c.hidden_size * c.mlp_dim))
+        attn = 12 * c.num_layers * c.hidden_size * c.max_seq_len
+        return 6.0 * n_params + attn

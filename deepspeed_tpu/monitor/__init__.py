@@ -1,0 +1,2 @@
+from .config import DeepSpeedMonitorConfig, get_monitor_config
+from .monitor import MonitorMaster

@@ -1,0 +1,42 @@
+"""Monitor config — analog of reference ``deepspeed/monitor/config.py``."""
+
+from __future__ import annotations
+
+from deepspeed_tpu.runtime.config_utils import DeepSpeedConfigModel
+
+
+class TensorBoardConfig(DeepSpeedConfigModel):
+    enabled: bool = False
+    output_path: str = ""
+    job_name: str = "DeepSpeedJobName"
+
+
+class WandbConfig(DeepSpeedConfigModel):
+    enabled: bool = False
+    group: str = ""
+    team: str = ""
+    project: str = "deepspeed_tpu"
+
+
+class CSVConfig(DeepSpeedConfigModel):
+    enabled: bool = False
+    output_path: str = ""
+    job_name: str = "DeepSpeedJobName"
+
+
+class DeepSpeedMonitorConfig(DeepSpeedConfigModel):
+    tensorboard: TensorBoardConfig = TensorBoardConfig()
+    wandb: WandbConfig = WandbConfig()
+    csv_monitor: CSVConfig = CSVConfig()
+
+    @property
+    def enabled(self) -> bool:
+        return self.tensorboard.enabled or self.wandb.enabled or self.csv_monitor.enabled
+
+
+def get_monitor_config(param_dict: dict) -> DeepSpeedMonitorConfig:
+    monitor_dict = {
+        k: v for k, v in param_dict.items()
+        if k in ("tensorboard", "wandb", "csv_monitor")
+    }
+    return DeepSpeedMonitorConfig(**monitor_dict)

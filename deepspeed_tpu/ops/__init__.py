@@ -1,0 +1,56 @@
+from . import registry as _registry_mod
+from .registry import OpBuilder, all_ops, get_op_builder, register_op_builder
+
+
+class _register_all:
+    """Importing this module registers the built-in op builders."""
+
+
+@register_op_builder
+class FusedAdamBuilder(_registry_mod.OpBuilder):
+    NAME = "fused_adam"
+
+    def load(self):
+        from deepspeed_tpu.ops.adam import FusedAdam
+
+        return FusedAdam
+
+
+@register_op_builder
+class CPUAdamBuilder(_registry_mod.OpBuilder):
+    NAME = "cpu_adam"
+
+    def load(self):
+        from deepspeed_tpu.ops.adam import DeepSpeedCPUAdam
+
+        return DeepSpeedCPUAdam
+
+
+@register_op_builder
+class FusedLambBuilder(_registry_mod.OpBuilder):
+    NAME = "fused_lamb"
+
+    def load(self):
+        from deepspeed_tpu.ops.adam import FusedLamb
+
+        return FusedLamb
+
+
+@register_op_builder
+class CPUAdagradBuilder(_registry_mod.OpBuilder):
+    NAME = "cpu_adagrad"
+
+    def load(self):
+        from deepspeed_tpu.ops.adam import DeepSpeedCPUAdagrad
+
+        return DeepSpeedCPUAdagrad
+
+
+@register_op_builder
+class AttentionBuilder(_registry_mod.PallasOpBuilder):
+    NAME = "attention"
+
+    def load(self):
+        from deepspeed_tpu.ops import attention
+
+        return attention

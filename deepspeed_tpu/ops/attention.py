@@ -1,0 +1,85 @@
+"""Attention ops.
+
+Reference counterpart: the fused attention kernels in
+``csrc/transformer/softmax_kernels.cu`` / ``csrc/transformer/inference/csrc/softmax.cu``
+(training + inference softmax with causal/alibi masking). Here the canonical
+implementation is jnp (XLA fuses QK^T→mask→softmax→PV well on the MXU);
+a Pallas flash-attention fast path (``flash_attention.py``) overrides it via
+the op registry on real TPU backends for long sequences.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+
+def multihead_attention(
+    q: jax.Array,  # [B, T, H, Dh]
+    k: jax.Array,  # [B, S, H, Dh]
+    v: jax.Array,  # [B, S, H, Dh]
+    *,
+    causal: bool = True,
+    mask: Optional[jax.Array] = None,  # [B, 1, T, S] additive or bool
+    bias: Optional[jax.Array] = None,  # e.g. alibi [H, T, S]
+    scale: Optional[float] = None,
+    dropout_rate: float = 0.0,
+    dropout_rng: Optional[jax.Array] = None,
+) -> jax.Array:
+    """Reference (jnp) attention; softmax in fp32 regardless of input dtype."""
+    *_, t, h, dh = q.shape
+    s = k.shape[1]
+    scale = scale if scale is not None else dh ** -0.5
+    logits = jnp.einsum("bthd,bshd->bhts", q, k, precision=None).astype(jnp.float32) * scale
+    if bias is not None:
+        logits = logits + bias.astype(jnp.float32)
+    if causal:
+        causal_mask = jnp.tril(jnp.ones((t, s), dtype=bool), k=s - t)
+        logits = jnp.where(causal_mask[None, None], logits, jnp.finfo(jnp.float32).min)
+    if mask is not None:
+        if mask.dtype == bool:
+            logits = jnp.where(mask, logits, jnp.finfo(jnp.float32).min)
+        else:
+            logits = logits + mask.astype(jnp.float32)
+    probs = jax.nn.softmax(logits, axis=-1)
+    if dropout_rate > 0.0 and dropout_rng is not None:
+        keep = jax.random.bernoulli(dropout_rng, 1.0 - dropout_rate, probs.shape)
+        probs = jnp.where(keep, probs / (1.0 - dropout_rate), 0.0)
+    probs = probs.astype(v.dtype)
+    return jnp.einsum("bhts,bshd->bthd", probs, v)
+
+
+def attention_with_kv_cache(
+    q: jax.Array,        # [B, 1, H, Dh] decode query (or [B, T, H, Dh] prefill)
+    k_new: jax.Array,    # same T as q
+    v_new: jax.Array,
+    k_cache: jax.Array,  # [B, S_max, H, Dh]
+    v_cache: jax.Array,
+    cache_index: jax.Array,  # scalar int — tokens already in cache
+    *,
+    scale: Optional[float] = None,
+):
+    """Decode-time attention against a static-shape KV cache.
+
+    Reference counterpart: ``softmax_context`` (csrc/transformer/inference
+    pt_binding.cpp) + the inference_context.h KV workspace. Static shapes keep
+    the decode loop compiled once (the CUDA-graph analog — SURVEY §7.12).
+    Returns (out, k_cache, v_cache) with the new tokens written at
+    ``cache_index``.
+    """
+    b, t, h, dh = q.shape
+    s_max = k_cache.shape[1]
+    k_cache = jax.lax.dynamic_update_slice(k_cache, k_new.astype(k_cache.dtype), (0, cache_index, 0, 0))
+    v_cache = jax.lax.dynamic_update_slice(v_cache, v_new.astype(v_cache.dtype), (0, cache_index, 0, 0))
+    scale = scale if scale is not None else dh ** -0.5
+    logits = jnp.einsum("bthd,bshd->bhts", q, k_cache).astype(jnp.float32) * scale
+    # positions <= cache_index + offset are valid (causal within the new block)
+    pos = jnp.arange(s_max)[None, :]  # [1, S]
+    q_pos = cache_index + jnp.arange(t)[:, None]  # [T, 1]
+    valid = pos <= q_pos  # [T, S]
+    logits = jnp.where(valid[None, None], logits, jnp.finfo(jnp.float32).min)
+    probs = jax.nn.softmax(logits, axis=-1).astype(v_cache.dtype)
+    out = jnp.einsum("bhts,bshd->bthd", probs, v_cache)
+    return out, k_cache, v_cache

@@ -1,0 +1,70 @@
+"""Op registry — TPU-native analog of the reference's ``op_builder`` system
+(op_builder/builder.py: OpBuilder.load / is_compatible, op_builder/all_ops.py
+ALL_OPS registry).
+
+The reference JIT-compiles CUDA extensions; here an "op" is a JAX callable
+with (possibly) a Pallas fast path and a pure-jnp reference fallback. The
+builder seam is kept: name → builder → ``is_compatible()`` → ``load()``,
+so callers (and ``ds_report``) can interrogate availability exactly like the
+reference, and future Mosaic/C++ host ops slot in behind the same interface.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, Optional, Type
+
+
+class OpBuilder:
+    NAME: str = "abstract"
+
+    def __init__(self, accelerator=None):
+        from deepspeed_tpu.accelerator import get_accelerator
+
+        self.accelerator = accelerator or get_accelerator()
+
+    def is_compatible(self, verbose: bool = False) -> bool:
+        return True
+
+    def compatibility_reason(self) -> str:
+        return "compatible"
+
+    def load(self):
+        """Return the op implementation (module or callable)."""
+        raise NotImplementedError
+
+    @property
+    def name(self) -> str:
+        return self.NAME
+
+
+class PallasOpBuilder(OpBuilder):
+    """Ops whose fast path is a Pallas TPU kernel; falls back to jnp on
+    non-TPU backends (interpret mode is used only in tests)."""
+
+    def is_compatible(self, verbose: bool = False) -> bool:
+        return True  # jnp fallback always exists
+
+    def has_fast_path(self) -> bool:
+        return self.accelerator.name() == "tpu"
+
+
+_OP_BUILDERS: Dict[str, Type[OpBuilder]] = {}
+
+
+def register_op_builder(cls: Type[OpBuilder]) -> Type[OpBuilder]:
+    _OP_BUILDERS[cls.NAME] = cls
+    return cls
+
+
+def get_op_builder(name: str) -> Type[OpBuilder]:
+    from . import _register_all  # noqa: F401  (populate registry lazily)
+
+    if name not in _OP_BUILDERS:
+        raise KeyError(f"unknown op builder '{name}'. known: {sorted(_OP_BUILDERS)}")
+    return _OP_BUILDERS[name]
+
+
+def all_ops() -> Dict[str, Type[OpBuilder]]:
+    from . import _register_all  # noqa: F401
+
+    return dict(_OP_BUILDERS)

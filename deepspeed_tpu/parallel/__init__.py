@@ -1,0 +1,25 @@
+from .topology import (
+    BATCH_AXES,
+    DATA_AXIS,
+    EXPERT_AXIS,
+    MESH_AXES,
+    MODEL_AXIS,
+    PIPE_AXIS,
+    SEQ_AXIS,
+    MeshTopology,
+    ParallelDims,
+    build_topology,
+)
+
+__all__ = [
+    "MeshTopology",
+    "ParallelDims",
+    "build_topology",
+    "MESH_AXES",
+    "BATCH_AXES",
+    "DATA_AXIS",
+    "MODEL_AXIS",
+    "PIPE_AXIS",
+    "EXPERT_AXIS",
+    "SEQ_AXIS",
+]

@@ -1,0 +1,183 @@
+"""Device-mesh topology (L3).
+
+TPU-native replacement for the reference's process-group topology machinery
+(``deepspeed/utils/groups.py`` and ``deepspeed/runtime/pipe/topology.py``:
+ProcessTopology / PipeModelDataParallelTopology / PipelineParallelGrid).
+
+Where the reference builds Cartesian rank→coordinate maps and one
+``torch.distributed`` ProcessGroup per axis slice, on TPU all of that collapses
+into a single ``jax.sharding.Mesh`` whose named axes ARE the parallel groups:
+
+    axes (outer→inner): ('pipe', 'data', 'expert', 'seq', 'model')
+
+  * 'data'   — ZeRO/data parallelism (reduce-scatter/allgather ride this axis)
+  * 'expert' — expert parallelism carved out of the data-parallel world,
+               exactly like ``_create_expert_and_data_parallel``
+               (reference deepspeed/utils/groups.py:108): dense layers treat
+               ('data','expert') jointly as the batch axis, expert weights are
+               sharded over 'expert' and dispatched with all_to_all.
+  * 'seq'    — sequence/context parallelism (ring attention / Ulysses); absent
+               from the reference snapshot (SURVEY §5.7) but first-class here.
+  * 'model'  — tensor parallelism; innermost so TP collectives get the
+               best ICI locality.
+  * 'pipe'   — pipeline stages; outermost so stage boundaries can cross the
+               slower links (DCN between slices), matching how the reference
+               orders axes (pipe, data, model) in PipeModelDataParallelTopology
+               (runtime/pipe/topology.py:244).
+
+Axes of size 1 are always present, so sharding rules never need to special-case
+"parallelism disabled".
+"""
+
+from __future__ import annotations
+
+import collections
+import dataclasses
+import itertools
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+PIPE_AXIS = "pipe"
+DATA_AXIS = "data"
+EXPERT_AXIS = "expert"
+SEQ_AXIS = "seq"
+MODEL_AXIS = "model"
+
+MESH_AXES = (PIPE_AXIS, DATA_AXIS, EXPERT_AXIS, SEQ_AXIS, MODEL_AXIS)
+
+# Axes over which the *batch* dimension is sharded for dense computation.
+BATCH_AXES = (DATA_AXIS, EXPERT_AXIS)
+
+
+@dataclasses.dataclass(frozen=True)
+class ParallelDims:
+    """Requested parallel degrees. dp = world // (pp*ep*sp*tp) when dp==-1."""
+
+    dp: int = -1
+    tp: int = 1
+    pp: int = 1
+    ep: int = 1
+    sp: int = 1
+
+    def resolve(self, world_size: int) -> "ParallelDims":
+        fixed = self.tp * self.pp * self.ep * self.sp
+        dp = self.dp
+        if dp in (-1, 0, None):
+            assert world_size % fixed == 0, (
+                f"world size {world_size} not divisible by tp*pp*ep*sp={fixed}")
+            dp = world_size // fixed
+        total = dp * fixed
+        assert total == world_size, (
+            f"dp({dp})*tp({self.tp})*pp({self.pp})*ep({self.ep})*sp({self.sp})"
+            f"={total} != world size {world_size}")
+        return ParallelDims(dp=dp, tp=self.tp, pp=self.pp, ep=self.ep, sp=self.sp)
+
+
+class MeshTopology:
+    """A named device mesh plus the rank-mapping helpers the reference exposes
+    via ProcessTopology (get_coord / get_axis_comm_lists / filter_match)."""
+
+    def __init__(self, dims: ParallelDims, devices: Optional[Sequence] = None):
+        import jax
+        from jax.sharding import Mesh
+
+        if devices is None:
+            devices = jax.devices()
+        self.dims = dims.resolve(len(devices))
+        shape = self.mesh_shape
+        try:
+            from jax.experimental import mesh_utils
+
+            dev_array = mesh_utils.create_device_mesh(shape, devices=list(devices))
+        except Exception:
+            dev_array = np.asarray(list(devices)).reshape(shape)
+        self.mesh = Mesh(dev_array, MESH_AXES)
+
+    @property
+    def mesh_shape(self) -> Tuple[int, ...]:
+        d = self.dims
+        return (d.pp, d.dp, d.ep, d.sp, d.tp)
+
+    @property
+    def world_size(self) -> int:
+        return int(np.prod(self.mesh_shape))
+
+    # -- ProcessTopology-compatible helpers (reference runtime/pipe/topology.py:12)
+    def get_axis_names(self) -> Tuple[str, ...]:
+        return MESH_AXES
+
+    def get_dim(self, axis: str) -> int:
+        return dict(zip(MESH_AXES, self.mesh_shape))[axis]
+
+    def get_coord(self, rank: int):
+        """rank -> namedtuple of coordinates along each axis."""
+        coords = np.unravel_index(rank, self.mesh_shape)
+        Coord = collections.namedtuple("Coord", MESH_AXES)
+        return Coord(*[int(c) for c in coords])
+
+    def get_rank(self, **coords) -> int:
+        full = [coords[a] for a in MESH_AXES]
+        return int(np.ravel_multi_index(full, self.mesh_shape))
+
+    def get_rank_repr(self, rank: int, omit_axes=(DATA_AXIS,), inner_sep="_", outer_sep="-") -> str:
+        coord = self.get_coord(rank)
+        parts = [f"{a}{inner_sep}{getattr(coord, a):02d}"
+                 for a in MESH_AXES if a not in omit_axes and self.get_dim(a) > 1]
+        return outer_sep.join(parts)
+
+    def get_axis_comm_lists(self, axis: str) -> List[List[int]]:
+        """Groups of ranks that communicate along ``axis`` (all other coords equal)."""
+        lists = []
+        other_axes = [a for a in MESH_AXES if a != axis]
+        ranges = [range(self.get_dim(a)) for a in other_axes]
+        for combo in itertools.product(*ranges):
+            fixed = dict(zip(other_axes, combo))
+            group = [self.get_rank(**{axis: i, **fixed}) for i in range(self.get_dim(axis))]
+            if len(group) > 1:
+                lists.append(group)
+        return lists
+
+    def filter_match(self, **filter_kwargs) -> List[int]:
+        out = []
+        for rank in range(self.world_size):
+            coord = self.get_coord(rank)
+            if all(getattr(coord, k) == v for k, v in filter_kwargs.items()):
+                out.append(rank)
+        return out
+
+    # ----------------------------------------------------------- degree helpers
+    @property
+    def data_parallel_size(self) -> int:
+        return self.dims.dp * self.dims.ep  # dense batch axis spans both
+
+    @property
+    def model_parallel_size(self) -> int:
+        return self.dims.tp
+
+    @property
+    def pipe_parallel_size(self) -> int:
+        return self.dims.pp
+
+    @property
+    def expert_parallel_size(self) -> int:
+        return self.dims.ep
+
+    @property
+    def sequence_parallel_size(self) -> int:
+        return self.dims.sp
+
+    def __repr__(self):
+        return f"MeshTopology(shape={dict(zip(MESH_AXES, self.mesh_shape))})"
+
+
+def build_topology(world_size: Optional[int] = None, *, dp: int = -1, tp: int = 1,
+                   pp: int = 1, ep: int = 1, sp: int = 1,
+                   devices: Optional[Sequence] = None) -> MeshTopology:
+    import jax
+
+    if devices is None:
+        devices = jax.devices()
+    if world_size is not None:
+        devices = devices[:world_size]
+    return MeshTopology(ParallelDims(dp=dp, tp=tp, pp=pp, ep=ep, sp=sp), devices)

@@ -1,0 +1,102 @@
+"""Activation checkpointing.
+
+TPU-native replacement for the reference's Megatron-style module
+(``runtime/activation_checkpointing/checkpointing.py``: CheckpointFunction:474,
+partition_activations:366, CPU checkpointing, RNG-state tracker:121, 881 LoC).
+
+On TPU all of that collapses into ``jax.checkpoint`` (remat) policies:
+  * ``partition_activations``  → don't save residuals; recompute from layer
+    inputs (policy "nothing") — the sharded-save variant is what GSPMD does
+    anyway when activations carry sharding constraints.
+  * ``cpu_checkpointing``      → ``save_and_offload_only_these_names`` /
+    offload policies (host-offloaded residuals).
+  * RNG tracking               → free: jax threads PRNG keys functionally, so
+    recomputed dropout sees identical randomness by construction (the whole
+    CudaRNGStatesTracker has no analog to port).
+
+``configure()``/``is_configured()`` mirror the reference's module-level API
+(checkpointing.py:789) for drop-in familiarity; models consult the config via
+``checkpoint_policy``.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+import jax
+
+_config = None
+
+
+def configure(mpu_=None, deepspeed_config=None, partition_activations=None,
+              contiguous_checkpointing=None, num_checkpoints=None,
+              checkpoint_in_cpu=None, synchronize=None, profile=None):
+    global _config
+    if deepspeed_config is not None:
+        _config = deepspeed_config.activation_checkpointing_config
+    else:
+        from deepspeed_tpu.runtime.config import ActivationCheckpointingConfig
+
+        _config = ActivationCheckpointingConfig(
+            partition_activations=bool(partition_activations),
+            cpu_checkpointing=bool(checkpoint_in_cpu),
+            contiguous_memory_optimization=bool(contiguous_checkpointing),
+            number_checkpoints=num_checkpoints,
+            synchronize_checkpoint_boundary=bool(synchronize),
+            profile=bool(profile),
+        )
+
+
+def is_configured() -> bool:
+    return _config is not None
+
+
+def get_config():
+    return _config
+
+
+_POLICIES: dict = {}
+
+
+def _build_policies():
+    global _POLICIES
+    if _POLICIES:
+        return _POLICIES
+    cp = jax.checkpoint_policies
+    _POLICIES = {
+        None: None,                      # save nothing: classic full remat
+        "nothing": None,
+        "everything": cp.everything_saveable,
+        "dots": cp.dots_saveable,
+        "dots_no_batch": cp.dots_with_no_batch_dims_saveable,
+        "checkpoint_dots": cp.dots_saveable,
+    }
+    if hasattr(cp, "save_anything_except_these_names"):
+        _POLICIES["offload_dots"] = getattr(
+            cp, "offload_dot_with_no_batch_dims", cp.dots_with_no_batch_dims_saveable)
+    return _POLICIES
+
+
+def checkpoint_policy(name: Optional[str] = None):
+    """Named policy -> jax.checkpoint policy callable (None = save nothing)."""
+    policies = _build_policies()
+    if name is None and _config is not None:
+        if _config.cpu_checkpointing:
+            name = "offload_dots" if "offload_dots" in policies else "nothing"
+        elif _config.policy:
+            name = _config.policy
+    if name not in policies:
+        raise ValueError(f"unknown remat policy '{name}'; known: {sorted(k for k in policies if k)}")
+    return policies[name]
+
+
+def checkpoint(function: Callable, *args):
+    """Drop-in for the reference's ``checkpoint(function, *args)``
+    (checkpointing.py:708): returns function(*args) with rematerialisation."""
+    return jax.checkpoint(function, policy=checkpoint_policy(None) if _config else None)(*args)
+
+
+def checkpoint_wrapper(function: Callable, policy: Optional[str] = None,
+                       prevent_cse: bool = True, static_argnums=()) -> Callable:
+    return jax.checkpoint(function, policy=checkpoint_policy(policy),
+                          prevent_cse=prevent_cse, static_argnums=static_argnums)

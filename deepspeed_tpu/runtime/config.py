@@ -1,0 +1,286 @@
+"""Master config system.
+
+TPU-native analog of reference ``deepspeed/runtime/config.py`` (DeepSpeedConfig,
+config.py:674): one JSON/dict config parsed once into ~20 typed sub-configs and
+threaded through every layer. Key names match the reference schema so existing
+DeepSpeed JSON files load unchanged; TPU-only sections (``tensor_parallel``,
+``sequence_parallel``, mesh overrides) extend it.
+
+The batch-size triple is solved with the same arithmetic as the reference's
+``_set_batch_related_parameters`` (config.py:904):
+    train_batch_size == micro_batch_per_device * gradient_accumulation_steps * dp_world
+"""
+
+from __future__ import annotations
+
+import copy
+import json
+import os
+from typing import Any, Dict, Optional, Union
+
+from deepspeed_tpu.comm.config import CommsLoggerConfig
+from deepspeed_tpu.monitor.config import DeepSpeedMonitorConfig, get_monitor_config
+from deepspeed_tpu.profiling.config import (
+    DeepSpeedFlopsProfilerConfig,
+    get_flops_profiler_config,
+)
+from deepspeed_tpu.runtime import constants as C
+from deepspeed_tpu.runtime.config_utils import (
+    DeepSpeedConfigModel,
+    dict_raise_error_on_duplicate_keys,
+    get_scalar_param,
+)
+from deepspeed_tpu.runtime.zero.config import DeepSpeedZeroConfig, ZeroStageEnum
+from deepspeed_tpu.utils.logging import logger
+
+
+class DeepSpeedConfigError(Exception):
+    pass
+
+
+class FP16Config(DeepSpeedConfigModel):
+    enabled: bool = False
+    auto_cast: bool = False
+    loss_scale: float = 0.0
+    initial_scale_power: int = 16
+    loss_scale_window: int = 1000
+    hysteresis: int = 2
+    min_loss_scale: float = 1.0
+    fp16_master_weights_and_grads: bool = False
+
+
+class BF16Config(DeepSpeedConfigModel):
+    enabled: bool = False
+
+
+class OptimizerConfig(DeepSpeedConfigModel):
+    type: Optional[str] = None
+    params: Dict[str, Any] = {}
+    legacy_fusion: bool = False
+
+
+class SchedulerConfig(DeepSpeedConfigModel):
+    type: Optional[str] = None
+    params: Dict[str, Any] = {}
+
+
+class ActivationCheckpointingConfig(DeepSpeedConfigModel):
+    """Reference schema (runtime/activation_checkpointing/config.py) mapped to
+    remat policies: ``partition_activations`` → save-nothing policy over the
+    model axis, ``cpu_checkpointing`` → offload policy."""
+
+    partition_activations: bool = False
+    cpu_checkpointing: bool = False
+    contiguous_memory_optimization: bool = False
+    number_checkpoints: Optional[int] = None
+    synchronize_checkpoint_boundary: bool = False
+    profile: bool = False
+    # TPU-native: named jax.checkpoint policy ("nothing", "dots", "dots_no_batch",
+    # "everything", "offload_dots")
+    policy: Optional[str] = None
+
+
+class TensorParallelConfig(DeepSpeedConfigModel):
+    tp_size: int = 1
+    autotp: bool = False
+
+
+class PipelineConfig(DeepSpeedConfigModel):
+    stages: int = 1
+    partition_method: str = "parameters"
+    activation_checkpoint_interval: int = 0
+    micro_batches: Optional[int] = None
+
+
+class SequenceParallelConfig(DeepSpeedConfigModel):
+    sp_size: int = 1
+    mode: str = "ring"  # "ring" | "ulysses"
+
+
+class ExpertParallelConfig(DeepSpeedConfigModel):
+    ep_size: int = 1
+
+
+class CheckpointConfig(DeepSpeedConfigModel):
+    tag_validation: str = "Warn"
+    load_universal: bool = False
+    use_node_local_storage: bool = False
+    parallel_write_pipeline: bool = False
+
+
+class DataloaderConfig(DeepSpeedConfigModel):
+    drop_last: bool = False
+
+
+class AIOConfig(DeepSpeedConfigModel):
+    """Host-swap engine knobs (the reference's aio section for ZeRO-Infinity)."""
+
+    block_size: int = 1048576
+    queue_depth: int = 8
+    thread_count: int = 1
+    single_submit: bool = False
+    overlap_events: bool = True
+
+
+def _read_config_dict(config: Union[str, dict]) -> dict:
+    if isinstance(config, dict):
+        return copy.deepcopy(config)
+    if isinstance(config, str):
+        if not os.path.exists(config):
+            raise DeepSpeedConfigError(f"config path does not exist: {config}")
+        with open(config) as f:
+            return json.load(f, object_pairs_hook=dict_raise_error_on_duplicate_keys)
+    raise DeepSpeedConfigError(f"unsupported config type {type(config)}")
+
+
+class DeepSpeedConfig:
+    """Parsed, validated config tree (reference DeepSpeedConfig, config.py:674)."""
+
+    def __init__(self, config: Union[str, dict], mpu=None, world_size: Optional[int] = None):
+        self._param_dict = _read_config_dict(config)
+        d = self._param_dict
+
+        # ---------------- parallel degrees (needed for batch arithmetic) ------
+        self.tensor_parallel = TensorParallelConfig(**d.get(C.TENSOR_PARALLEL, {}))
+        self.pipeline = PipelineConfig(**d.get(C.PIPELINE, {})) if isinstance(
+            d.get(C.PIPELINE, {}), dict) else PipelineConfig()
+        self.sequence_parallel = SequenceParallelConfig(**d.get(C.SEQUENCE_PARALLEL, {}))
+        self.expert_parallel = ExpertParallelConfig(
+            **({"ep_size": d[C.EXPERT_PARALLEL_SIZE]} if C.EXPERT_PARALLEL_SIZE in d else {}))
+
+        if world_size is None:
+            try:
+                import jax
+
+                world_size = jax.device_count()
+            except Exception:
+                world_size = 1
+        self.world_size = world_size
+        denom = (self.tensor_parallel.tp_size * self.pipeline.stages *
+                 self.sequence_parallel.sp_size)
+        self.data_parallel_size = max(world_size // max(denom, 1), 1)
+
+        # ---------------- batch triple ---------------------------------------
+        self.train_batch_size = d.get(C.TRAIN_BATCH_SIZE)
+        self.train_micro_batch_size_per_gpu = d.get(C.TRAIN_MICRO_BATCH_SIZE_PER_GPU)
+        self.gradient_accumulation_steps = d.get(C.GRADIENT_ACCUMULATION_STEPS)
+        self._set_batch_related_parameters()
+
+        # ---------------- precision ------------------------------------------
+        self.fp16_config = FP16Config(**d.get(C.FP16, {}))
+        bf16_dict = d.get(C.BFLOAT16, d.get(C.BFLOAT16_OLD, {}))
+        self.bf16_config = BF16Config(**bf16_dict)
+        self.fp16_enabled = self.fp16_config.enabled
+        self.bfloat16_enabled = self.bf16_config.enabled
+        if self.fp16_enabled and self.bfloat16_enabled:
+            raise DeepSpeedConfigError("fp16 and bf16 cannot both be enabled")
+        self.gradient_clipping = float(d.get(C.GRADIENT_CLIPPING, C.GRADIENT_CLIPPING_DEFAULT))
+        self.prescale_gradients = d.get(C.PRESCALE_GRADIENTS, C.PRESCALE_GRADIENTS_DEFAULT)
+        self.gradient_predivide_factor = d.get(
+            C.GRADIENT_PREDIVIDE_FACTOR, C.GRADIENT_PREDIVIDE_FACTOR_DEFAULT)
+
+        # ---------------- optimizer / scheduler -------------------------------
+        opt_dict = d.get(C.OPTIMIZER, {})
+        self.optimizer = OptimizerConfig(**opt_dict) if opt_dict else None
+        self.optimizer_name = self.optimizer.type.lower() if self.optimizer and \
+            self.optimizer.type else None
+        self.optimizer_params = self.optimizer.params if self.optimizer else {}
+        sched_dict = d.get(C.SCHEDULER, {})
+        self.scheduler = SchedulerConfig(**sched_dict) if sched_dict else None
+        self.scheduler_name = self.scheduler.type if self.scheduler else None
+        self.scheduler_params = self.scheduler.params if self.scheduler else {}
+
+        # ---------------- zero ------------------------------------------------
+        self.zero_config = DeepSpeedZeroConfig(**d.get("zero_optimization", {}))
+        self.zero_optimization_stage = int(self.zero_config.stage)
+        self.zero_enabled = self.zero_optimization_stage > 0
+        self.zero_allow_untested_optimizer = d.get(
+            C.ZERO_ALLOW_UNTESTED_OPTIMIZER, C.ZERO_ALLOW_UNTESTED_OPTIMIZER_DEFAULT)
+
+        # ---------------- subsystems -----------------------------------------
+        self.activation_checkpointing_config = ActivationCheckpointingConfig(
+            **d.get(C.ACTIVATION_CHECKPOINTING, {}))
+        self.monitor_config: DeepSpeedMonitorConfig = get_monitor_config(d)
+        self.flops_profiler_config: DeepSpeedFlopsProfilerConfig = get_flops_profiler_config(d)
+        self.comms_logger_config = CommsLoggerConfig(**d.get("comms_logger", {}))
+        self.checkpoint_config = CheckpointConfig(**d.get(C.CHECKPOINT, {}))
+        self.aio_config = AIOConfig(**d.get("aio", {}))
+        self.dataloader_drop_last = d.get(C.DATALOADER_DROP_LAST, C.DATALOADER_DROP_LAST_DEFAULT)
+
+        # ---------------- misc ------------------------------------------------
+        self.steps_per_print = d.get(C.STEPS_PER_PRINT, C.STEPS_PER_PRINT_DEFAULT)
+        self.wall_clock_breakdown = d.get(C.WALL_CLOCK_BREAKDOWN, C.WALL_CLOCK_BREAKDOWN_DEFAULT)
+        self.memory_breakdown = d.get(C.MEMORY_BREAKDOWN, C.MEMORY_BREAKDOWN_DEFAULT)
+        self.dump_state = d.get(C.DUMP_STATE, C.DUMP_STATE_DEFAULT)
+        self.seed = d.get(C.SEED, C.SEED_DEFAULT)
+        self.communication_data_type = d.get(
+            C.COMMUNICATION_DATA_TYPE, C.COMMUNICATION_DATA_TYPE_DEFAULT)
+        self.disable_allgather = d.get(C.DISABLE_ALLGATHER, C.DISABLE_ALLGATHER_DEFAULT)
+        self.load_universal_checkpoint = d.get(
+            C.LOAD_UNIVERSAL_CHECKPOINT, C.LOAD_UNIVERSAL_CHECKPOINT_DEFAULT)
+        self.elasticity_enabled = bool(d.get(C.ELASTICITY, {}).get("enabled", False))
+
+        # MoE section (layer-level config like the reference, plus global ep_size)
+        self.moe_param_dict = d.get("moe", {})
+
+        self._do_sanity_check()
+
+    # --- reference config.py:904 _set_batch_related_parameters, same logic ----
+    def _set_batch_related_parameters(self):
+        train_batch = self.train_batch_size
+        micro_batch = self.train_micro_batch_size_per_gpu
+        grad_acc = self.gradient_accumulation_steps
+        dp = self.data_parallel_size
+
+        if train_batch is not None and micro_batch is not None and grad_acc is not None:
+            pass
+        elif train_batch is not None and micro_batch is not None:
+            grad_acc = train_batch // micro_batch
+            grad_acc //= dp
+            self.gradient_accumulation_steps = grad_acc
+        elif train_batch is not None and grad_acc is not None:
+            micro_batch = train_batch // dp
+            micro_batch //= grad_acc
+            self.train_micro_batch_size_per_gpu = micro_batch
+        elif micro_batch is not None and grad_acc is not None:
+            self.train_batch_size = micro_batch * grad_acc * dp
+        elif train_batch is not None:
+            self.gradient_accumulation_steps = 1
+            self.train_micro_batch_size_per_gpu = train_batch // dp
+        elif micro_batch is not None:
+            self.train_batch_size = micro_batch * dp
+            self.gradient_accumulation_steps = 1
+        else:
+            raise DeepSpeedConfigError(
+                "Either train_batch_size or train_micro_batch_size_per_gpu needs to be provided")
+
+    def _batch_assertion(self):
+        train_batch = self.train_batch_size
+        micro_batch = self.train_micro_batch_size_per_gpu
+        grad_acc = self.gradient_accumulation_steps
+        assert train_batch > 0, f"train_batch_size: {train_batch} has to be greater than 0"
+        assert micro_batch > 0, f"micro_batch: {micro_batch} has to be greater than 0"
+        assert grad_acc > 0, f"gradient_accumulation_steps: {grad_acc} has to be greater than 0"
+        assert train_batch == micro_batch * grad_acc * self.data_parallel_size, (
+            f"Check batch related parameters. train_batch_size is not equal to "
+            f"micro_batch_per_gpu * gradient_acc_step * world_size "
+            f"{train_batch} != {micro_batch} * {grad_acc} * {self.data_parallel_size}")
+
+    def _do_sanity_check(self):
+        self._batch_assertion()
+        if self.zero_optimization_stage > ZeroStageEnum.max_stage:
+            raise DeepSpeedConfigError(
+                f"max zero stage is {int(ZeroStageEnum.max_stage)}, got "
+                f"{self.zero_optimization_stage}")
+
+    def print_user_config(self):
+        logger.info("  json = {}".format(
+            json.dumps(self._param_dict, sort_keys=True, indent=4, default=str)))
+
+    def print(self, name: str = "DeepSpeedConfig"):
+        logger.info(f"{name}:")
+        for k in sorted(vars(self)):
+            if k.startswith("_"):
+                continue
+            logger.info(f"  {k} = {getattr(self, k)}")
+        self.print_user_config()

@@ -1,0 +1,113 @@
+"""Data loading.
+
+Analog of reference ``runtime/dataloader.py`` (DeepSpeedDataLoader +
+DistributedSampler wiring, RepeatingLoader). TPU-native differences: JAX is
+single-controller per host, so the "distributed sampler" shards batches by
+``jax.process_index()`` across hosts; within a host the engine shards the
+global batch across devices via NamedSharding (no per-device loader).
+
+Sources supported: python iterables/generators yielding dict/tuple batches of
+numpy/jnp arrays, torch Datasets (indexed), and callables. Curriculum /
+data-efficiency sampling plugs in via ``deepspeed_tpu.runtime.data_pipeline``.
+"""
+
+from __future__ import annotations
+
+import itertools
+from typing import Any, Callable, Iterable, Iterator, Optional
+
+import numpy as np
+
+
+class RepeatingLoader:
+    """Wraps an iterator to restart on StopIteration (reference RepeatingLoader)."""
+
+    def __init__(self, loader):
+        self.loader = loader
+        self.data_iter = iter(self.loader)
+
+    def __iter__(self):
+        return self
+
+    def __len__(self):
+        return len(self.loader)
+
+    def __next__(self):
+        try:
+            return next(self.data_iter)
+        except StopIteration:
+            self.data_iter = iter(self.loader)
+            return next(self.data_iter)
+
+
+class DeepSpeedDataLoader:
+    """Batches an indexable dataset into per-step numpy batches.
+
+    - ``batch_size`` is the *micro* batch per data-parallel replica times the
+      local replica count — i.e. the per-process slice of the global batch.
+    - multi-host: each process reads its own shard (rank-strided, like the
+      reference's DistributedSampler).
+    """
+
+    def __init__(self, dataset, batch_size: int, *, collate_fn: Optional[Callable] = None,
+                 shuffle: bool = True, seed: int = 0, drop_last: bool = True,
+                 num_replicas: Optional[int] = None, rank: Optional[int] = None,
+                 data_sampler=None):
+        import jax
+
+        self.dataset = dataset
+        self.batch_size = batch_size
+        self.collate_fn = collate_fn or default_collate
+        self.shuffle = shuffle
+        self.seed = seed
+        self.drop_last = drop_last
+        self.num_replicas = num_replicas if num_replicas is not None else jax.process_count()
+        self.rank = rank if rank is not None else jax.process_index()
+        self.epoch = 0
+        self.data_sampler = data_sampler
+
+    def set_epoch(self, epoch: int):
+        self.epoch = epoch
+
+    def __len__(self):
+        n = len(self.dataset) // self.num_replicas
+        if self.drop_last:
+            return n // self.batch_size
+        return (n + self.batch_size - 1) // self.batch_size
+
+    def __iter__(self) -> Iterator[Any]:
+        n = len(self.dataset)
+        if self.data_sampler is not None:
+            indices = list(self.data_sampler)
+        else:
+            indices = np.arange(n)
+            if self.shuffle:
+                rng = np.random.RandomState(self.seed + self.epoch)
+                rng.shuffle(indices)
+        indices = indices[self.rank::self.num_replicas]
+        batch = []
+        for idx in indices:
+            batch.append(self.dataset[int(idx)])
+            if len(batch) == self.batch_size:
+                yield self.collate_fn(batch)
+                batch = []
+        if batch and not self.drop_last:
+            yield self.collate_fn(batch)
+
+
+def default_collate(samples):
+    """Stack a list of samples (dicts / tuples / arrays) into numpy batches."""
+    first = samples[0]
+    if isinstance(first, dict):
+        return {k: default_collate([s[k] for s in samples]) for k in first}
+    if isinstance(first, (tuple, list)):
+        return type(first)(default_collate([s[i] for s in samples]) for i in range(len(first)))
+    arr = np.stack([np.asarray(s) for s in samples])
+    return arr
+
+
+def build_dataloader(dataset, batch_size: int, config=None, **kw) -> DeepSpeedDataLoader:
+    drop_last = kw.pop("drop_last", None)
+    if drop_last is None and config is not None:
+        drop_last = config.dataloader_drop_last
+    return DeepSpeedDataLoader(dataset, batch_size, drop_last=bool(drop_last), **kw)

@@ -1,0 +1,531 @@
+"""Training engine (L4).
+
+TPU-native re-design of the reference ``DeepSpeedEngine``
+(runtime/engine.py:181, 3267 LoC). The reference wraps a torch nn.Module and
+drives forward/backward/step imperatively with grad hooks firing collectives;
+here the entire step — microbatch scan (grad accumulation), loss scaling,
+mixed-precision casts, ZeRO collectives, overflow check, clip, optimizer
+update, loss-scale adjustment — is ONE compiled XLA program built from the
+PartitionPlan's shardings. XLA schedules the reduce-scatters/all-gathers the
+reference hand-buckets (stage_1_and_2.py average_tensor:894, stage3.py
+__reduce_and_partition_ipg_grads:1045).
+
+API parity (reference names in parens):
+    engine(batch) / engine.forward(batch)   — compute loss (+cache grads)
+    engine.backward(loss)                   — accumulate grads (backward:1755)
+    engine.step()                           — optimizer step at gas boundary
+                                              (step:1951, _take_model_step:1886)
+    engine.train_batch(data_iter)           — fused full step (PipelineEngine
+                                              train_batch:285 shape, but valid
+                                              for every topology here)
+    engine.eval_batch(batch)                — no-grad loss
+    engine.save_checkpoint / load_checkpoint
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Dict, Iterator, NamedTuple, Optional, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from deepspeed_tpu.accelerator import get_accelerator
+from deepspeed_tpu.ops.adam import build_optimizer
+from deepspeed_tpu.runtime.config import DeepSpeedConfig
+from deepspeed_tpu.runtime.lr_schedules import build_lr_scheduler
+from deepspeed_tpu.runtime.precision import (
+    DynamicLossScaler,
+    LossScalerState,
+    StaticLossScaler,
+    clip_grads_by_global_norm,
+    create_loss_scaler,
+    global_grad_norm,
+    has_inf_or_nan,
+)
+from deepspeed_tpu.runtime.zero.partition import PartitionPlan
+from deepspeed_tpu.utils import groups as groups_mod
+from deepspeed_tpu.utils.logging import log_dist, logger
+from deepspeed_tpu.utils.timer import (
+    BACKWARD_GLOBAL_TIMER,
+    FORWARD_GLOBAL_TIMER,
+    STEP_GLOBAL_TIMER,
+    TRAIN_BATCH_TIMER,
+    SynchronizedWallClockTimer,
+    ThroughputTimer,
+)
+
+
+class TrainState(NamedTuple):
+    params: Any            # fp32 master params (sharded per plan)
+    opt_state: Any
+    scaler: LossScalerState
+    global_step: jax.Array
+
+
+class DeepSpeedEngine:
+    def __init__(self, model, config: Union[DeepSpeedConfig, dict, str], *,
+                 optimizer=None, lr_scheduler=None, training_data=None,
+                 collate_fn=None, topology=None, init_rng=None, dont_change_device=False):
+        if not isinstance(config, DeepSpeedConfig):
+            config = DeepSpeedConfig(config)
+        self.config = config
+        self._config = config  # reference attribute name
+        self.module = model
+        self.accelerator = get_accelerator()
+
+        # ---- topology / groups (engine _configure_distributed_model analog)
+        if topology is None:
+            topology = groups_mod.initialize(
+                tp_size=config.tensor_parallel.tp_size,
+                pp_size=config.pipeline.stages,
+                ep_size=config.expert_parallel.ep_size,
+                sp_size=config.sequence_parallel.sp_size,
+            )
+        else:
+            groups_mod.initialize(topology)
+        self.topology = topology
+        self.mesh = topology.mesh
+
+        # ---- precision policy
+        self.fp16_enabled = config.fp16_enabled
+        self.bfloat16_enabled = config.bfloat16_enabled
+        if self.fp16_enabled:
+            self.compute_dtype = jnp.float16
+            self.loss_scaler = create_loss_scaler(config.fp16_config)
+        elif self.bfloat16_enabled:
+            self.compute_dtype = jnp.bfloat16
+            self.loss_scaler = StaticLossScaler(1.0)
+        else:
+            self.compute_dtype = jnp.float32
+            self.loss_scaler = StaticLossScaler(1.0)
+        self.dynamic_loss_scale = isinstance(self.loss_scaler, DynamicLossScaler)
+
+        # ---- partition plan (ZeRO + TP declarative shardings)
+        self.zero_stage = config.zero_optimization_stage
+        self.plan = PartitionPlan(
+            topology=topology,
+            zero_stage=self.zero_stage,
+            param_persistence_threshold=config.zero_config.param_persistence_threshold,
+        )
+        self.logical_axes = model.logical_axes() if hasattr(model, "logical_axes") else None
+
+        # ---- offload: optimizer state / master params to host memory
+        zc = config.zero_config
+        self.offload_optimizer = bool(
+            zc.offload_optimizer and zc.offload_optimizer.device != "none")
+
+        # ---- optimizer (reference _configure_optimizer:1137)
+        if optimizer is None and config.optimizer_name is not None:
+            optimizer = build_optimizer(config.optimizer_name, config.optimizer_params)
+        if optimizer is None:
+            optimizer = build_optimizer("adam", {"lr": 1e-3})
+        self.optimizer = optimizer
+        self.client_lr_scheduler = lr_scheduler
+        if lr_scheduler is None and config.scheduler_name is not None:
+            lr_scheduler = build_lr_scheduler(config.scheduler_name,
+                                              config.scheduler_params, optimizer)
+        self.lr_scheduler = lr_scheduler
+        if self.lr_scheduler is not None and self.lr_scheduler.last_batch_iteration < 0:
+            self.lr_scheduler.step(0)  # prime initial LR (warmup start)
+
+        # ---- shardings
+        self._build_shardings()
+
+        # ---- state init (zero.Init analog: params born sharded on device)
+        self._init_rng = init_rng if init_rng is not None else jax.random.PRNGKey(config.seed)
+        self.state = self._init_state()
+        self._dropout_rng = jax.random.fold_in(self._init_rng, 0x5eed)
+
+        # ---- counters (reference engine attrs)
+        self.micro_steps = 0
+        self.global_steps = 0
+        self.skipped_steps = 0
+        self.gas = config.gradient_accumulation_steps
+        self._grad_acc = None       # accumulated grads for fwd/bwd/step API
+        self._acc_count = 0
+        self._global_grad_norm = None
+
+        # ---- compiled steps
+        self._compiled_train_step = None
+        self._compiled_micro_grad = None
+        self._compiled_apply_grads = None
+        self._compiled_eval = None
+
+        # ---- data / monitor / timers
+        self.training_dataloader = None
+        if training_data is not None:
+            self.training_dataloader = self.deepspeed_io(training_data, collate_fn=collate_fn)
+        self.timers = SynchronizedWallClockTimer(
+            sync_fn=lambda: jax.block_until_ready(self.state.params))
+        self.tput_timer = ThroughputTimer(
+            batch_size=config.train_batch_size,
+            steps_per_output=config.steps_per_print or 50)
+        if hasattr(model, "flops_per_token"):
+            try:
+                self.tput_timer.flops_per_sample = model.flops_per_token()
+            except Exception:
+                pass
+        from deepspeed_tpu.monitor.monitor import MonitorMaster
+
+        self.monitor = MonitorMaster(config.monitor_config)
+        import deepspeed_tpu.comm as dist
+
+        dist.configure(comms_config=None, enabled=config.comms_logger_config.enabled,
+                       prof_all=config.comms_logger_config.prof_all,
+                       prof_ops=config.comms_logger_config.prof_ops,
+                       verbose=config.comms_logger_config.verbose)
+
+        log_dist(
+            f"DeepSpeedEngine: zero_stage={self.zero_stage} dtype={self.compute_dtype.__name__} "
+            f"mesh={dict(zip(topology.get_axis_names(), topology.mesh_shape))} "
+            f"batch triple=({config.train_batch_size},{config.train_micro_batch_size_per_gpu},"
+            f"{config.gradient_accumulation_steps})", ranks=[0])
+
+    # ------------------------------------------------------------------ specs
+    def _build_shardings(self):
+        mesh = self.mesh
+        params_shape = jax.eval_shape(self.module.init, self._rng_placeholder())
+        self._params_shape = params_shape
+        self.master_specs = self.plan.master_specs(params_shape, self.logical_axes)
+        self.compute_specs = self.plan.compute_specs(params_shape, self.logical_axes)
+        self.grad_specs = self.plan.grad_specs(params_shape, self.logical_axes)
+        mem_kind = "pinned_host" if (self.offload_optimizer and
+                                     self.accelerator.name() == "tpu") else None
+        self.master_shardings = self.plan.shardings(self.master_specs)
+        opt_state_shape = jax.eval_shape(self.optimizer.init, params_shape)
+        self.opt_specs = self._specs_like(opt_state_shape)
+        self.opt_shardings = self.plan.shardings(self.opt_specs, memory_kind=mem_kind)
+        self._replicated = NamedSharding(mesh, P())
+        self.state_shardings = TrainState(
+            params=self.master_shardings,
+            opt_state=self.opt_shardings,
+            scaler=jax.tree_util.tree_map(lambda _: self._replicated,
+                                          self.loss_scaler.init()),
+            global_step=self._replicated,
+        )
+
+    def _rng_placeholder(self):
+        return jax.random.PRNGKey(0)
+
+    def _specs_like(self, tree_shape):
+        """Map arbitrary state trees (optimizer moments) to master specs by
+        shape-matching against params; scalars/unknown shapes replicate."""
+        shape_to_spec: Dict[Tuple, P] = {}
+
+        def record(p, spec):
+            shape_to_spec.setdefault(tuple(p.shape), spec)
+
+        jax.tree_util.tree_map(record, self._params_shape, self.master_specs,
+                               is_leaf=lambda x: isinstance(x, P))
+
+        def assign(leaf):
+            s = tuple(leaf.shape)
+            if s in shape_to_spec:
+                return shape_to_spec[s]
+            if len(s) == 0:
+                return P()
+            return self.plan.master_spec(s, None)
+
+        return jax.tree_util.tree_map(assign, tree_shape)
+
+    # ------------------------------------------------------------------- init
+    def _init_state(self) -> TrainState:
+        init_params = jax.jit(self.module.init, out_shardings=self.master_shardings)
+        params = init_params(self._init_rng)
+        opt_state = jax.jit(self.optimizer.init, out_shardings=self.opt_shardings)(params)
+        scaler_state = self.loss_scaler.init()
+        return TrainState(params=params, opt_state=opt_state, scaler=scaler_state,
+                          global_step=jnp.zeros((), jnp.int32))
+
+    # ---------------------------------------------------------- micro helpers
+    def _cast_for_compute(self, params):
+        specs = self.compute_specs
+
+        def cast(p, spec):
+            c = p.astype(self.compute_dtype) if p.dtype == jnp.float32 else p
+            return jax.lax.with_sharding_constraint(c, NamedSharding(self.mesh, spec))
+
+        return jax.tree_util.tree_map(cast, params, specs)
+
+    def _micro_loss_and_grads(self, params, batch, scale, rng):
+        """Single microbatch loss+grads in compute dtype; grads carry the
+        stage-dependent sharding constraint (→ reduce-scatter from stage 2)."""
+
+        def loss_fn(master_params):
+            cparams = self._cast_for_compute(master_params)
+            loss, metrics = self.module.apply(cparams, batch, rngs={"dropout": rng}, train=True)
+            return loss * scale, metrics
+
+        (scaled_loss, metrics), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
+        grads = jax.tree_util.tree_map(
+            lambda g, s: jax.lax.with_sharding_constraint(
+                g.astype(jnp.float32), NamedSharding(self.mesh, s)),
+            grads, self.grad_specs)
+        return scaled_loss, grads, metrics
+
+    def _apply_grads(self, state: TrainState, grads, lr):
+        """unscale → overflow check → clip → optimizer → scale update.
+        (_take_model_step analog, engine.py:1886)."""
+        inv = 1.0 / state.scaler.cur_scale
+        grads = jax.tree_util.tree_map(lambda g: g * inv, grads)
+        if self.fp16_enabled:
+            overflow = has_inf_or_nan(grads)
+        else:
+            overflow = jnp.zeros((), bool)
+        norm = global_grad_norm(grads)
+        if self.config.gradient_clipping > 0:
+            grads, norm = clip_grads_by_global_norm(grads, self.config.gradient_clipping, norm)
+        new_params, new_opt = self.optimizer.step(state.params, grads, state.opt_state, lr)
+        # skip the update on overflow (dynamic loss scaling semantics)
+        new_params = jax.tree_util.tree_map(
+            lambda old, new: jnp.where(overflow, old, new), state.params, new_params)
+        new_opt = jax.tree_util.tree_map(
+            lambda old, new: jnp.where(overflow, old, new), state.opt_state, new_opt)
+        new_scaler = self.loss_scaler.update(state.scaler, overflow)
+        new_state = TrainState(params=new_params, opt_state=new_opt, scaler=new_scaler,
+                               global_step=state.global_step + 1 - overflow.astype(jnp.int32))
+        return new_state, overflow, norm
+
+    # -------------------------------------------------------- fused train step
+    def _build_train_step(self):
+        gas = self.gas
+
+        def train_step(state: TrainState, batch, lr, rng):
+            scale = state.scaler.cur_scale
+
+            def micro(carry, mb_and_i):
+                grads_acc, loss_acc = carry
+                mb, i = mb_and_i
+                sub = jax.random.fold_in(rng, i)
+                scaled_loss, grads, metrics = self._micro_loss_and_grads(
+                    state.params, mb, scale, sub)
+                grads_acc = jax.tree_util.tree_map(jnp.add, grads_acc, grads)
+                return (grads_acc, loss_acc + metrics["loss"]), None
+
+            grads0 = jax.tree_util.tree_map(
+                lambda p, s: jax.lax.with_sharding_constraint(
+                    jnp.zeros(p.shape, jnp.float32), NamedSharding(self.mesh, s)),
+                state.params, self.grad_specs)
+            (grads, loss_sum), _ = jax.lax.scan(
+                micro, (grads0, jnp.zeros((), jnp.float32)),
+                (batch, jnp.arange(gas)))
+            grads = jax.tree_util.tree_map(lambda g: g / gas, grads)
+            new_state, overflow, norm = self._apply_grads(state, grads, lr)
+            metrics = {"loss": loss_sum / gas, "overflow": overflow, "grad_norm": norm,
+                       "loss_scale": state.scaler.cur_scale}
+            return new_state, metrics
+
+        batch_sharding_fn = self._gas_batch_shardings
+        self._compiled_train_step = jax.jit(train_step, donate_argnums=(0,))
+        return self._compiled_train_step
+
+    def _gas_batch_shardings(self, batch):
+        def shard(x):
+            spec = self.plan.batch_spec(x.ndim - 1)
+            return NamedSharding(self.mesh, P(None, *spec))
+        return jax.tree_util.tree_map(shard, batch)
+
+    def _batch_shardings(self, batch):
+        return jax.tree_util.tree_map(
+            lambda x: NamedSharding(self.mesh, self.plan.batch_spec(x.ndim)), batch)
+
+    # --------------------------------------------------------------- user API
+    def train_batch(self, data_iter: Optional[Iterator] = None):
+        """Pull ``gas`` microbatches, run ONE fused compiled step.
+        Microbatch leaves are stacked on a leading [gas] dim."""
+        if data_iter is None:
+            assert self.training_dataloader is not None, \
+                "train_batch needs a data_iter or training_data at init"
+            if not hasattr(self, "_train_iter") or self._train_iter is None:
+                from deepspeed_tpu.runtime.dataloader import RepeatingLoader
+
+                self._train_iter = iter(RepeatingLoader(self.training_dataloader))
+            data_iter = self._train_iter
+        micro_batches = [next(data_iter) for _ in range(self.gas)]
+        batch = jax.tree_util.tree_map(lambda *xs: np.stack(xs), *micro_batches)
+        return self._run_fused_step(batch)
+
+    def train_batch_from_stacked(self, batch):
+        """As train_batch, but the caller supplies the [gas, ...] stacked batch."""
+        return self._run_fused_step(batch)
+
+    def _run_fused_step(self, batch):
+        if self._compiled_train_step is None:
+            self._build_train_step()
+        self.tput_timer.start()
+        self.timers(TRAIN_BATCH_TIMER).start()
+        lr = jnp.asarray(self.get_lr()[0], jnp.float32)
+        rng = jax.random.fold_in(self._dropout_rng, self.global_steps)
+        batch = jax.device_put(batch, self._gas_batch_shardings(batch))
+        self.state, metrics = self._compiled_train_step(self.state, batch, lr, rng)
+        self._global_grad_norm = metrics["grad_norm"]
+        self.micro_steps += self.gas
+        self.global_steps += 1
+        if self.lr_scheduler is not None:
+            self.lr_scheduler.step()
+        self._after_step(metrics)
+        self.timers(TRAIN_BATCH_TIMER).stop(record=True)
+        self.tput_timer.stop(global_step=True)
+        return metrics["loss"]
+
+    def _after_step(self, metrics):
+        cfg = self.config
+        if self.fp16_enabled:
+            # host round-trip only when someone asks; keep async by default
+            pass
+        if self.monitor.enabled and self.global_steps % max(cfg.steps_per_print, 1) == 0:
+            loss = float(jax.device_get(metrics["loss"]))
+            events = [("Train/Samples/train_loss", loss, self.global_steps),
+                      ("Train/Samples/lr", self.get_lr()[0], self.global_steps)]
+            if self.fp16_enabled:
+                events.append(("Train/Samples/loss_scale",
+                               float(jax.device_get(metrics["loss_scale"])), self.global_steps))
+            self.monitor.write_events(events)
+        if cfg.steps_per_print and self.global_steps % cfg.steps_per_print == 0:
+            loss = float(jax.device_get(metrics["loss"]))
+            log_dist(f"step={self.global_steps} loss={loss:.4f} lr={self.get_lr()[0]:.3e}",
+                     ranks=[0])
+            if cfg.wall_clock_breakdown:
+                self.timers.log([TRAIN_BATCH_TIMER, FORWARD_GLOBAL_TIMER,
+                                 BACKWARD_GLOBAL_TIMER, STEP_GLOBAL_TIMER],
+                                memory_breakdown=cfg.memory_breakdown)
+
+    # ------------------------------------------ forward/backward/step parity
+    def forward(self, batch):
+        """Compute loss for one microbatch; grads are computed in the same
+        compiled program and cached for backward() (JAX has no separate
+        autograd pass — doc'd divergence from reference forward:1614)."""
+        if self._compiled_micro_grad is None:
+            def micro(state_params, scaler, batch, rng):
+                return self._micro_loss_and_grads(state_params, batch, scaler.cur_scale, rng)
+            self._compiled_micro_grad = jax.jit(micro)
+        self.timers(FORWARD_GLOBAL_TIMER).start()
+        rng = jax.random.fold_in(self._dropout_rng, self.micro_steps)
+        batch = jax.device_put(batch, self._batch_shardings(batch))
+        scaled_loss, grads, metrics = self._compiled_micro_grad(
+            self.state.params, self.state.scaler, batch, rng)
+        self._pending = (scaled_loss, grads)
+        self.timers(FORWARD_GLOBAL_TIMER).stop()
+        return metrics["loss"]
+
+    __call__ = forward
+
+    def backward(self, loss=None, allreduce_gradients: bool = True):
+        """Accumulate the cached grads (reference backward:1755 + grad hooks)."""
+        assert getattr(self, "_pending", None) is not None, \
+            "backward() must follow forward()"
+        self.timers(BACKWARD_GLOBAL_TIMER).start()
+        _, grads = self._pending
+        self._pending = None
+        if self._grad_acc is None:
+            self._grad_acc = grads
+        else:
+            add = jax.jit(lambda a, b: jax.tree_util.tree_map(jnp.add, a, b))
+            self._grad_acc = add(self._grad_acc, grads)
+        self._acc_count += 1
+        self.timers(BACKWARD_GLOBAL_TIMER).stop()
+        return loss
+
+    def is_gradient_accumulation_boundary(self) -> bool:
+        return (self.micro_steps + 1) % self.gas == 0
+
+    def step(self):
+        """Apply optimizer at gas boundary (reference step:1951)."""
+        self.timers(STEP_GLOBAL_TIMER).start()
+        at_boundary = self.is_gradient_accumulation_boundary()
+        if at_boundary:
+            assert self._acc_count == self.gas, (
+                f"step() at boundary needs {self.gas} backward() calls, "
+                f"got {self._acc_count}")
+            if self._compiled_apply_grads is None:
+                def apply_fn(state, grads, lr):
+                    grads = jax.tree_util.tree_map(lambda g: g / self.gas, grads)
+                    new_state, overflow, norm = self._apply_grads(state, grads, lr)
+                    return new_state, overflow, norm
+                self._compiled_apply_grads = jax.jit(apply_fn, donate_argnums=(0, 1))
+            lr = jnp.asarray(self.get_lr()[0], jnp.float32)
+            self.state, overflow, norm = self._compiled_apply_grads(
+                self.state, self._grad_acc, lr)
+            self._grad_acc = None
+            self._acc_count = 0
+            self._global_grad_norm = norm
+            self.global_steps += 1
+            if self.lr_scheduler is not None:
+                self.lr_scheduler.step()
+        self.micro_steps += 1
+        self.timers(STEP_GLOBAL_TIMER).stop()
+
+    # -------------------------------------------------------------- eval path
+    def eval_batch(self, batch):
+        if self._compiled_eval is None:
+            def ev(params, batch):
+                cparams = self._cast_for_compute(params)
+                loss, metrics = self.module.apply(cparams, batch, rngs=None, train=False)
+                return loss
+            self._compiled_eval = jax.jit(ev)
+        batch = jax.device_put(batch, self._batch_shardings(batch))
+        return self._compiled_eval(self.state.params, batch)
+
+    # ------------------------------------------------------------- accessors
+    def get_lr(self):
+        if self.lr_scheduler is not None:
+            return self.lr_scheduler.get_last_lr()
+        return [getattr(self.optimizer, "lr", 1e-3)]
+
+    def get_global_grad_norm(self):
+        return None if self._global_grad_norm is None else float(
+            jax.device_get(self._global_grad_norm))
+
+    def train_micro_batch_size_per_gpu(self) -> int:
+        return self.config.train_micro_batch_size_per_gpu
+
+    def train_batch_size(self) -> int:
+        return self.config.train_batch_size
+
+    def gradient_accumulation_steps(self) -> int:
+        return self.gas
+
+    def zero_optimization_stage(self) -> int:
+        return self.zero_stage
+
+    @property
+    def params(self):
+        return self.state.params
+
+    def get_loss_scale(self):
+        return float(jax.device_get(self.state.scaler.cur_scale))
+
+    # --------------------------------------------------------------- data io
+    def deepspeed_io(self, dataset, batch_size=None, collate_fn=None, **kw):
+        from deepspeed_tpu.runtime.dataloader import build_dataloader
+
+        if batch_size is None:
+            # per-process batch: micro_batch * local share of the dense batch axes
+            batch_size = self.config.train_micro_batch_size_per_gpu * (
+                self.topology.data_parallel_size // max(jax.process_count(), 1))
+        return build_dataloader(dataset, batch_size, config=self.config,
+                                collate_fn=collate_fn, **kw)
+
+    # ----------------------------------------------------------- checkpoints
+    def save_checkpoint(self, save_dir, tag=None, client_state=None, save_latest=True):
+        from deepspeed_tpu.runtime.checkpoint_engine.engine import save_engine_checkpoint
+
+        return save_engine_checkpoint(self, save_dir, tag=tag, client_state=client_state,
+                                      save_latest=save_latest)
+
+    def load_checkpoint(self, load_dir, tag=None, load_optimizer_states=True,
+                        load_lr_scheduler_states=True, load_module_only=False):
+        from deepspeed_tpu.runtime.checkpoint_engine.engine import load_engine_checkpoint
+
+        return load_engine_checkpoint(self, load_dir, tag=tag,
+                                      load_optimizer_states=load_optimizer_states,
+                                      load_lr_scheduler_states=load_lr_scheduler_states,
+                                      load_module_only=load_module_only)
+
+    def save_16bit_model(self, save_dir, save_filename="pytorch_model.bin"):
+        from deepspeed_tpu.runtime.checkpoint_engine.engine import save_16bit_model
+
+        return save_16bit_model(self, save_dir, save_filename)

@@ -1,0 +1,143 @@
+"""Pipeline model description — analog of reference ``runtime/pipe/module.py``
+(PipelineModule:85, LayerSpec:29, TiedLayerSpec:76).
+
+A PipelineModule is a list of layer specs partitioned into stages. Each spec
+builds a functional layer: ``init(rng) -> params`` and
+``apply(params, x, *, rngs, train) -> x``. The PipelineEngine (pipe/engine.py)
+executes stages over the 'pipe' mesh axis.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+import jax
+import numpy as np
+
+
+class LayerSpec:
+    """Deferred layer construction (reference LayerSpec builds the nn.Module
+    lazily on its stage's device; here laziness avoids materialising params
+    for stages this process doesn't own)."""
+
+    def __init__(self, typename: Callable, *args, **kwargs):
+        self.typename = typename
+        self.args = args
+        self.kwargs = kwargs
+
+    def build(self):
+        return self.typename(*self.args, **self.kwargs)
+
+    def __repr__(self):
+        return f"LayerSpec({getattr(self.typename, '__name__', self.typename)})"
+
+
+class TiedLayerSpec(LayerSpec):
+    """Layers sharing parameters across stages (reference TiedLayerSpec:76) —
+    e.g. tied input/output embeddings in GPT. ``key`` names the tie group;
+    ``forward_fn`` optionally reinterprets the shared params."""
+
+    def __init__(self, key: str, typename: Callable, *args,
+                 forward_fn: Optional[Callable] = None, tied_weight_attr: str = "weight",
+                 **kwargs):
+        super().__init__(typename, *args, **kwargs)
+        self.key = key
+        self.forward_fn = forward_fn
+        self.tied_weight_attr = tied_weight_attr
+
+
+class PipelineModule:
+    """Partitioned layer-list model (reference PipelineModule:85).
+
+    partition_method: 'uniform' | 'parameters' — same options as the
+    reference (regex profiling TBD); parameters partitioning balances
+    estimated param counts per stage.
+    """
+
+    def __init__(self, layers: Sequence[LayerSpec], num_stages: Optional[int] = None,
+                 loss_fn: Optional[Callable] = None, partition_method: str = "parameters",
+                 activation_checkpoint_interval: int = 0, seed_layers: bool = False):
+        self.layer_specs = list(layers)
+        self.num_stages = num_stages or 1
+        self.loss_fn = loss_fn
+        self.partition_method = partition_method
+        self.activation_checkpoint_interval = activation_checkpoint_interval
+        self._layers = [spec.build() if isinstance(spec, LayerSpec) else spec
+                        for spec in self.layer_specs]
+        self.parts = self._partition_layers()
+
+    # ---------------------------------------------------------------- builder
+    def _estimate_params(self, layer) -> int:
+        try:
+            shapes = jax.eval_shape(layer.init, jax.random.PRNGKey(0))
+            return sum(int(np.prod(s.shape)) for s in jax.tree_util.tree_leaves(shapes))
+        except Exception:
+            return 1
+
+    def _partition_layers(self) -> List[int]:
+        """Stage boundaries: parts[i] is the first layer of stage i
+        (reference module.py _partition_layers)."""
+        n, s = len(self._layers), self.num_stages
+        assert n >= s, f"cannot split {n} layers into {s} stages"
+        if self.partition_method == "uniform":
+            bounds = [round(i * n / s) for i in range(s + 1)]
+        else:  # 'parameters': balance cumulative param counts
+            weights = np.array([self._estimate_params(l) for l in self._layers], dtype=np.float64)
+            cum = np.cumsum(weights)
+            total = cum[-1]
+            bounds = [0]
+            for i in range(1, s):
+                bounds.append(int(np.searchsorted(cum, total * i / s)) + 1)
+            bounds.append(n)
+            # enforce monotonicity / at least one layer per stage
+            for i in range(1, s + 1):
+                bounds[i] = max(bounds[i], bounds[i - 1] + 1) if i < s + 1 else bounds[i]
+            bounds[s] = n
+        return bounds
+
+    def stage_layers(self, stage_id: int):
+        lo, hi = self.parts[stage_id], self.parts[stage_id + 1]
+        return self._layers[lo:hi]
+
+    @property
+    def layers(self):
+        return self._layers
+
+    def tied_groups(self) -> Dict[str, List[int]]:
+        groups: Dict[str, List[int]] = {}
+        for i, spec in enumerate(self.layer_specs):
+            if isinstance(spec, TiedLayerSpec):
+                groups.setdefault(spec.key, []).append(i)
+        return groups
+
+    # ------------------------------------------------- whole-model functional
+    def init(self, rng):
+        params = []
+        tied: Dict[str, Any] = {}
+        for i, (spec, layer) in enumerate(zip(self.layer_specs, self._layers)):
+            rng, sub = jax.random.split(rng)
+            if isinstance(spec, TiedLayerSpec) and spec.key in tied:
+                params.append(tied[spec.key])  # share the same pytree
+            else:
+                p = layer.init(sub) if hasattr(layer, "init") else {}
+                params.append(p)
+                if isinstance(spec, TiedLayerSpec):
+                    tied[spec.key] = p
+        return params
+
+    def apply(self, params, batch, *, rngs=None, train: bool = False):
+        x = batch["inputs"] if isinstance(batch, dict) else batch[0]
+        labels = batch.get("labels") if isinstance(batch, dict) else batch[1]
+        for i, layer in enumerate(self._layers):
+            if hasattr(layer, "apply"):
+                x = layer.apply(params[i], x, rngs=rngs, train=train)
+            else:
+                x = layer(x)
+        if self.loss_fn is not None:
+            loss = self.loss_fn(x, labels)
+            return loss, {"loss": loss}
+        return x, {}
+
+    def logical_axes(self):
+        return None

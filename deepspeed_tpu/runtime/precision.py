@@ -1,0 +1,128 @@
+"""Mixed precision: loss scaling + dtype policy.
+
+Analog of reference ``deepspeed/runtime/fp16/loss_scaler.py`` (LossScaler /
+DynamicLossScaler, :90) and the bf16/fp16 optimizer wrappers
+(``runtime/bf16_optimizer.py``, ``runtime/fp16/fused_optimizer.py``).
+
+On TPU bf16 is native, so the canonical mode is "bf16 compute, fp32 master"
+with no loss scaling; fp16 with dynamic scaling is retained for parity. The
+scaler state is a jittable pytree so the whole update (overflow check,
+scale adjustment, conditional optimizer skip) lives inside the compiled step
+— the reference needs a separate allreduce for overflow checks
+(runtime/utils.py CheckOverflow); here it is part of the fused program.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+
+class LossScalerState(NamedTuple):
+    cur_scale: jax.Array          # f32 scalar
+    cur_iter: jax.Array           # i32
+    last_overflow_iter: jax.Array  # i32
+    cur_hysteresis: jax.Array     # i32
+
+
+@dataclasses.dataclass
+class DynamicLossScaler:
+    init_scale: float = 2.0 ** 16
+    scale_factor: float = 2.0
+    scale_window: int = 1000
+    min_scale: float = 1.0
+    delayed_shift: int = 1  # hysteresis
+    consecutive_hysteresis: bool = False
+
+    def init(self) -> LossScalerState:
+        return LossScalerState(
+            cur_scale=jnp.asarray(self.init_scale, jnp.float32),
+            cur_iter=jnp.zeros((), jnp.int32),
+            last_overflow_iter=jnp.asarray(-1, jnp.int32),
+            cur_hysteresis=jnp.asarray(self.delayed_shift, jnp.int32),
+        )
+
+    def update(self, state: LossScalerState, has_overflow: jax.Array) -> LossScalerState:
+        def on_overflow(s: LossScalerState) -> LossScalerState:
+            new_hyst = s.cur_hysteresis - 1
+            drop = new_hyst <= 0
+            new_scale = jnp.where(
+                drop, jnp.maximum(s.cur_scale / self.scale_factor, self.min_scale), s.cur_scale)
+            return LossScalerState(
+                cur_scale=new_scale,
+                cur_iter=s.cur_iter + 1,
+                last_overflow_iter=s.cur_iter,
+                cur_hysteresis=jnp.where(drop, jnp.asarray(self.delayed_shift, jnp.int32),
+                                         new_hyst).astype(jnp.int32),
+            )
+
+        def on_ok(s: LossScalerState) -> LossScalerState:
+            grow = (s.cur_iter - s.last_overflow_iter) % self.scale_window == (
+                self.scale_window - 1)
+            return LossScalerState(
+                cur_scale=jnp.where(grow, s.cur_scale * self.scale_factor, s.cur_scale),
+                cur_iter=s.cur_iter + 1,
+                last_overflow_iter=s.last_overflow_iter,
+                cur_hysteresis=s.cur_hysteresis,
+            )
+
+        return jax.tree_util.tree_map(
+            lambda a, b: jnp.where(has_overflow, a, b), on_overflow(state), on_ok(state))
+
+
+@dataclasses.dataclass
+class StaticLossScaler:
+    scale: float = 1.0
+
+    def init(self) -> LossScalerState:
+        return LossScalerState(
+            cur_scale=jnp.asarray(self.scale, jnp.float32),
+            cur_iter=jnp.zeros((), jnp.int32),
+            last_overflow_iter=jnp.asarray(-1, jnp.int32),
+            cur_hysteresis=jnp.ones((), jnp.int32),
+        )
+
+    def update(self, state: LossScalerState, has_overflow: jax.Array) -> LossScalerState:
+        return state._replace(cur_iter=state.cur_iter + 1)
+
+
+def create_loss_scaler(fp16_config) -> Any:
+    """Mirror of CREATE_LOSS_SCALER logic (reference fp16/loss_scaler.py)."""
+    if fp16_config.loss_scale and fp16_config.loss_scale > 0:
+        return StaticLossScaler(scale=float(fp16_config.loss_scale))
+    return DynamicLossScaler(
+        init_scale=2.0 ** fp16_config.initial_scale_power,
+        scale_window=fp16_config.loss_scale_window,
+        min_scale=fp16_config.min_loss_scale,
+        delayed_shift=fp16_config.hysteresis,
+    )
+
+
+def has_inf_or_nan(tree) -> jax.Array:
+    """Global overflow flag for a grad pytree (CheckOverflow analog)."""
+    leaves = jax.tree_util.tree_leaves(tree)
+    flags = [jnp.logical_not(jnp.all(jnp.isfinite(x))) for x in leaves]
+    out = flags[0]
+    for f in flags[1:]:
+        out = jnp.logical_or(out, f)
+    return out
+
+
+def global_grad_norm(tree) -> jax.Array:
+    """L2 norm over a grad pytree in fp32 (runtime/utils.py get_global_norm)."""
+    leaves = jax.tree_util.tree_leaves(tree)
+    total = jnp.zeros((), jnp.float32)
+    for x in leaves:
+        total = total + jnp.sum(jnp.square(x.astype(jnp.float32)))
+    return jnp.sqrt(total)
+
+
+def clip_grads_by_global_norm(tree, max_norm: float, norm: jax.Array = None):
+    """clip_grad_norm_ analog (runtime/utils.py:975); returns (clipped, norm)."""
+    if norm is None:
+        norm = global_grad_norm(tree)
+    scale = jnp.minimum(1.0, max_norm / (norm + 1e-6))
+    return jax.tree_util.tree_map(lambda x: x * scale.astype(x.dtype), tree), norm

@@ -1,0 +1,24 @@
+from .config import (
+    DeepSpeedZeroConfig,
+    DeepSpeedZeroOffloadOptimizerConfig,
+    DeepSpeedZeroOffloadParamConfig,
+    ZeroStageEnum,
+)
+from .partition import PartitionPlan
+
+
+class Init:
+    """API-parity shim for ``deepspeed.zero.Init`` (reference
+    partition_parameters.py:601). In JAX, parameters are created already
+    sharded by jitting ``model.init`` with the plan's out_shardings (see
+    DeepSpeedEngine._init_state), so this context manager is a no-op provided
+    for source compatibility."""
+
+    def __init__(self, *args, **kwargs):
+        pass
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False

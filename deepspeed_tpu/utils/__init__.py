@@ -1,0 +1,14 @@
+from . import groups
+from .logging import log_dist, logger, print_rank_0
+from .timer import SynchronizedWallClockTimer, ThroughputTimer
+from .comms_logging import CommsLogger
+
+__all__ = [
+    "groups",
+    "log_dist",
+    "logger",
+    "print_rank_0",
+    "SynchronizedWallClockTimer",
+    "ThroughputTimer",
+    "CommsLogger",
+]

@@ -1,0 +1,123 @@
+"""Communication operation logging — analog of the reference's
+``deepspeed/utils/comms_logging.py`` (CommsLogger) and the ``timed_op``
+decorator in ``deepspeed/comm/comm.py:104``.
+
+Collectives on TPU execute inside compiled programs, so per-op wall-clock is
+only measurable for the eager (outside-jit) paths; for traced collectives the
+logger records op name, message size and axis at trace time and the summary
+reports counts/volumes (algbw/busbw are reported for timed ops only).
+"""
+
+from __future__ import annotations
+
+import math
+from collections import defaultdict
+from typing import Dict, List, Optional
+
+from .logging import log_dist
+
+
+def get_caller_func(frame_depth: int = 3) -> str:
+    import sys
+
+    frame = sys._getframe(frame_depth)
+    return frame.f_code.co_name
+
+
+def convert_size(size_bytes: int) -> str:
+    if size_bytes == 0:
+        return "0B"
+    names = ("B", "KB", "MB", "GB", "TB", "PB")
+    i = int(math.floor(math.log(size_bytes, 1024)))
+    p = math.pow(1024, i)
+    return f"{round(size_bytes / p, 2)} {names[i]}"
+
+
+def calc_bw_log(comm_op: str, size_bytes: int, duration_s: float, n: int):
+    """algbw/busbw formulae per collective (mirrors reference calc_bw_log)."""
+    duration_s = max(duration_s, 1e-12)
+    tput = size_bytes / duration_s
+    if comm_op in ("all_to_all",):
+        busbw = tput * ((n - 1) / max(n, 1))
+    elif comm_op in ("all_gather", "reduce_scatter"):
+        size_bytes = size_bytes * n
+        tput = size_bytes / duration_s
+        busbw = tput * ((n - 1) / max(n, 1))
+    elif comm_op in ("all_reduce",):
+        tput = size_bytes * 2 / duration_s
+        busbw = (size_bytes / duration_s) * (2 * (n - 1) / max(n, 1))
+    else:  # pt2pt / broadcast / barrier
+        busbw = tput
+    return tput / 1e9, busbw / 1e9, size_bytes
+
+
+class CommsLogger:
+    def __init__(self, enabled: bool = False, verbose: bool = False,
+                 prof_all: bool = True, prof_ops: Optional[List[str]] = None,
+                 debug: bool = False):
+        self.enabled = enabled
+        self.verbose = verbose
+        self.prof_all = prof_all
+        self.prof_ops = prof_ops or []
+        self.debug = debug
+        # op name -> msg size -> [count, total_time_s, [tputs], [busbws]]
+        self.comms_dict: Dict[str, Dict[int, list]] = defaultdict(dict)
+
+    def configure(self, config) -> None:
+        self.enabled = config.enabled
+        self.verbose = config.verbose
+        self.prof_all = config.prof_all
+        self.prof_ops = config.prof_ops
+        self.debug = config.debug
+
+    def should_profile(self, op_name: str) -> bool:
+        return self.enabled and (self.prof_all or op_name in self.prof_ops)
+
+    def append(self, raw_name: str, record_name: str, latency_s: float,
+               msg_size: int, world_size: int = 1) -> None:
+        algbw, busbw, msg_size = calc_bw_log(raw_name, msg_size, latency_s, world_size)
+        if record_name in self.comms_dict:
+            if msg_size in self.comms_dict[record_name]:
+                entry = self.comms_dict[record_name][msg_size]
+                entry[0] += 1
+                entry[1] += latency_s
+                entry[2].append(algbw)
+                entry[3].append(busbw)
+            else:
+                self.comms_dict[record_name][msg_size] = [1, latency_s, [algbw], [busbw]]
+        else:
+            self.comms_dict[record_name] = {msg_size: [1, latency_s, [algbw], [busbw]]}
+        if self.verbose:
+            log_dist(
+                f"comm op: {record_name} | time (ms): {latency_s * 1000:.2f} | "
+                f"msg size: {convert_size(msg_size)} | algbw (Gbps): {algbw * 8:.2f} | "
+                f"busbw (Gbps): {busbw * 8:.2f}", ranks=[0])
+
+    def record_traced(self, raw_name: str, record_name: str, msg_size: int) -> None:
+        """Trace-time record (no latency available inside jit)."""
+        if record_name in self.comms_dict and msg_size in self.comms_dict[record_name]:
+            self.comms_dict[record_name][msg_size][0] += 1
+        else:
+            self.comms_dict[record_name][msg_size] = [1, 0.0, [], []]
+
+    def log_all(self, print_log: bool = True, show_straggler: bool = False):
+        import numpy as np
+
+        lines = [f"{'Comm. Op': <20}{'Message Size': <20}{'Count': <20}"
+                 f"{'Total Latency(ms)': <20}{'Avg Latency(ms)': <20}"
+                 f"{'tput_avg (Gbps)': <20}{'busbw_avg (Gbps)': <20}"]
+        for record_name in self.comms_dict:
+            lines.append(record_name)
+            for msg_size, vals in sorted(self.comms_dict[record_name].items()):
+                count, total_lat, tputs, busbws = vals
+                avg_lat = total_lat / count * 1000 if count else 0.0
+                avg_algbw = 8 * float(np.mean(tputs)) if tputs else 0.0
+                avg_busbw = 8 * float(np.mean(busbws)) if busbws else 0.0
+                lines.append(
+                    f"{' ': <20}{convert_size(msg_size): <20}{count: <20}"
+                    f"{total_lat * 1000:<20.2f}{avg_lat:<20.2f}"
+                    f"{avg_algbw:<20.2f}{avg_busbw:<20.2f}")
+        out = "\n".join(lines)
+        if print_log:
+            log_dist("\n" + out, ranks=[0])
+        return out

@@ -1,0 +1,108 @@
+"""Global parallel-group state — analog of the reference's
+``deepspeed/utils/groups.py``.
+
+The reference materialises torch ProcessGroups per axis; here a "group" is a
+mesh axis name (str) usable directly in ``jax.lax`` collectives and
+``PartitionSpec``s. A module-level current topology plays the role of the
+reference's ``_WORLD_GROUP``/``_EXPERT_PARALLEL_GROUP`` dicts.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+from deepspeed_tpu.parallel.topology import (
+    BATCH_AXES,
+    DATA_AXIS,
+    EXPERT_AXIS,
+    MODEL_AXIS,
+    PIPE_AXIS,
+    SEQ_AXIS,
+    MeshTopology,
+    build_topology,
+)
+
+_TOPOLOGY: Optional[MeshTopology] = None
+
+
+def initialize(topology: Optional[MeshTopology] = None, *, ep_size: int = 1,
+               tp_size: int = 1, pp_size: int = 1, sp_size: int = 1) -> MeshTopology:
+    """Initialise the global topology (reference groups.initialize, :46)."""
+    global _TOPOLOGY
+    if topology is None:
+        topology = build_topology(tp=tp_size, pp=pp_size, ep=ep_size, sp=sp_size)
+    _TOPOLOGY = topology
+    return topology
+
+
+def is_initialized() -> bool:
+    return _TOPOLOGY is not None
+
+
+def get_topology() -> MeshTopology:
+    global _TOPOLOGY
+    if _TOPOLOGY is None:
+        _TOPOLOGY = build_topology()
+    return _TOPOLOGY
+
+
+def reset() -> None:
+    global _TOPOLOGY
+    _TOPOLOGY = None
+
+
+def get_mesh():
+    return get_topology().mesh
+
+
+# --- group accessors: return mesh axis names (usable as lax collective axes) ---
+def get_data_parallel_group() -> Tuple[str, ...]:
+    """Dense-batch axis: ('data','expert') — expert axis folds into DP for
+    non-expert params (reference _get_data_parallel_group, groups.py:319)."""
+    return BATCH_AXES
+
+
+def get_model_parallel_group() -> str:
+    return MODEL_AXIS
+
+
+def get_expert_parallel_group() -> str:
+    return EXPERT_AXIS
+
+
+def get_expert_data_parallel_group() -> Tuple[str, ...]:
+    """Axis over which *expert* parameters are data-parallel (grad averaged):
+    the plain data axis, since experts are sharded over 'expert'."""
+    return (DATA_AXIS,)
+
+
+def get_pipe_parallel_group() -> str:
+    return PIPE_AXIS
+
+
+def get_sequence_parallel_group() -> str:
+    return SEQ_AXIS
+
+
+def get_data_parallel_world_size() -> int:
+    return get_topology().data_parallel_size
+
+
+def get_model_parallel_world_size() -> int:
+    return get_topology().model_parallel_size
+
+
+def get_expert_parallel_world_size() -> int:
+    return get_topology().expert_parallel_size
+
+
+def get_pipe_parallel_world_size() -> int:
+    return get_topology().pipe_parallel_size
+
+
+def get_sequence_parallel_world_size() -> int:
+    return get_topology().sequence_parallel_size
+
+
+def get_world_size() -> int:
+    return get_topology().world_size

@@ -1,0 +1,71 @@
+"""Rank-filtered logging.
+
+TPU-native analog of the reference's ``deepspeed/utils/logging.py`` (log_dist /
+logger setup). In JAX the "rank" is ``jax.process_index()`` for multi-host and
+0 for single-process runs; device-level ranks do not exist as processes.
+"""
+
+from __future__ import annotations
+
+import functools
+import logging
+import os
+import sys
+
+LOG_LEVELS = {
+    "debug": logging.DEBUG,
+    "info": logging.INFO,
+    "warning": logging.WARNING,
+    "error": logging.ERROR,
+    "critical": logging.CRITICAL,
+}
+
+
+@functools.lru_cache(None)
+def _create_logger(name: str = "deepspeed_tpu", level=logging.INFO) -> logging.Logger:
+    lg = logging.getLogger(name)
+    lg.setLevel(level)
+    lg.propagate = False
+    formatter = logging.Formatter(
+        "[%(asctime)s] [%(levelname)s] [%(name)s:%(lineno)d] %(message)s"
+    )
+    handler = logging.StreamHandler(stream=sys.stdout)
+    handler.setLevel(level)
+    handler.setFormatter(formatter)
+    lg.addHandler(handler)
+    return lg
+
+
+logger = _create_logger(
+    "deepspeed_tpu", LOG_LEVELS.get(os.environ.get("DSTPU_LOG_LEVEL", "info"), logging.INFO)
+)
+
+
+def _process_index() -> int:
+    try:
+        import jax
+
+        return jax.process_index()
+    except Exception:  # jax not initialised yet / no backend
+        return int(os.environ.get("DSTPU_PROCESS_INDEX", 0))
+
+
+def log_dist(message: str, ranks=None, level=logging.INFO) -> None:
+    """Log only on the given process ranks (None or [-1] => all ranks).
+
+    Mirrors the contract of the reference ``log_dist`` (deepspeed/utils/logging.py).
+    """
+    my_rank = _process_index()
+    if ranks is None or -1 in ranks or my_rank in ranks:
+        logger.log(level, f"[Rank {my_rank}] {message}")
+
+
+def print_rank_0(message: str) -> None:
+    if _process_index() == 0:
+        print(message, flush=True)
+
+
+def warning_once(message: str, _seen=set()) -> None:
+    if message not in _seen:
+        _seen.add(message)
+        logger.warning(message)

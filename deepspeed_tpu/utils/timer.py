@@ -1,0 +1,182 @@
+"""Wall-clock timers and throughput accounting.
+
+TPU-native analog of the reference's ``deepspeed/utils/timer.py``
+(SynchronizedWallClockTimer / ThroughputTimer). CUDA-event timing has no
+equivalent on TPU: dispatch is async but ``jax.block_until_ready`` gives the
+device-complete boundary, so synchronized timers call it on request.
+"""
+
+from __future__ import annotations
+
+import time
+from collections import OrderedDict
+from typing import Dict, List, Optional
+
+from .logging import log_dist
+
+FORWARD_MICRO_TIMER = "fwd_microstep"
+FORWARD_GLOBAL_TIMER = "fwd"
+BACKWARD_MICRO_TIMER = "bwd_microstep"
+BACKWARD_GLOBAL_TIMER = "bwd"
+STEP_MICRO_TIMER = "step_microstep"
+STEP_GLOBAL_TIMER = "step"
+TRAIN_BATCH_TIMER = "train_batch"
+
+
+class _Timer:
+    def __init__(self, name: str):
+        self.name = name
+        self.started = False
+        self._start = 0.0
+        self._elapsed = 0.0
+        self._record: List[float] = []
+
+    def start(self):
+        assert not self.started, f"timer {self.name} already started"
+        self._start = time.perf_counter()
+        self.started = True
+
+    def stop(self, record: bool = False):
+        assert self.started, f"timer {self.name} not started"
+        dt = time.perf_counter() - self._start
+        self._elapsed += dt
+        self.started = False
+        if record:
+            self._record.append(dt)
+
+    def reset(self):
+        self.started = False
+        self._elapsed = 0.0
+
+    def elapsed(self, reset: bool = True) -> float:
+        started = self.started
+        if started:
+            self.stop()
+        out = self._elapsed
+        if reset:
+            self.reset()
+        if started:
+            self.start()
+        return out
+
+    def mean(self) -> float:
+        return sum(self._record) / max(len(self._record), 1)
+
+
+class SynchronizedWallClockTimer:
+    """Named timer registry; ``sync_fn`` (e.g. block_until_ready on engine state)
+    is invoked before reading when device-accurate numbers are requested."""
+
+    def __init__(self, sync_fn=None):
+        self.timers: "OrderedDict[str, _Timer]" = OrderedDict()
+        self.sync_fn = sync_fn
+
+    def __call__(self, name: str) -> _Timer:
+        if name not in self.timers:
+            self.timers[name] = _Timer(name)
+        return self.timers[name]
+
+    def has(self, name: str) -> bool:
+        return name in self.timers
+
+    @staticmethod
+    def memory_usage() -> str:
+        try:
+            import jax
+
+            stats = jax.local_devices()[0].memory_stats() or {}
+            in_use = stats.get("bytes_in_use", 0) / (1024**3)
+            peak = stats.get("peak_bytes_in_use", 0) / (1024**3)
+            return f"Device mem: in_use {in_use:.2f} GB | peak {peak:.2f} GB"
+        except Exception:
+            return "Device mem: unavailable"
+
+    def log(self, names: List[str], normalizer: float = 1.0, reset: bool = True,
+            memory_breakdown: bool = False, ranks: Optional[List[int]] = None):
+        assert normalizer > 0.0
+        if self.sync_fn is not None:
+            self.sync_fn()
+        string = "time (ms)"
+        for name in names:
+            if name in self.timers:
+                elapsed = self.timers[name].elapsed(reset=reset) * 1000.0 / normalizer
+                string += f" | {name}: {elapsed:.2f}"
+        if memory_breakdown:
+            string += " | " + self.memory_usage()
+        log_dist(string, ranks=ranks or [0])
+
+    def get_mean(self, names: List[str], normalizer: float = 1.0) -> Dict[str, float]:
+        return {n: self.timers[n].mean() * 1000.0 / normalizer for n in names if n in self.timers}
+
+
+class ThroughputTimer:
+    """Samples/sec + TFLOPs estimate, mirroring the reference ThroughputTimer
+    (deepspeed/utils/timer.py:137)."""
+
+    def __init__(self, batch_size: int, start_step: int = 2, steps_per_output: int = 50,
+                 monitor_memory: bool = False, logging_fn=None):
+        self.start_time = 0.0
+        self.end_time = 0.0
+        self.started = False
+        self.batch_size = max(batch_size, 1)
+        self.start_step = start_step
+        self.epoch_count = 0
+        self.micro_step_count = 0
+        self.global_step_count = 0
+        self.total_elapsed_time = 0.0
+        self.step_elapsed_time = 0.0
+        self.steps_per_output = steps_per_output
+        self.monitor_memory = monitor_memory
+        self.logging = logging_fn or (lambda msg: log_dist(msg, ranks=[0]))
+        self.initialized = False
+        self.flops_per_sample = None  # optionally set by the engine from model cost analysis
+
+    def update_epoch_count(self):
+        self.epoch_count += 1
+        self.micro_step_count = 0
+
+    def _init_timer(self):
+        self.initialized = True
+
+    def start(self):
+        self._init_timer()
+        self.started = True
+        if self.global_step_count >= self.start_step:
+            self.start_time = time.perf_counter()
+
+    def stop(self, global_step: bool = False, report_speed: bool = True):
+        if not self.started:
+            return
+        self.started = False
+        self.micro_step_count += 1
+        if global_step:
+            self.global_step_count += 1
+        if self.start_time > 0.0:
+            self.end_time = time.perf_counter()
+            duration = self.end_time - self.start_time
+            self.total_elapsed_time += duration
+            self.step_elapsed_time += duration
+            self.start_time = 0.0
+            if global_step and report_speed and \
+                    self.global_step_count % self.steps_per_output == 0:
+                msg = (f"epoch={self.epoch_count}/micro_step={self.micro_step_count}/"
+                       f"global_step={self.global_step_count}, "
+                       f"RunningAvgSamplesPerSec={self.avg_samples_per_sec():.3f}, "
+                       f"CurrSamplesPerSec={self.batch_size / self.step_elapsed_time:.3f}")
+                if self.flops_per_sample:
+                    tflops = self.flops_per_sample * self.batch_size / self.step_elapsed_time / 1e12
+                    msg += f", TFLOPs={tflops:.2f}"
+                self.logging(msg)
+                self.step_elapsed_time = 0.0
+
+    def avg_samples_per_sec(self) -> float:
+        if self.global_step_count > self.start_step and self.total_elapsed_time > 0:
+            samples = self.batch_size * (self.global_step_count - self.start_step)
+            return samples / self.total_elapsed_time
+        return float("nan")
+
+
+def trainable_parameters_numel(params) -> int:
+    import jax
+
+    return sum(x.size for x in jax.tree_util.tree_leaves(params))

@@ -1,0 +1,40 @@
+"""Test harness setup.
+
+Forces an 8-device CPU-emulated mesh (SURVEY.md §4: the
+``--xla_force_host_platform_device_count`` trick gives true multi-device unit
+tests without hardware — something the reference's NCCL-forked harness,
+tests/unit/common.py, could not do).
+"""
+
+import os
+
+# Must be set before the first jax backend initialisation.
+_flags = os.environ.get("XLA_FLAGS", "")
+if "host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = _flags + " --xla_force_host_platform_device_count=8"
+os.environ["DSTPU_ACCELERATOR"] = "cpu"
+
+import jax  # noqa: E402
+
+# The axon sitecustomize pins JAX_PLATFORMS=axon (one real TPU chip); tests
+# run on the virtual 8-device CPU backend instead.
+jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _reset_groups():
+    """Each test starts with fresh global topology state."""
+    from deepspeed_tpu.utils import groups
+
+    groups.reset()
+    yield
+    groups.reset()
+
+
+@pytest.fixture
+def topology8():
+    from deepspeed_tpu.parallel.topology import build_topology
+
+    return build_topology()

@@ -1,0 +1,55 @@
+import pytest
+
+from deepspeed_tpu.parallel.topology import ParallelDims, build_topology
+
+
+def test_default_topology_all_data():
+    topo = build_topology()
+    assert topo.world_size == 8
+    assert topo.data_parallel_size == 8
+    assert topo.get_dim("data") == 8
+
+
+def test_tp_dp_split():
+    topo = build_topology(tp=2)
+    assert topo.get_dim("model") == 2
+    assert topo.get_dim("data") == 4
+    assert topo.data_parallel_size == 4
+
+
+def test_3d_topology():
+    topo = build_topology(tp=2, pp=2)
+    assert topo.mesh_shape == (2, 2, 1, 1, 2)
+    assert topo.world_size == 8
+
+
+def test_expert_axis_folds_into_batch():
+    topo = build_topology(ep=4)
+    assert topo.get_dim("expert") == 4
+    assert topo.get_dim("data") == 2
+    assert topo.data_parallel_size == 8  # dense batch spans data*expert
+
+
+def test_invalid_dims_raise():
+    with pytest.raises(AssertionError):
+        build_topology(tp=3)  # 8 % 3 != 0
+
+
+def test_coord_roundtrip():
+    topo = build_topology(tp=2, pp=2)
+    for rank in range(topo.world_size):
+        coord = topo.get_coord(rank)
+        assert topo.get_rank(**coord._asdict()) == rank
+
+
+def test_axis_comm_lists():
+    topo = build_topology(tp=2)
+    lists = topo.get_axis_comm_lists("model")
+    assert len(lists) == 4
+    for group in lists:
+        assert len(group) == 2
+
+
+def test_rank_repr():
+    topo = build_topology(tp=2, pp=2)
+    assert "model" in topo.get_rank_repr(1) or "pipe" in topo.get_rank_repr(1)

@@ -1,0 +1,76 @@
+import pytest
+
+from deepspeed_tpu.runtime.config import DeepSpeedConfig, DeepSpeedConfigError
+
+
+def test_batch_triple_full():
+    c = DeepSpeedConfig({"train_batch_size": 32, "train_micro_batch_size_per_gpu": 2,
+                         "gradient_accumulation_steps": 2}, world_size=8)
+    assert c.train_batch_size == 32
+    assert c.data_parallel_size == 8
+
+
+def test_batch_triple_infer_gas():
+    c = DeepSpeedConfig({"train_batch_size": 32, "train_micro_batch_size_per_gpu": 2},
+                        world_size=8)
+    assert c.gradient_accumulation_steps == 2
+
+
+def test_batch_triple_infer_micro():
+    c = DeepSpeedConfig({"train_batch_size": 32, "gradient_accumulation_steps": 2},
+                        world_size=8)
+    assert c.train_micro_batch_size_per_gpu == 2
+
+
+def test_batch_triple_infer_total():
+    c = DeepSpeedConfig({"train_micro_batch_size_per_gpu": 2,
+                         "gradient_accumulation_steps": 2}, world_size=8)
+    assert c.train_batch_size == 32
+
+
+def test_batch_triple_missing_raises():
+    with pytest.raises(DeepSpeedConfigError):
+        DeepSpeedConfig({}, world_size=8)
+
+
+def test_batch_triple_inconsistent_raises():
+    with pytest.raises(AssertionError):
+        DeepSpeedConfig({"train_batch_size": 33, "train_micro_batch_size_per_gpu": 2,
+                         "gradient_accumulation_steps": 2}, world_size=8)
+
+
+def test_zero_config_parsing():
+    c = DeepSpeedConfig({
+        "train_batch_size": 8,
+        "zero_optimization": {
+            "stage": 3,
+            "offload_optimizer": {"device": "cpu"},
+            "stage3_param_persistence_threshold": 1000,
+        },
+    }, world_size=8)
+    assert c.zero_optimization_stage == 3
+    assert c.zero_config.offload_optimizer.device == "cpu"
+    assert c.zero_config.param_persistence_threshold == 1000
+
+
+def test_fp16_bf16_exclusive():
+    with pytest.raises(DeepSpeedConfigError):
+        DeepSpeedConfig({"train_batch_size": 8, "fp16": {"enabled": True},
+                         "bf16": {"enabled": True}}, world_size=8)
+
+
+def test_tp_reduces_dp():
+    c = DeepSpeedConfig({"train_batch_size": 8,
+                         "tensor_parallel": {"tp_size": 2}}, world_size=8)
+    assert c.data_parallel_size == 4
+
+
+def test_optimizer_scheduler_sections():
+    c = DeepSpeedConfig({
+        "train_batch_size": 8,
+        "optimizer": {"type": "AdamW", "params": {"lr": 3e-4, "betas": [0.9, 0.95]}},
+        "scheduler": {"type": "WarmupLR", "params": {"warmup_num_steps": 10}},
+    }, world_size=8)
+    assert c.optimizer_name == "adamw"
+    assert c.optimizer_params["lr"] == 3e-4
+    assert c.scheduler_name == "WarmupLR"

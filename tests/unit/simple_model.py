@@ -1,0 +1,73 @@
+"""Test model fixtures — analog of reference ``tests/unit/simple_model.py``
+(SimpleModel:18, random dataloaders :250-271)."""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@dataclasses.dataclass
+class SimpleModel:
+    """MLP regression model: hidden -> hidden x nlayers -> scalar loss (MSE)."""
+
+    hidden_dim: int = 16
+    nlayers: int = 2
+
+    def init(self, rng):
+        keys = jax.random.split(rng, self.nlayers + 1)
+        params = {
+            "layers": {
+                "w": jnp.stack([
+                    jax.random.normal(keys[i], (self.hidden_dim, self.hidden_dim)) * 0.1
+                    for i in range(self.nlayers)]),
+                "b": jnp.zeros((self.nlayers, self.hidden_dim)),
+            },
+            "head": jax.random.normal(keys[-1], (self.hidden_dim, 1)) * 0.1,
+        }
+        return params
+
+    def logical_axes(self):
+        return {
+            "layers": {"w": ("layer", "hidden", "mlp"), "b": ("layer", "mlp")},
+            "head": ("hidden", None),
+        }
+
+    def apply(self, params, batch, *, rngs=None, train=False):
+        x, y = batch["x"], batch["y"]
+
+        def body(h, lp):
+            h = jnp.tanh(h @ lp["w"].astype(h.dtype) + lp["b"].astype(h.dtype))
+            return h, None
+
+        h, _ = jax.lax.scan(body, x, params["layers"])
+        pred = h @ params["head"].astype(h.dtype)
+        loss = jnp.mean(jnp.square(pred[..., 0].astype(jnp.float32) -
+                                   y.astype(jnp.float32)))
+        return loss, {"loss": loss}
+
+
+def random_dataset(n=128, hidden_dim=16, seed=0):
+    rng = np.random.RandomState(seed)
+    x = rng.randn(n, hidden_dim).astype(np.float32)
+    w = rng.randn(hidden_dim)
+    y = (x @ w).astype(np.float32)
+    return [{"x": x[i], "y": y[i]} for i in range(n)]
+
+
+def random_batch(batch_size=8, hidden_dim=16, seed=0):
+    rng = np.random.RandomState(seed)
+    return {
+        "x": rng.randn(batch_size, hidden_dim).astype(np.float32),
+        "y": rng.randn(batch_size).astype(np.float32),
+    }
+
+
+def lm_batch(batch_size=8, seq_len=16, vocab=512, seed=0):
+    rng = np.random.RandomState(seed)
+    ids = rng.randint(0, vocab, size=(batch_size, seq_len + 1))
+    return {"input_ids": ids[:, :-1].astype(np.int32),
+            "labels": ids[:, 1:].astype(np.int32)}
