@@ -1,0 +1,196 @@
+"""Engine checkpoint save/load — analog of reference engine checkpoint logic
+(engine.py save_checkpoint:2792 / load_checkpoint:2487 / _save_zero_checkpoint
+:3136 / save_16bit_model:3213 / _zero3_consolidated_16bit_state_dict:3146)
+plus the universal-checkpoint property of ``deepspeed/checkpoint/`` for free.
+
+Layout under ``save_dir``:
+    latest                       — text file holding the newest tag
+    <tag>/state.npz              — global param/optimizer/scaler arrays (path-keyed)
+    <tag>/client_state.json      — counters, lr-scheduler state, user state
+Checkpoints carry *global* (unsharded) arrays keyed by parameter path, so a
+load under ANY mesh/ZeRO-stage re-sharding is just device_put with the new
+plan's shardings — dp/tp resize needs no conversion pass.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Dict, Optional
+
+import jax
+import numpy as np
+
+from deepspeed_tpu.runtime.checkpoint_engine.checkpoint_engine import (
+    NativeCheckpointEngine,
+    _flatten_state,
+    _unflatten_into,
+)
+from deepspeed_tpu.utils.logging import log_dist, logger
+
+
+def _tag_for(engine, tag: Optional[str]) -> str:
+    return tag if tag is not None else f"global_step{engine.global_steps}"
+
+
+def _validate_tag(engine, tag: str):
+    """Tag consistency across processes (reference _checkpoint_tag_validation
+    :2775): all hosts must agree on the tag or resume desyncs."""
+    mode = engine.config.checkpoint_config.tag_validation
+    if mode == "Ignore" or jax.process_count() == 1:
+        return
+    from jax.experimental import multihost_utils
+
+    try:
+        multihost_utils.assert_equal(np.frombuffer(
+            tag.encode().ljust(64)[:64], dtype=np.uint8), f"checkpoint tag mismatch: {tag}")
+    except Exception as e:
+        if mode == "Fail":
+            raise
+        logger.warning(f"checkpoint tag validation: {e}")
+
+
+def save_engine_checkpoint(engine, save_dir: str, tag: Optional[str] = None,
+                           client_state: Optional[dict] = None, save_latest: bool = True,
+                           checkpoint_engine=None):
+    tag = _tag_for(engine, tag)
+    _validate_tag(engine, tag)
+    ckpt_engine = checkpoint_engine or NativeCheckpointEngine()
+    ckpt_engine.create(tag)
+    path = os.path.join(save_dir, tag, "state.npz")
+    state = engine.state
+    state_dict = {
+        "params": state.params,
+        "opt_state": state.opt_state,
+        "scaler": state.scaler,
+        "__meta__": {"global_step": int(jax.device_get(state.global_step))},
+    }
+    ckpt_engine.save(state_dict, path)
+
+    cs = {
+        "global_steps": engine.global_steps,
+        "micro_steps": engine.micro_steps,
+        "skipped_steps": engine.skipped_steps,
+        "zero_stage": engine.zero_stage,
+        "dtype": str(engine.compute_dtype.__name__),
+        "lr_scheduler": engine.lr_scheduler.state_dict() if engine.lr_scheduler else None,
+        "client_state": client_state or {},
+        "mesh_shape": list(engine.topology.mesh_shape),
+    }
+    if jax.process_index() == 0:
+        with open(os.path.join(save_dir, tag, "client_state.json"), "w") as f:
+            json.dump(cs, f, indent=2)
+        if save_latest:
+            with open(os.path.join(save_dir, "latest"), "w") as f:
+                f.write(tag)
+    ckpt_engine.commit(tag)
+    log_dist(f"saved checkpoint {tag} to {save_dir}", ranks=[0])
+    return True
+
+
+def load_engine_checkpoint(engine, load_dir: str, tag: Optional[str] = None,
+                           load_optimizer_states: bool = True,
+                           load_lr_scheduler_states: bool = True,
+                           load_module_only: bool = False,
+                           checkpoint_engine=None):
+    if tag is None:
+        latest = os.path.join(load_dir, "latest")
+        if not os.path.exists(latest):
+            logger.warning(f"no 'latest' file under {load_dir}; nothing loaded")
+            return None, {}
+        with open(latest) as f:
+            tag = f.read().strip()
+    path = os.path.join(load_dir, tag, "state.npz")
+    ckpt_engine = checkpoint_engine or NativeCheckpointEngine()
+    loaded = ckpt_engine.load(path)
+
+    # universal-by-default: re-shard global arrays onto the *current* plan
+    from deepspeed_tpu.runtime.engine import TrainState
+
+    params, missing_p = _unflatten_into(engine.state.params, loaded.get("params", {}))
+    params = jax.device_put(params, engine.master_shardings)
+    if load_optimizer_states and not load_module_only and "opt_state" in loaded:
+        opt_state, _ = _unflatten_into(engine.state.opt_state, loaded["opt_state"],
+                                       strict=False)
+        opt_state = jax.device_put(opt_state, engine.opt_shardings)
+    else:
+        opt_state = engine.state.opt_state
+    if "scaler" in loaded and not load_module_only:
+        scaler, _ = _unflatten_into(engine.state.scaler, loaded["scaler"], strict=False)
+        scaler = jax.device_put(scaler, jax.tree_util.tree_map(
+            lambda _: engine._replicated, engine.state.scaler))
+    else:
+        scaler = engine.state.scaler
+
+    meta = loaded.get("__meta__", {})
+    gstep = int(meta.get("global_step", 0))
+    engine.state = TrainState(params=params, opt_state=opt_state, scaler=scaler,
+                              global_step=jax.device_put(
+                                  np.int32(gstep), engine._replicated))
+    # keep host-side counters in sync even if client_state.json is missing,
+    # so LR schedule / dropout folding resume from the right step
+    engine.global_steps = gstep
+
+    client_state = {}
+    cs_path = os.path.join(load_dir, tag, "client_state.json")
+    if os.path.exists(cs_path):
+        with open(cs_path) as f:
+            cs = json.load(f)
+        engine.global_steps = cs.get("global_steps", gstep)
+        engine.micro_steps = cs.get("micro_steps", 0)
+        engine.skipped_steps = cs.get("skipped_steps", 0)
+        if load_lr_scheduler_states and engine.lr_scheduler and cs.get("lr_scheduler"):
+            engine.lr_scheduler.load_state_dict(cs["lr_scheduler"])
+        client_state = cs.get("client_state", {})
+    log_dist(f"loaded checkpoint {tag} from {load_dir} (reshard onto "
+             f"{dict(zip(engine.topology.get_axis_names(), engine.topology.mesh_shape))})",
+             ranks=[0])
+    return os.path.join(load_dir, tag), client_state
+
+
+def save_16bit_model(engine, save_dir: str, save_filename: str = "model_weights.npz"):
+    """Consolidated 16-bit weights for serving (reference save_16bit_model:3213
+    + zero_to_fp32 analog: with global arrays, consolidation is device_get)."""
+    import ml_dtypes
+
+    os.makedirs(save_dir, exist_ok=True)
+    flat = _flatten_state(engine.state.params)
+    # npz round-trips bf16 as raw void — store as uint16 views, tagged "@bf16"
+    out = {}
+    for k, v in flat.items():
+        if v.dtype.kind == "f":
+            out[k + "@bf16"] = v.astype(ml_dtypes.bfloat16).view(np.uint16)
+        else:
+            out[k] = v
+    if not save_filename.endswith(".npz"):
+        save_filename += ".npz"  # np.savez appends it anyway; keep path truthful
+    if jax.process_index() == 0:
+        np.savez(os.path.join(save_dir, save_filename), **out)
+    return os.path.join(save_dir, save_filename)
+
+
+def load_16bit_model(path: str) -> Dict[str, np.ndarray]:
+    import ml_dtypes
+
+    data = np.load(path)
+    out = {}
+    for k in data.files:
+        if k.endswith("@bf16"):
+            out[k[:-5]] = data[k].view(ml_dtypes.bfloat16)
+        else:
+            out[k] = data[k]
+    return out
+
+
+def zero_to_fp32(checkpoint_dir: str, output_file: str, tag: Optional[str] = None):
+    """Offline reconstruction of full fp32 weights (reference
+    utils/zero_to_fp32.py). Native checkpoints already store global fp32
+    arrays, so this is a re-keying pass, runnable without any mesh."""
+    if tag is None:
+        with open(os.path.join(checkpoint_dir, "latest")) as f:
+            tag = f.read().strip()
+    loaded = NativeCheckpointEngine().load(os.path.join(checkpoint_dir, tag, "state.npz"))
+    params = loaded.get("params", {})
+    np.savez(output_file, **{k: v.astype(np.float32) if v.dtype.kind == "f" else v
+                             for k, v in params.items()})
+    return output_file

@@ -525,7 +525,7 @@ class DeepSpeedEngine:
                                       load_lr_scheduler_states=load_lr_scheduler_states,
                                       load_module_only=load_module_only)
 
-    def save_16bit_model(self, save_dir, save_filename="pytorch_model.bin"):
+    def save_16bit_model(self, save_dir, save_filename="model_weights.npz"):
         from deepspeed_tpu.runtime.checkpoint_engine.engine import save_16bit_model
 
         return save_16bit_model(self, save_dir, save_filename)
