@@ -1,0 +1,43 @@
+"""Rotary position embeddings.
+
+Reference counterpart: ``csrc/transformer/inference/csrc/apply_rotary_pos_emb.cu``
+(432 LoC CUDA). On TPU this is pure VPU elementwise work that XLA fuses into
+the surrounding projections, so the jnp form IS the fast path.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def rope_frequencies(head_dim: int, max_seq_len: int, theta: float = 10000.0):
+    """Precompute cos/sin tables [T, Dh/2] in fp32."""
+    inv_freq = 1.0 / (theta ** (jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim))
+    t = jnp.arange(max_seq_len, dtype=jnp.float32)
+    freqs = jnp.outer(t, inv_freq)  # [T, Dh/2]
+    return jnp.cos(freqs), jnp.sin(freqs)
+
+
+def apply_rotary_pos_emb(x: jax.Array, cos: jax.Array, sin: jax.Array,
+                         position_offset=0) -> jax.Array:
+    """x: [B, T, H, Dh]; cos/sin: [T_max, Dh/2] tables.
+
+    Pairs (x[2i], x[2i+1]) rotated by position angle — the interleaved GPT-NeoX
+    convention used by LLaMA.
+    """
+    b, t, h, dh = x.shape
+    if isinstance(position_offset, int) and position_offset == 0:
+        c = jax.lax.dynamic_slice_in_dim(cos, 0, t, axis=0)
+        s = jax.lax.dynamic_slice_in_dim(sin, 0, t, axis=0)
+    else:
+        c = jax.lax.dynamic_slice_in_dim(cos, position_offset, t, axis=0)
+        s = jax.lax.dynamic_slice_in_dim(sin, position_offset, t, axis=0)
+    c = c[None, :, None, :]  # [1, T, 1, Dh/2]
+    s = s[None, :, None, :]
+    x1 = x[..., 0::2].astype(jnp.float32)
+    x2 = x[..., 1::2].astype(jnp.float32)
+    o1 = x1 * c - x2 * s
+    o2 = x2 * c + x1 * s
+    out = jnp.stack([o1, o2], axis=-1).reshape(b, t, h, dh)
+    return out.astype(x.dtype)

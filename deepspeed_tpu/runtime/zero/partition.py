@@ -58,6 +58,7 @@ DEFAULT_LOGICAL_RULES: Dict[str, Optional[str]] = {
     "vocab": MODEL_AXIS,      # output head vocab dim is TP-sharded
     "hidden": None,
     "heads": MODEL_AXIS,      # attention heads / qkv fused dim
+    "kv_heads": MODEL_AXIS,   # GQA kv projection dim (must divide by tp)
     "kv": None,
     "mlp": MODEL_AXIS,        # ffn intermediate dim
     "expert": EXPERT_AXIS,    # leading expert dim of MoE params
