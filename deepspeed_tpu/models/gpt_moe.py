@@ -1,0 +1,151 @@
+"""GPT with MoE FFN layers — the BASELINE ladder's "GPT-MoE" config
+(reference analog: Megatron-DeepSpeed MoE models driven through
+``deepspeed.moe.layer.MoE``; test fixture analog SimpleMoEModel,
+reference tests/unit/simple_model.py:70).
+
+Interleaves dense and MoE transformer blocks (every other layer MoE, the
+standard GShard/DeepSpeed-MoE pattern). Blocks are unrolled (not scanned)
+because MoE and dense layers alternate structurally.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.models.base import cross_entropy_loss, gelu, layer_norm
+from deepspeed_tpu.moe.layer import MoE
+from deepspeed_tpu.ops.attention import multihead_attention
+
+
+@dataclasses.dataclass
+class GPTMoEConfig:
+    vocab_size: int = 50257
+    max_seq_len: int = 1024
+    num_layers: int = 12
+    hidden_size: int = 768
+    num_heads: int = 12
+    num_experts: int = 8
+    moe_every: int = 2          # every Nth layer is MoE
+    top_k: int = 1
+    capacity_factor: float = 1.25
+    aux_loss_weight: float = 0.01
+    use_residual: bool = False  # PR-MoE
+    eps: float = 1e-5
+
+    @property
+    def head_dim(self):
+        return self.hidden_size // self.num_heads
+
+    @classmethod
+    def tiny(cls, **kw):
+        kw.setdefault("vocab_size", 512)
+        kw.setdefault("max_seq_len", 128)
+        kw.setdefault("num_experts", 4)
+        return cls(num_layers=2, hidden_size=64, num_heads=4, **kw)
+
+
+class GPTMoEModel:
+    def __init__(self, config: GPTMoEConfig, compute_dtype=jnp.bfloat16):
+        self.config = config
+        self.compute_dtype = compute_dtype
+        c = config
+        self.moe_layers = [i for i in range(c.num_layers) if (i + 1) % c.moe_every == 0]
+        self.moe = MoE(c.hidden_size, c.num_experts, k=c.top_k,
+                       capacity_factor=c.capacity_factor,
+                       use_residual=c.use_residual)
+
+    def init(self, rng):
+        c = self.config
+        d = c.hidden_size
+        keys = jax.random.split(rng, 2 * c.num_layers + 3)
+        init = jax.nn.initializers.normal(0.02)
+        blocks = []
+        for i in range(c.num_layers):
+            k1, k2 = keys[2 * i], keys[2 * i + 1]
+            blk = {
+                "ln1_scale": jnp.ones((d,)), "ln1_bias": jnp.zeros((d,)),
+                "qkv_w": init(k1, (d, 3 * d), jnp.float32),
+                "qkv_b": jnp.zeros((3 * d,)),
+                "out_w": init(k2, (d, d), jnp.float32) / (2 * c.num_layers) ** 0.5,
+                "out_b": jnp.zeros((d,)),
+                "ln2_scale": jnp.ones((d,)), "ln2_bias": jnp.zeros((d,)),
+            }
+            if i in self.moe_layers:
+                blk["moe"] = self.moe.init(jax.random.fold_in(k2, 7))
+            else:
+                k3 = jax.random.fold_in(k1, 13)
+                blk["mlp_fc_w"] = init(k3, (d, 4 * d), jnp.float32)
+                blk["mlp_fc_b"] = jnp.zeros((4 * d,))
+                blk["mlp_out_w"] = init(jax.random.fold_in(k3, 1), (4 * d, d),
+                                        jnp.float32) / (2 * c.num_layers) ** 0.5
+                blk["mlp_out_b"] = jnp.zeros((d,))
+            blocks.append(blk)
+        return {
+            "wte": init(keys[-3], (c.vocab_size, d), jnp.float32),
+            "wpe": init(keys[-2], (c.max_seq_len, d), jnp.float32),
+            "blocks": blocks,
+            "ln_f_scale": jnp.ones((d,)), "ln_f_bias": jnp.zeros((d,)),
+        }
+
+    def logical_axes(self):
+        c = self.config
+        d_axes = {
+            "ln1_scale": ("hidden",), "ln1_bias": ("hidden",),
+            "qkv_w": ("hidden", "heads"), "qkv_b": ("heads",),
+            "out_w": ("heads", "hidden"), "out_b": ("hidden",),
+            "ln2_scale": ("hidden",), "ln2_bias": ("hidden",),
+        }
+        blocks = []
+        for i in range(c.num_layers):
+            blk = dict(d_axes)
+            if i in self.moe_layers:
+                blk["moe"] = self.moe.logical_axes()
+            else:
+                blk.update({"mlp_fc_w": ("hidden", "mlp"), "mlp_fc_b": ("mlp",),
+                            "mlp_out_w": ("mlp", "hidden"), "mlp_out_b": ("hidden",)})
+            blocks.append(blk)
+        return {"wte": ("vocab_in", "hidden"), "wpe": ("seq", "hidden"),
+                "blocks": blocks, "ln_f_scale": ("hidden",), "ln_f_bias": ("hidden",)}
+
+    def _attn(self, x, blk):
+        c = self.config
+        b, t, d = x.shape
+        y = layer_norm(x, blk["ln1_scale"], blk["ln1_bias"], c.eps)
+        qkv = y @ blk["qkv_w"].astype(y.dtype) + blk["qkv_b"].astype(y.dtype)
+        q, k_, v_ = jnp.split(qkv, 3, axis=-1)
+        shape = (b, t, c.num_heads, c.head_dim)
+        attn = multihead_attention(q.reshape(shape), k_.reshape(shape),
+                                   v_.reshape(shape), causal=True)
+        return x + attn.reshape(b, t, d) @ blk["out_w"].astype(x.dtype) + \
+            blk["out_b"].astype(x.dtype)
+
+    def apply(self, params, batch, *, rngs=None, train: bool = False):
+        c = self.config
+        ids = batch["input_ids"]
+        b, t = ids.shape
+        x = params["wte"].astype(self.compute_dtype)[ids]
+        x = x + params["wpe"].astype(self.compute_dtype)[:t][None]
+        rng = rngs.get("dropout") if isinstance(rngs, dict) else rngs
+        total_aux = jnp.zeros((), jnp.float32)
+        for i, blk in enumerate(params["blocks"]):
+            x = self._attn(x, blk)
+            y = layer_norm(x, blk["ln2_scale"], blk["ln2_bias"], c.eps)
+            if i in self.moe_layers:
+                sub = jax.random.fold_in(rng, i) if rng is not None else None
+                moe_out, l_aux, _ = self.moe.apply(blk["moe"], y, train=train, rng=sub)
+                x = x + moe_out
+                total_aux = total_aux + l_aux
+            else:
+                h = gelu(y @ blk["mlp_fc_w"].astype(y.dtype) +
+                         blk["mlp_fc_b"].astype(y.dtype))
+                x = x + h @ blk["mlp_out_w"].astype(x.dtype) + \
+                    blk["mlp_out_b"].astype(x.dtype)
+        x = layer_norm(x, params["ln_f_scale"], params["ln_f_bias"], c.eps)
+        logits = jnp.einsum("btd,vd->btv", x, params["wte"].astype(x.dtype))
+        ce, n = cross_entropy_loss(logits, batch["labels"])
+        loss = ce + c.aux_loss_weight * total_aux / max(len(self.moe_layers), 1)
+        return loss, {"loss": loss, "ce_loss": ce, "aux_loss": total_aux, "ntokens": n}

@@ -1,0 +1,2 @@
+from .layer import MoE, ExpertFFN, split_params_into_different_moe_groups_for_optimizer
+from .sharded_moe import TopKGate, top1gating, top2gating

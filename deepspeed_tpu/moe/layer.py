@@ -1,0 +1,150 @@
+"""MoE layer — analog of reference ``deepspeed/moe/layer.py`` (MoE:16) and
+``MOELayer.forward`` (sharded_moe.py:473).
+
+The reference pipeline per layer: gate → einsum dispatch → all_to_all →
+local experts → all_to_all → combine. Here the same einsums carry sharding
+constraints instead of manual collectives: tokens are sharded over the batch
+axes, the dispatched [E, C, M] tensor is constrained to shard E over the
+'expert' mesh axis, and XLA inserts the ICI all-to-alls (both directions)
+with overlap — SURVEY §2.2 row EP.
+
+Expert parameters carry a leading expert dim sharded over 'expert' (logical
+axis name "expert" → EXPERT_AXIS in the partition plan), which also gives the
+expert-data-parallel gradient averaging over the remaining 'data' axis for
+free (reference needs dedicated expert-data-parallel groups,
+utils/groups.py:202).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from deepspeed_tpu.moe.sharded_moe import TopKGate
+from deepspeed_tpu.parallel.topology import BATCH_AXES, EXPERT_AXIS
+
+
+def _constrain(x, *spec):
+    """Apply a sharding constraint when running under a mesh; no-op otherwise."""
+    try:
+        return jax.lax.with_sharding_constraint(x, P(*spec))
+    except (ValueError, RuntimeError):
+        return x
+
+
+@dataclasses.dataclass
+class ExpertFFN:
+    """The local expert stack: [E_local experts each a 2-layer FFN]."""
+
+    model_dim: int
+    ffn_dim: int
+    num_experts: int
+
+    def init(self, rng):
+        k1, k2 = jax.random.split(rng)
+        scale_in = self.model_dim ** -0.5
+        scale_out = self.ffn_dim ** -0.5
+        return {
+            "w1": jax.random.normal(k1, (self.num_experts, self.model_dim, self.ffn_dim),
+                                    jnp.float32) * scale_in,
+            "b1": jnp.zeros((self.num_experts, self.ffn_dim)),
+            "w2": jax.random.normal(k2, (self.num_experts, self.ffn_dim, self.model_dim),
+                                    jnp.float32) * scale_out,
+            "b2": jnp.zeros((self.num_experts, self.model_dim)),
+        }
+
+    @staticmethod
+    def logical_axes():
+        return {"w1": ("expert", "hidden", "mlp"), "b1": ("expert", "mlp"),
+                "w2": ("expert", "mlp", "hidden"), "b2": ("expert", "hidden")}
+
+    def apply(self, params, x):
+        """x: [E, C, M] dispatched tokens; per-expert FFN via batched einsum."""
+        h = jnp.einsum("ecm,emf->ecf", x, params["w1"].astype(x.dtype))
+        h = h + params["b1"].astype(x.dtype)[:, None, :]
+        h = jax.nn.gelu(h, approximate=True)
+        out = jnp.einsum("ecf,efm->ecm", h, params["w2"].astype(x.dtype))
+        return out + params["b2"].astype(x.dtype)[:, None, :]
+
+
+class MoE:
+    """Drop-in FFN replacement (reference MoE layer.py:16).
+
+    apply(params, x, train, rng) -> (out, l_aux, exp_counts); x: [B, T, M].
+    """
+
+    def __init__(self, hidden_size: int, num_experts: int, ffn_dim: Optional[int] = None,
+                 k: int = 1, capacity_factor: float = 1.25,
+                 eval_capacity_factor: float = 2.0, min_capacity: int = 4,
+                 noisy_gate_policy: Optional[str] = None, drop_tokens: bool = True,
+                 use_residual: bool = False):
+        self.hidden_size = hidden_size
+        self.num_experts = num_experts
+        self.ffn_dim = ffn_dim or 4 * hidden_size
+        self.gate = TopKGate(hidden_size, num_experts, k, capacity_factor,
+                             eval_capacity_factor, min_capacity, noisy_gate_policy,
+                             drop_tokens)
+        self.experts = ExpertFFN(hidden_size, self.ffn_dim, num_experts)
+        self.use_residual = use_residual  # PR-MoE residual expert (reference MoE)
+
+    def init(self, rng):
+        kg, ke, kr = jax.random.split(rng, 3)
+        params = {"gate": self.gate.init(kg), "experts": self.experts.init(ke)}
+        if self.use_residual:
+            res = ExpertFFN(self.hidden_size, self.ffn_dim, 1)
+            params["residual_mlp"] = res.init(kr)
+            params["coefficient"] = jnp.zeros((self.hidden_size, 2))
+        return params
+
+    def logical_axes(self):
+        axes = {"gate": {"wg": ("hidden", None)},
+                "experts": ExpertFFN.logical_axes()}
+        if self.use_residual:
+            # single residual expert: leading dim 1 stays replicated
+            axes["residual_mlp"] = {k: (None,) + v[1:]
+                                    for k, v in ExpertFFN.logical_axes().items()}
+            axes["coefficient"] = ("hidden", None)
+        return axes
+
+    def apply(self, params, x, *, train: bool = True, rng=None):
+        b, t, m = x.shape
+        tokens = x.reshape(b * t, m)
+        tokens = _constrain(tokens, BATCH_AXES, None)
+        l_aux, combine, dispatch, exp_counts = self.gate(
+            params["gate"], tokens, train=train, rng=rng)
+        # dispatch einsum: [S,M] x [S,E,C] -> [E,C,M]; resharding S-sharded →
+        # E-sharded is the all_to_all (XLA inserts it over the expert axis)
+        dispatched = jnp.einsum("sm,sec->ecm", tokens,
+                                dispatch.astype(tokens.dtype))
+        dispatched = _constrain(dispatched, EXPERT_AXIS, None, None)
+        expert_out = self.experts.apply(params["experts"], dispatched)
+        expert_out = _constrain(expert_out, EXPERT_AXIS, None, None)
+        out = jnp.einsum("ecm,sec->sm", expert_out, combine.astype(expert_out.dtype))
+        out = _constrain(out, BATCH_AXES, None)
+        out = out.reshape(b, t, m)
+        if self.use_residual:
+            res = ExpertFFN(self.hidden_size, self.ffn_dim, 1)
+            res_out = res.apply(params["residual_mlp"],
+                                x.reshape(1, b * t, m)).reshape(b, t, m)
+            coef = jax.nn.softmax(
+                x.astype(jnp.float32) @ params["coefficient"], axis=-1)
+            out = out * coef[..., 0:1].astype(out.dtype) + \
+                res_out * coef[..., 1:2].astype(out.dtype)
+        return out, l_aux, exp_counts
+
+
+def split_params_into_different_moe_groups_for_optimizer(params, moe_paths=("experts",)):
+    """Expert/non-expert param split (reference moe/utils.py:65) — returns
+    (dense_tree, expert_tree) masks usable for per-group optimizer settings."""
+    import jax
+
+    def is_expert(path):
+        return any(p in str(path) for p in moe_paths)
+
+    leaves, treedef = jax.tree_util.tree_flatten_with_path(params)
+    dense = [not is_expert(path) for path, _ in leaves]
+    return treedef, dense

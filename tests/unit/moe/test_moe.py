@@ -1,0 +1,121 @@
+import sys
+from pathlib import Path
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
+
+import deepspeed_tpu  # noqa: E402
+from deepspeed_tpu.models.gpt_moe import GPTMoEConfig, GPTMoEModel  # noqa: E402
+from deepspeed_tpu.moe.sharded_moe import top1gating, top2gating  # noqa: E402
+from deepspeed_tpu.parallel.topology import build_topology  # noqa: E402
+
+
+def test_top1_gating_shapes_and_capacity():
+    logits = jax.random.normal(jax.random.PRNGKey(0), (64, 4))
+    l_aux, combine, dispatch, counts = top1gating(logits, capacity_factor=1.0)
+    c = 16  # 64/4*1.0
+    assert combine.shape == (64, 4, c)
+    assert dispatch.shape == (64, 4, c)
+    assert counts.shape == (4,)
+    # no expert exceeds capacity
+    assert int(counts.max()) <= c
+    # every slot used at most once per (expert, position)
+    slot_usage = dispatch.astype(np.int32).sum(axis=0)
+    assert int(slot_usage.max()) <= 1
+
+
+def test_top1_aux_loss_balanced_vs_skewed():
+    balanced = jnp.zeros((64, 4))
+    l_bal, *_ = top1gating(balanced, capacity_factor=4.0)
+    skewed = jnp.tile(jnp.array([[10.0, 0, 0, 0]]), (64, 1))
+    l_skew, *_ = top1gating(skewed, capacity_factor=4.0)
+    assert float(l_skew) > float(l_bal)
+
+
+def test_top1_combine_weights_are_gate_probs():
+    logits = jax.random.normal(jax.random.PRNGKey(1), (8, 2))
+    _, combine, dispatch, _ = top1gating(logits, capacity_factor=8.0)
+    gates = jax.nn.softmax(logits, axis=-1)
+    per_token = combine.sum(axis=(1, 2))
+    expected = gates.max(axis=-1)  # top-1 prob (no drops at cf=8)
+    np.testing.assert_allclose(np.asarray(per_token), np.asarray(expected), rtol=1e-5)
+
+
+def test_top2_gating_two_experts_per_token():
+    logits = jax.random.normal(jax.random.PRNGKey(2), (32, 4))
+    l_aux, combine, dispatch, counts = top2gating(logits, capacity_factor=4.0)
+    per_token_experts = (dispatch.sum(axis=2) > 0).sum(axis=1)
+    assert int(per_token_experts.min()) >= 1
+    assert int(per_token_experts.max()) == 2
+    # renormalised weights sum to ~1
+    np.testing.assert_allclose(np.asarray(combine.sum(axis=(1, 2))),
+                               np.ones(32), rtol=1e-4)
+
+
+def test_capacity_drops_tokens():
+    # all tokens to expert 0, capacity 4 => only 4 dispatched
+    logits = jnp.tile(jnp.array([[10.0, 0.0]]), (16, 1))
+    _, combine, dispatch, counts = top1gating(logits, capacity_factor=0.5)
+    assert int(counts[0]) == 4
+    assert float(combine.sum()) < 16
+
+
+def moe_engine(ep=4, k=1, use_residual=False, steps=6):
+    from deepspeed_tpu.utils import groups
+
+    groups.reset()
+    topo = build_topology(ep=ep)
+    model = GPTMoEModel(GPTMoEConfig.tiny(top_k=k, use_residual=use_residual))
+    engine, *_ = deepspeed_tpu.initialize(model=model, topology=topo, config={
+        "train_batch_size": 16,
+        "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+        "steps_per_print": 0,
+    })
+    rng = np.random.RandomState(0)
+    losses = []
+    for _ in range(steps):
+        start = rng.randint(0, 512, size=(1, 16, 1))
+        st = rng.randint(1, 5, size=(1, 16, 1))
+        ids = (start + st * np.arange(33)) % 512
+        batch = {"input_ids": ids[:, :, :-1].astype(np.int32),
+                 "labels": ids[:, :, 1:].astype(np.int32)}
+        losses.append(float(jax.device_get(engine.train_batch_from_stacked(batch))))
+    return engine, losses
+
+
+def test_moe_model_trains_expert_parallel():
+    engine, losses = moe_engine(ep=4)
+    assert losses[-1] < losses[0]
+    # expert params sharded over the expert axis
+    moe_blk = engine.state.params["blocks"][1]["moe"]["experts"]["w1"]
+    assert "expert" in str(moe_blk.sharding.spec), moe_blk.sharding.spec
+
+
+def test_moe_top2_trains():
+    _, losses = moe_engine(ep=2, k=2)
+    assert losses[-1] < losses[0]
+
+
+def test_pr_moe_residual():
+    engine, losses = moe_engine(ep=4, use_residual=True, steps=4)
+    assert np.isfinite(losses).all()
+    assert "residual_mlp" in engine.state.params["blocks"][1]["moe"]
+
+
+def test_moe_ep_matches_no_ep_numerics():
+    _, l1 = moe_engine(ep=1, steps=3)
+    _, l4 = moe_engine(ep=4, steps=3)
+    np.testing.assert_allclose(l1, l4, rtol=2e-4)
+
+
+def test_expert_param_split_helper():
+    from deepspeed_tpu.moe import split_params_into_different_moe_groups_for_optimizer
+
+    model = GPTMoEModel(GPTMoEConfig.tiny())
+    params = jax.eval_shape(model.init, jax.random.PRNGKey(0))
+    treedef, dense_mask = split_params_into_different_moe_groups_for_optimizer(params)
+    assert any(dense_mask) and not all(dense_mask)
