@@ -1,0 +1,147 @@
+"""Per-layer building blocks for PipelineModule models.
+
+Analog of the reference's Megatron-style ``GPT2ModelPipe`` (built from
+LayerSpecs over EmbeddingPipe / ParallelTransformerLayerPipe / the tied lm
+head — the pattern PipelineModule was designed for, reference
+runtime/pipe/module.py:85). Math matches ``models/gpt2.py`` exactly so a
+pipelined run is numerically comparable to the fused scan model.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.models.base import cross_entropy_loss, gelu, layer_norm
+from deepspeed_tpu.models.gpt2 import GPT2Config
+from deepspeed_tpu.ops.attention import multihead_attention
+from deepspeed_tpu.runtime.pipe.module import LayerSpec, PipelineModule, TiedLayerSpec
+
+
+class GPT2EmbedLayer:
+    """Token + position embedding (first pipeline stage input layer)."""
+
+    def __init__(self, config: GPT2Config, compute_dtype=jnp.bfloat16):
+        self.config = config
+        self.compute_dtype = compute_dtype
+
+    def init(self, rng):
+        c = self.config
+        k1, k2 = jax.random.split(rng)
+        init = jax.nn.initializers.normal(0.02)
+        return {"wte": init(k1, (c.vocab_size, c.hidden_size), jnp.float32),
+                "wpe": init(k2, (c.max_seq_len, c.hidden_size), jnp.float32)}
+
+    def apply(self, params, input_ids, *, rngs=None, train: bool = False):
+        t = input_ids.shape[-1]
+        x = params["wte"].astype(self.compute_dtype)[input_ids]
+        return x + params["wpe"].astype(self.compute_dtype)[:t][None]
+
+
+def tied_lm_head(params, hidden):
+    """Tied-head forward_fn: project through the embedding table
+    (TiedLayerSpec re-use site; grads sum into the embed owner's params)."""
+    w = params["wte"].astype(hidden.dtype)
+    return jnp.einsum("btd,vd->btv", hidden, w)
+
+
+class GPT2BlockLayer:
+    """One transformer block — unstacked params (the pipeline engine stacks
+    homogeneous runs of these into [stages, layers_per_stage, ...])."""
+
+    def __init__(self, config: GPT2Config):
+        self.config = config
+
+    def init(self, rng):
+        c = self.config
+        d, m = c.hidden_size, c.mlp_dim
+        k = jax.random.split(rng, 4)
+        init = jax.nn.initializers.normal(0.02)
+        depth_scale = (2 * c.num_layers) ** 0.5
+        return {
+            "ln1_scale": jnp.ones((d,)), "ln1_bias": jnp.zeros((d,)),
+            "qkv_w": init(k[0], (d, 3 * d), jnp.float32),
+            "qkv_b": jnp.zeros((3 * d,)),
+            "attn_out_w": init(k[1], (d, d), jnp.float32) / depth_scale,
+            "attn_out_b": jnp.zeros((d,)),
+            "ln2_scale": jnp.ones((d,)), "ln2_bias": jnp.zeros((d,)),
+            "mlp_fc_w": init(k[2], (d, m), jnp.float32),
+            "mlp_fc_b": jnp.zeros((m,)),
+            "mlp_out_w": init(k[3], (m, d), jnp.float32) / depth_scale,
+            "mlp_out_b": jnp.zeros((d,)),
+        }
+
+    def apply(self, blk, x, *, rngs=None, train: bool = False):
+        c = self.config
+        b, t, d = x.shape
+        h, dh = c.num_heads, c.head_dim
+        y = layer_norm(x, blk["ln1_scale"], blk["ln1_bias"], c.eps)
+        qkv = jnp.einsum("btd,de->bte", y, blk["qkv_w"].astype(y.dtype)) + \
+            blk["qkv_b"].astype(y.dtype)
+        q, k_, v_ = jnp.split(qkv, 3, axis=-1)
+        attn = multihead_attention(
+            q.reshape(b, t, h, dh), k_.reshape(b, t, h, dh), v_.reshape(b, t, h, dh),
+            causal=True)
+        x = x + jnp.einsum("btd,de->bte", attn.reshape(b, t, d),
+                           blk["attn_out_w"].astype(x.dtype)) + \
+            blk["attn_out_b"].astype(x.dtype)
+        y = layer_norm(x, blk["ln2_scale"], blk["ln2_bias"], c.eps)
+        hmid = gelu(jnp.einsum("btd,dm->btm", y, blk["mlp_fc_w"].astype(y.dtype)) +
+                    blk["mlp_fc_b"].astype(y.dtype))
+        return x + jnp.einsum("btm,md->btd", hmid, blk["mlp_out_w"].astype(x.dtype)) + \
+            blk["mlp_out_b"].astype(x.dtype)
+
+
+class GPT2FinalNorm:
+    def __init__(self, config: GPT2Config):
+        self.config = config
+
+    def init(self, rng):
+        d = self.config.hidden_size
+        return {"scale": jnp.ones((d,)), "bias": jnp.zeros((d,))}
+
+    def apply(self, params, x, *, rngs=None, train: bool = False):
+        return layer_norm(x, params["scale"], params["bias"], self.config.eps)
+
+
+class GPT2LMHead:
+    """Untied output projection (when tie_embeddings=False)."""
+
+    def __init__(self, config: GPT2Config):
+        self.config = config
+
+    def init(self, rng):
+        c = self.config
+        return {"w": jax.nn.initializers.normal(0.02)(
+            rng, (c.hidden_size, c.vocab_size), jnp.float32)}
+
+    def apply(self, params, x, *, rngs=None, train: bool = False):
+        return jnp.einsum("btd,dv->btv", x, params["w"].astype(x.dtype))
+
+
+def lm_loss(logits, labels):
+    return cross_entropy_loss(logits, labels)[0]
+
+
+def gpt2_pipe(config: GPT2Config, num_stages: int = 2,
+              compute_dtype=jnp.bfloat16,
+              activation_checkpoint_interval: int = 0) -> PipelineModule:
+    """GPT-2 as a PipelineModule (GPT2ModelPipe analog)."""
+    layers = []
+    if config.tie_embeddings:
+        layers.append(TiedLayerSpec("embed", GPT2EmbedLayer, config, compute_dtype))
+    else:
+        layers.append(LayerSpec(GPT2EmbedLayer, config, compute_dtype))
+    layers += [LayerSpec(GPT2BlockLayer, config) for _ in range(config.num_layers)]
+    layers.append(LayerSpec(GPT2FinalNorm, config))
+    if config.tie_embeddings:
+        layers.append(TiedLayerSpec("embed", GPT2EmbedLayer, config, compute_dtype,
+                                    forward_fn=tied_lm_head))
+    else:
+        layers.append(LayerSpec(GPT2LMHead, config))
+    return PipelineModule(
+        layers, num_stages=num_stages, loss_fn=lm_loss,
+        activation_checkpoint_interval=activation_checkpoint_interval)

@@ -1,0 +1,9 @@
+from deepspeed_tpu.runtime.pipe.module import LayerSpec, PipelineModule, TiedLayerSpec
+from deepspeed_tpu.runtime.pipe.schedule import (
+    DataParallelSchedule,
+    InferenceSchedule,
+    TrainSchedule,
+)
+
+__all__ = ["LayerSpec", "PipelineModule", "TiedLayerSpec", "TrainSchedule",
+           "InferenceSchedule", "DataParallelSchedule"]

@@ -47,6 +47,7 @@ from deepspeed_tpu.parallel.topology import (
     DATA_AXIS,
     EXPERT_AXIS,
     MODEL_AXIS,
+    PIPE_AXIS,
     SEQ_AXIS,
     MeshTopology,
 )
@@ -62,6 +63,7 @@ DEFAULT_LOGICAL_RULES: Dict[str, Optional[str]] = {
     "kv": None,
     "mlp": MODEL_AXIS,        # ffn intermediate dim
     "expert": EXPERT_AXIS,    # leading expert dim of MoE params
+    "pipe_stage": PIPE_AXIS,  # leading stage dim of pipelined body params
     "seq": None,
     "norm": None,
 }

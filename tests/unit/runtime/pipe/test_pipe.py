@@ -1,0 +1,165 @@
+"""Pipeline-parallel engine tests — analog of reference
+tests/unit/runtime/pipe/test_pipe.py (which trains LinearStackPipe/AlexNetPipe
+and compares against non-pipelined runs)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import deepspeed_tpu
+from deepspeed_tpu.models.gpt2 import GPT2Config
+from deepspeed_tpu.models.pipeline_layers import gpt2_pipe
+from deepspeed_tpu.parallel.pipeline import spmd_pipeline, stack_stage_params
+from deepspeed_tpu.parallel.topology import build_topology
+from deepspeed_tpu.runtime.pipe.engine import PipelineEngine, PipelineError
+from deepspeed_tpu.utils import groups
+
+
+# --------------------------------------------------------- executor-level
+def _mk_linear_stages(rng, num_stages, dim):
+    keys = jax.random.split(rng, num_stages)
+    return [{"w": jax.random.normal(k, (dim, dim)) * 0.3, "b": jnp.zeros((dim,))}
+            for k in keys]
+
+
+def _stage_fn(p, x):
+    return jnp.tanh(x @ p["w"] + p["b"])
+
+
+def test_spmd_pipeline_matches_sequential():
+    S, M, B, D = 4, 6, 2, 8
+    groups.reset()
+    topo = build_topology(pp=S)
+    per_stage = _mk_linear_stages(jax.random.PRNGKey(0), S, D)
+    stacked = stack_stage_params(per_stage)
+    xs = jax.random.normal(jax.random.PRNGKey(1), (M, B, D))
+
+    out = jax.jit(lambda p, x: spmd_pipeline(
+        _stage_fn, p, x, mesh=topo.mesh, num_stages=S, num_microbatches=M))(stacked, xs)
+
+    expected = xs
+    for p in per_stage:
+        expected = jax.vmap(lambda x, p=p: _stage_fn(p, x))(expected)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(expected), rtol=1e-5)
+
+
+def test_spmd_pipeline_gradients_match_sequential():
+    S, M, B, D = 2, 4, 2, 8
+    groups.reset()
+    topo = build_topology(pp=S)
+    per_stage = _mk_linear_stages(jax.random.PRNGKey(2), S, D)
+    stacked = stack_stage_params(per_stage)
+    xs = jax.random.normal(jax.random.PRNGKey(3), (M, B, D))
+
+    def piped_loss(p):
+        out = spmd_pipeline(_stage_fn, p, xs, mesh=topo.mesh,
+                            num_stages=S, num_microbatches=M)
+        return jnp.sum(out ** 2)
+
+    def seq_loss(p):
+        out = xs
+        for s in range(S):
+            ps = jax.tree_util.tree_map(lambda leaf: leaf[s], p)
+            out = jax.vmap(lambda x: _stage_fn(ps, x))(out)
+        return jnp.sum(out ** 2)
+
+    g1 = jax.jit(jax.grad(piped_loss))(stacked)
+    g2 = jax.jit(jax.grad(seq_loss))(stacked)
+    for a, b in zip(jax.tree_util.tree_leaves(g1), jax.tree_util.tree_leaves(g2)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-5)
+
+
+# ----------------------------------------------------------- engine-level
+def lm_stream(gas, b=8, t=32, vocab=512, seed=0, n=3):
+    rng = np.random.RandomState(seed)
+    out = []
+    for _ in range(n):
+        start = rng.randint(0, vocab, size=(gas, b, 1))
+        step = rng.randint(1, 5, size=(gas, b, 1))
+        ids = (start + step * np.arange(t + 1)) % vocab
+        out.append({"input_ids": ids[:, :, :-1].astype(np.int32),
+                    "labels": ids[:, :, 1:].astype(np.int32)})
+    return out
+
+
+def run_pipe_training(pp, gas=4, steps=3, stage=0, tie=True, seed=0, num_layers=None):
+    groups.reset()
+    topo = build_topology(pp=pp)
+    if num_layers is None:
+        cfg = GPT2Config.tiny(tie_embeddings=tie)
+    else:
+        cfg = GPT2Config(vocab_size=512, max_seq_len=128, num_layers=num_layers,
+                         hidden_size=64, num_heads=4, tie_embeddings=tie)
+    module = gpt2_pipe(cfg, num_stages=pp)
+    engine, *_ = deepspeed_tpu.initialize(
+        model=module, topology=topo, config={
+            "train_batch_size": 8 * gas,
+            "train_micro_batch_size_per_gpu": 8 // topo.data_parallel_size,
+            "gradient_accumulation_steps": gas,
+            "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+            "zero_optimization": {"stage": stage},
+            "pipeline": {"stages": pp},
+            "steps_per_print": 0,
+        })
+    assert isinstance(engine, PipelineEngine)
+    losses = []
+    for batch in lm_stream(gas, seed=seed, n=steps):
+        losses.append(float(jax.device_get(engine.train_batch_from_stacked(batch))))
+    return engine, losses
+
+
+def test_pipeline_engine_trains():
+    engine, losses = run_pipe_training(pp=2)
+    assert losses[-1] < losses[0], losses
+
+
+def test_pipeline_matches_single_stage():
+    _, l1 = run_pipe_training(pp=1)
+    _, l2 = run_pipe_training(pp=2)
+    np.testing.assert_allclose(l1, l2, rtol=2e-4)
+
+
+def test_pipeline_four_stages_tied():
+    _, l1 = run_pipe_training(pp=1, tie=True, num_layers=4)
+    _, l4 = run_pipe_training(pp=4, tie=True, num_layers=4)
+    np.testing.assert_allclose(l1, l4, rtol=2e-4)
+
+
+def test_pipeline_with_zero1():
+    engine, losses = run_pipe_training(pp=2, stage=1)
+    assert losses[-1] < losses[0]
+    spec = str(jax.tree_util.tree_leaves(
+        jax.tree_util.tree_map(lambda x: x.sharding.spec,
+                               engine.state.params["body"]))[0])
+    assert "pipe" in spec, spec
+
+
+def test_pipeline_body_sharded_over_pipe_axis():
+    engine, _ = run_pipe_training(pp=2, steps=1)
+    for leaf in jax.tree_util.tree_leaves(engine.state.params["body"]):
+        assert "pipe" in str(leaf.sharding.spec), leaf.sharding.spec
+
+
+def test_forward_backward_disabled():
+    engine, _ = run_pipe_training(pp=2, steps=1)
+    with pytest.raises(PipelineError):
+        engine.forward(None)
+    with pytest.raises(PipelineError):
+        engine.backward(None)
+    with pytest.raises(PipelineError):
+        engine.step()
+
+
+def test_eval_batch():
+    engine, _ = run_pipe_training(pp=2, steps=1)
+    batch = lm_stream(1, n=1)[0]
+    loss = float(jax.device_get(engine.eval_batch(batch)))
+    assert np.isfinite(loss)
+
+
+def test_untied_head_trains():
+    engine, losses = run_pipe_training(pp=2, tie=False)
+    assert losses[-1] < losses[0]
+    assert "w" in engine.state.params["post"][
+        str(len(engine.pipeline_module.layers) - 1)]

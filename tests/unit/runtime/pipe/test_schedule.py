@@ -1,0 +1,90 @@
+"""Schedule semantics tests — analog of reference tests/unit/runtime/pipe/
+test_pipe_schedule.py, plus cross-validation of the SPMD executor's
+occupancy rule (stage s processes microbatch t-s at tick t)."""
+
+import pytest
+
+from deepspeed_tpu.runtime.pipe import schedule as sched
+
+
+def _cmds_of(s):
+    return list(s.steps())
+
+
+def test_inference_schedule_occupancy():
+    M, S = 4, 3
+    for stage in range(S):
+        s = sched.InferenceSchedule(micro_batches=M, stages=S, stage_id=stage)
+        fwd_ticks = []
+        for tick, cmds in enumerate(_cmds_of(s)):
+            fwds = [c for c in cmds if isinstance(c, sched.ForwardPass)]
+            if fwds:
+                fwd_ticks.append(tick)
+        # SPMD executor rule: stage s works on microbatch t - s
+        assert fwd_ticks == [stage + m for m in range(M)]
+
+
+def test_train_schedule_all_microbatches_covered():
+    M, S = 6, 4
+    for stage in range(S):
+        s = sched.TrainSchedule(micro_batches=M, stages=S, stage_id=stage)
+        fwd_bufs, bwd_bufs = [], []
+        for cmds in s.steps():
+            for c in cmds:
+                if isinstance(c, sched.ForwardPass):
+                    fwd_bufs.append(c.buffer_id)
+                elif isinstance(c, sched.BackwardPass):
+                    bwd_bufs.append(c.buffer_id)
+        assert len(fwd_bufs) == M, f"stage {stage}: {len(fwd_bufs)} forwards"
+        assert len(bwd_bufs) == M, f"stage {stage}: {len(bwd_bufs)} backwards"
+
+
+def test_train_schedule_fwd_before_bwd_per_buffer():
+    M, S = 4, 2
+    for stage in range(S):
+        s = sched.TrainSchedule(micro_batches=M, stages=S, stage_id=stage)
+        seen_fwd = set()
+        for cmds in s.steps():
+            for c in cmds:
+                if isinstance(c, sched.ForwardPass):
+                    seen_fwd.add(c.buffer_id)
+                elif isinstance(c, sched.BackwardPass):
+                    assert c.buffer_id in seen_fwd, \
+                        "backward before forward on a buffer"
+
+
+def test_train_schedule_tail_instructions():
+    s = sched.TrainSchedule(micro_batches=2, stages=2, stage_id=0)
+    steps = _cmds_of(s)
+    tail = steps[-1]
+    names = [c.name for c in tail]
+    assert "ReduceTiedGrads" in names and "ReduceGrads" in names \
+        and "OptimizerStep" in names
+    for cmds in steps[:-1]:
+        assert all(c.name != "OptimizerStep" for c in cmds)
+
+
+def test_train_schedule_buffer_counts():
+    # front stages need more in-flight buffers (reference schedule.py:248)
+    S = 4
+    counts = [sched.TrainSchedule(8, S, i).num_pipe_buffers() for i in range(S)]
+    assert counts == [4, 3, 2, 2]
+
+
+def test_sends_match_recvs_between_adjacent_stages():
+    M, S = 4, 3
+    streams = [list(sched.TrainSchedule(M, S, i).steps()) for i in range(S)]
+    for s in range(S - 1):
+        sends = sum(1 for cmds in streams[s] for c in cmds
+                    if isinstance(c, sched.SendActivation))
+        recvs = sum(1 for cmds in streams[s + 1] for c in cmds
+                    if isinstance(c, sched.RecvActivation))
+        assert sends == recvs == M
+
+
+def test_data_parallel_schedule():
+    s = sched.DataParallelSchedule(micro_batches=3, stages=1, stage_id=0)
+    steps = _cmds_of(s)
+    assert len(steps) == 3
+    assert any(isinstance(c, sched.OptimizerStep) for c in steps[-1])
+    assert s.num_pipe_buffers() == 1
