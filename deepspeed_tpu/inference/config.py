@@ -1,0 +1,89 @@
+"""Inference configuration — parity with reference
+``deepspeed/inference/config.py`` (DeepSpeedInferenceConfig:131-246).
+
+Fields kept with reference semantics: dtype, tensor_parallel.tp_size (:55),
+moe.ep_size (:71), max_out_tokens, min_out_tokens, checkpoint,
+replace_with_kernel_inject (:131), enable_cuda_graph (:151). TPU notes:
+``replace_with_kernel_inject``/``enable_cuda_graph`` are accepted for config
+compatibility but are no-ops — every decode step is a jit-compiled XLA
+program (the CUDA-graph equivalent), and kernel fusion is XLA/Pallas's job
+rather than module surgery's.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Union
+
+import jax.numpy as jnp
+from pydantic import Field
+
+from deepspeed_tpu.runtime.config_utils import DeepSpeedConfigModel
+
+_DTYPES = {
+    "fp32": jnp.float32, "float32": jnp.float32, "float": jnp.float32,
+    "fp16": jnp.float16, "float16": jnp.float16, "half": jnp.float16,
+    "bf16": jnp.bfloat16, "bfloat16": jnp.bfloat16,
+    "int8": jnp.int8,
+}
+
+
+class DeepSpeedTPConfig(DeepSpeedConfigModel):
+    """reference inference/config.py:50 DeepSpeedTPConfig."""
+
+    enabled: bool = True
+    tp_size: int = 1
+
+
+class DeepSpeedMoEConfig(DeepSpeedConfigModel):
+    """reference inference/config.py:64 DeepSpeedMoEConfig."""
+
+    enabled: bool = True
+    ep_size: int = 1
+    moe_experts: list = Field(default_factory=lambda: [1])
+
+
+class QuantizationConfig(DeepSpeedConfigModel):
+    enabled: bool = False
+    bits: int = 8
+    group_size: int = 64
+
+
+class DeepSpeedInferenceConfig(DeepSpeedConfigModel):
+    """reference inference/config.py:131 DeepSpeedInferenceConfig."""
+
+    dtype: Any = "bf16"                 # TPU-native default (reference: fp16)
+    tensor_parallel: DeepSpeedTPConfig = Field(
+        default_factory=DeepSpeedTPConfig, alias="tp")
+    moe: Union[bool, DeepSpeedMoEConfig] = Field(default_factory=DeepSpeedMoEConfig)
+    quant: QuantizationConfig = Field(default_factory=QuantizationConfig)
+    checkpoint: Optional[Union[str, Dict]] = None
+    max_tokens: int = Field(1024, alias="max_out_tokens")
+    min_out_tokens: int = Field(1, alias="min_tokens")
+    max_batch_size: int = 1
+    replace_with_kernel_inject: bool = False  # accepted; no-op on TPU
+    enable_cuda_graph: bool = False           # accepted; jit IS the graph
+    triangular_masking: bool = True
+    return_tuple: bool = True
+    set_empty_params: bool = False
+    seed: int = 0
+
+    # convenience used by the engine
+    def jax_dtype(self):
+        d = self.dtype
+        if isinstance(d, str):
+            key = d.lower().replace("torch.", "")
+            if key not in _DTYPES:
+                raise ValueError(f"unknown inference dtype {d!r}; "
+                                 f"one of {sorted(_DTYPES)}")
+            return _DTYPES[key]
+        return d
+
+    @property
+    def tp_size(self) -> int:
+        return self.tensor_parallel.tp_size
+
+    @property
+    def ep_size(self) -> int:
+        if isinstance(self.moe, bool):
+            return 1
+        return self.moe.ep_size

@@ -1,0 +1,164 @@
+"""Per-architecture HF weight-mapping policies — the module_inject analog.
+
+The reference surgically replaces HF nn.Modules with CUDA-kernel containers
+(``deepspeed/module_inject/replace_module.py:279 replace_transformer_layer``,
+per-arch policies in ``module_inject/containers/``). On TPU the model
+implementations are this framework's own JAX models, so "injection" becomes a
+one-time weight conversion: torch state_dict → params pytree. The policy
+registry keyed by HF architecture class name mirrors the reference's
+``replace_policies`` list (module_inject/replace_policy.py).
+
+Conventions handled:
+  * HF GPT-2 uses Conv1D ([in, out] weights) — matches our [d_in, d_out]
+    einsum layout directly.
+  * HF LLaMA Linear stores [out, in] — transposed on load.
+  * HF LLaMA RoPE uses the half-split ("rotate_half") convention; our rotary
+    op (ops/rotary.py) is interleaved (GPT-NeoX). q/k projection columns are
+    permuted per-head on load so the two are numerically identical.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Tuple
+
+import numpy as np
+
+_POLICIES: Dict[str, Callable] = {}
+
+
+def register_policy(arch: str):
+    def deco(fn):
+        _POLICIES[arch] = fn
+        return fn
+    return deco
+
+
+def convert_hf_model(hf_model, compute_dtype=None) -> Tuple[Any, Any]:
+    """HF torch model → (ModelSpec, params). Raises for unknown archs,
+    listing supported ones (reference raises when no policy matches)."""
+    arch = type(hf_model).__name__
+    if arch not in _POLICIES:
+        raise ValueError(
+            f"no inference policy for HF architecture {arch!r}; "
+            f"supported: {sorted(_POLICIES)}")
+    import jax.numpy as jnp
+
+    dtype = compute_dtype or jnp.bfloat16
+    return _POLICIES[arch](hf_model, dtype)
+
+
+def _np(t) -> np.ndarray:
+    return t.detach().cpu().float().numpy()
+
+
+def _interleave_rope_columns(w: np.ndarray, num_heads: int) -> np.ndarray:
+    """Permute projection output columns from HF half-split RoPE layout to
+    interleaved: per head, column order [0, dh/2, 1, dh/2+1, ...]."""
+    d_in, d_out = w.shape
+    dh = d_out // num_heads
+    perm = np.empty(dh, dtype=np.int64)
+    perm[0::2] = np.arange(dh // 2)
+    perm[1::2] = np.arange(dh // 2) + dh // 2
+    w = w.reshape(d_in, num_heads, dh)[:, :, perm]
+    return w.reshape(d_in, d_out)
+
+
+@register_policy("GPT2LMHeadModel")
+def gpt2_policy(hf_model, dtype):
+    """HF GPT2LMHeadModel → GPT2Model (reference containers/gpt2.py GPT2
+    policy + HFGPT2LayerPolicy)."""
+    import jax.numpy as jnp
+
+    from deepspeed_tpu.models.gpt2 import GPT2Config, GPT2Model
+
+    hf_cfg = hf_model.config
+    cfg = GPT2Config(
+        vocab_size=hf_cfg.vocab_size, max_seq_len=hf_cfg.n_positions,
+        num_layers=hf_cfg.n_layer, hidden_size=hf_cfg.n_embd,
+        num_heads=hf_cfg.n_head, eps=hf_cfg.layer_norm_epsilon,
+        tie_embeddings=True)
+    model = GPT2Model(cfg, compute_dtype=dtype)
+    sd = hf_model.state_dict()
+
+    def stack(fmt, post=lambda x: x):
+        return jnp.asarray(np.stack([post(_np(sd[fmt.format(i=i)]))
+                                     for i in range(cfg.num_layers)]))
+
+    params = {
+        "wte": jnp.asarray(_np(sd["transformer.wte.weight"])),
+        "wpe": jnp.asarray(_np(sd["transformer.wpe.weight"])),
+        "blocks": {
+            "ln1_scale": stack("transformer.h.{i}.ln_1.weight"),
+            "ln1_bias": stack("transformer.h.{i}.ln_1.bias"),
+            "qkv_w": stack("transformer.h.{i}.attn.c_attn.weight"),   # Conv1D [in,out]
+            "qkv_b": stack("transformer.h.{i}.attn.c_attn.bias"),
+            "attn_out_w": stack("transformer.h.{i}.attn.c_proj.weight"),
+            "attn_out_b": stack("transformer.h.{i}.attn.c_proj.bias"),
+            "ln2_scale": stack("transformer.h.{i}.ln_2.weight"),
+            "ln2_bias": stack("transformer.h.{i}.ln_2.bias"),
+            "mlp_fc_w": stack("transformer.h.{i}.mlp.c_fc.weight"),
+            "mlp_fc_b": stack("transformer.h.{i}.mlp.c_fc.bias"),
+            "mlp_out_w": stack("transformer.h.{i}.mlp.c_proj.weight"),
+            "mlp_out_b": stack("transformer.h.{i}.mlp.c_proj.bias"),
+        },
+        "ln_f_scale": jnp.asarray(_np(sd["transformer.ln_f.weight"])),
+        "ln_f_bias": jnp.asarray(_np(sd["transformer.ln_f.bias"])),
+    }
+    return model, params
+
+
+@register_policy("LlamaForCausalLM")
+def llama_policy(hf_model, dtype):
+    """HF LlamaForCausalLM → LlamaModel. The reference snapshot has no LLaMA
+    container — serving went through AutoTP (module_inject/auto_tp.py:84);
+    here LLaMA serving is first-class."""
+    import jax.numpy as jnp
+
+    from deepspeed_tpu.models.llama import LlamaConfig, LlamaModel
+
+    hf_cfg = hf_model.config
+    cfg = LlamaConfig(
+        vocab_size=hf_cfg.vocab_size,
+        max_seq_len=hf_cfg.max_position_embeddings,
+        num_layers=hf_cfg.num_hidden_layers,
+        hidden_size=hf_cfg.hidden_size,
+        num_heads=hf_cfg.num_attention_heads,
+        num_kv_heads=getattr(hf_cfg, "num_key_value_heads",
+                             hf_cfg.num_attention_heads),
+        intermediate_size=hf_cfg.intermediate_size,
+        rope_theta=getattr(hf_cfg, "rope_theta", 10000.0),
+        eps=hf_cfg.rms_norm_eps)
+    model = LlamaModel(cfg, compute_dtype=dtype)
+    sd = hf_model.state_dict()
+
+    def stack(fmt, post=lambda x: x):
+        return jnp.asarray(np.stack([post(_np(sd[fmt.format(i=i)]))
+                                     for i in range(cfg.num_layers)]))
+
+    def lin(x):          # HF Linear [out, in] → [in, out]
+        return x.T
+
+    def rope_q(x):
+        return _interleave_rope_columns(lin(x), cfg.num_heads)
+
+    def rope_k(x):
+        return _interleave_rope_columns(lin(x), cfg.num_kv_heads)
+
+    params = {
+        "embed": jnp.asarray(_np(sd["model.embed_tokens.weight"])),
+        "blocks": {
+            "attn_norm": stack("model.layers.{i}.input_layernorm.weight"),
+            "wq": stack("model.layers.{i}.self_attn.q_proj.weight", rope_q),
+            "wk": stack("model.layers.{i}.self_attn.k_proj.weight", rope_k),
+            "wv": stack("model.layers.{i}.self_attn.v_proj.weight", lin),
+            "wo": stack("model.layers.{i}.self_attn.o_proj.weight", lin),
+            "mlp_norm": stack("model.layers.{i}.post_attention_layernorm.weight"),
+            "w_gate": stack("model.layers.{i}.mlp.gate_proj.weight", lin),
+            "w_up": stack("model.layers.{i}.mlp.up_proj.weight", lin),
+            "w_down": stack("model.layers.{i}.mlp.down_proj.weight", lin),
+        },
+        "final_norm": jnp.asarray(_np(sd["model.norm.weight"])),
+        "lm_head": jnp.asarray(
+            _np(sd.get("lm_head.weight", sd["model.embed_tokens.weight"])).T),
+    }
+    return model, params

@@ -21,7 +21,7 @@ import jax
 import jax.numpy as jnp
 
 from deepspeed_tpu.models.base import cross_entropy_loss, gelu, layer_norm
-from deepspeed_tpu.ops.attention import multihead_attention
+from deepspeed_tpu.ops.attention import attention_with_kv_cache, multihead_attention
 
 
 @dataclasses.dataclass
@@ -125,7 +125,10 @@ class GPT2Model:
         return axes
 
     # ------------------------------------------------------------------ layers
-    def _block(self, x, blk, rng, train: bool):
+    def _block_impl(self, x, blk, rng, train: bool, cache):
+        """One transformer block; with ``cache=(kc, vc, idx)`` the attention
+        runs against the KV cache (one shared implementation so training and
+        serving can never diverge numerically)."""
         c = self.config
         b, t, d = x.shape
         h, dh = c.num_heads, c.head_dim
@@ -136,12 +139,17 @@ class GPT2Model:
         q = q.reshape(b, t, h, dh)
         k_ = k_.reshape(b, t, h, dh)
         v_ = v_.reshape(b, t, h, dh)
-        drop_rng = None
-        if train and c.dropout > 0.0 and rng is not None:
-            rng, drop_rng = jax.random.split(rng)
-        attn = multihead_attention(q, k_, v_, causal=True,
-                                   dropout_rate=c.dropout if train else 0.0,
-                                   dropout_rng=drop_rng)
+        if cache is None:
+            drop_rng = None
+            if train and c.dropout > 0.0 and rng is not None:
+                rng, drop_rng = jax.random.split(rng)
+            attn = multihead_attention(q, k_, v_, causal=True,
+                                       dropout_rate=c.dropout if train else 0.0,
+                                       dropout_rng=drop_rng)
+            kc = vc = None
+        else:
+            kc, vc, idx = cache
+            attn, kc, vc = attention_with_kv_cache(q, k_, v_, kc, vc, idx)
         attn = attn.reshape(b, t, d)
         x = x + jnp.einsum("btd,de->bte", attn, blk["attn_out_w"].astype(x.dtype)) + \
             blk["attn_out_b"].astype(x.dtype)
@@ -150,7 +158,10 @@ class GPT2Model:
                     blk["mlp_fc_b"].astype(y.dtype))
         x = x + jnp.einsum("btm,md->btd", hmid, blk["mlp_out_w"].astype(x.dtype)) + \
             blk["mlp_out_b"].astype(x.dtype)
-        return x
+        return x, kc, vc
+
+    def _block(self, x, blk, rng, train: bool):
+        return self._block_impl(x, blk, rng, train, None)[0]
 
     def forward_hidden(self, params, input_ids, *, rngs=None, train: bool = False):
         c = self.config
@@ -189,6 +200,40 @@ class GPT2Model:
         logits = self.logits(params, hidden)
         loss, n = cross_entropy_loss(logits, batch["labels"])
         return loss, {"loss": loss, "ntokens": n}
+
+    # --------------------------------------------------------- inference path
+    def init_cache(self, batch_size: int, max_len: int, dtype=None):
+        """Static-shape KV cache (the inference_context.h workspace analog —
+        reference csrc/transformer/inference/includes/inference_context.h)."""
+        c = self.config
+        dtype = dtype or self.compute_dtype
+        shape = (c.num_layers, batch_size, max_len, c.num_heads, c.head_dim)
+        return {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype),
+                "index": jnp.zeros((), jnp.int32)}
+
+    def _block_cached(self, x, blk, kc, vc, idx):
+        return self._block_impl(x, blk, None, False, (kc, vc, idx))
+
+    def forward_with_cache(self, params, input_ids, cache):
+        """Prefill (T>1) or decode (T=1) step against the KV cache.
+        Returns (logits [B,T,V], new_cache)."""
+        c = self.config
+        b, t = input_ids.shape
+        idx = cache["index"]
+        x = params["wte"].astype(self.compute_dtype)[input_ids]
+        pos = idx + jnp.arange(t)
+        x = x + params["wpe"].astype(self.compute_dtype)[pos][None]
+
+        def scan_body(x, layer_in):
+            blk, kc, vc = layer_in
+            x, kc, vc = self._block_cached(x, blk, kc, vc, idx)
+            return x, (kc, vc)
+
+        x, (k_new, v_new) = jax.lax.scan(
+            scan_body, x, (params["blocks"], cache["k"], cache["v"]))
+        hidden = layer_norm(x, params["ln_f_scale"], params["ln_f_bias"], c.eps)
+        logits = self.logits(params, hidden)
+        return logits, {"k": k_new, "v": v_new, "index": idx + t}
 
     # ------------------------------------------------------------------- cost
     def flops_per_token(self) -> float:

@@ -15,7 +15,7 @@ import jax
 import jax.numpy as jnp
 
 from deepspeed_tpu.models.base import cross_entropy_loss, rms_norm
-from deepspeed_tpu.ops.attention import multihead_attention
+from deepspeed_tpu.ops.attention import attention_with_kv_cache, multihead_attention
 from deepspeed_tpu.ops.rotary import apply_rotary_pos_emb, rope_frequencies
 
 
@@ -112,28 +112,39 @@ class LlamaModel:
             "lm_head": ("hidden", "vocab"),
         }
 
-    def _block(self, x, blk, cos, sin, train: bool):
+    def _block_impl(self, x, blk, cos, sin, train: bool, cache):
+        """One LLaMA block; with ``cache=(kc, vc, idx)`` attention runs against
+        the GQA KV cache (shared implementation for train + serving)."""
         c = self.config
         b, t, d = x.shape
         hq, hkv, dh = c.num_heads, c.num_kv_heads, c.head_dim
+        idx = cache[2] if cache is not None else 0
         y = rms_norm(x, blk["attn_norm"], c.eps)
         q = jnp.einsum("btd,de->bte", y, blk["wq"].astype(y.dtype)).reshape(b, t, hq, dh)
         k_ = jnp.einsum("btd,de->bte", y, blk["wk"].astype(y.dtype)).reshape(b, t, hkv, dh)
         v_ = jnp.einsum("btd,de->bte", y, blk["wv"].astype(y.dtype)).reshape(b, t, hkv, dh)
-        q = apply_rotary_pos_emb(q, cos, sin)
-        k_ = apply_rotary_pos_emb(k_, cos, sin)
-        if hkv != hq:  # GQA: repeat kv heads
-            rep = hq // hkv
-            k_ = jnp.repeat(k_, rep, axis=2)
-            v_ = jnp.repeat(v_, rep, axis=2)
-        attn = multihead_attention(q, k_, v_, causal=True)
+        q = apply_rotary_pos_emb(q, cos, sin, position_offset=idx)
+        k_ = apply_rotary_pos_emb(k_, cos, sin, position_offset=idx)
+        if cache is None:
+            if hkv != hq:  # GQA: repeat kv heads
+                rep = hq // hkv
+                k_ = jnp.repeat(k_, rep, axis=2)
+                v_ = jnp.repeat(v_, rep, axis=2)
+            attn = multihead_attention(q, k_, v_, causal=True)
+            kc = vc = None
+        else:
+            kc, vc, idx = cache
+            attn, kc, vc = attention_with_kv_cache(q, k_, v_, kc, vc, idx)
         x = x + jnp.einsum("bte,ed->btd", attn.reshape(b, t, hq * dh),
                            blk["wo"].astype(x.dtype))
         y = rms_norm(x, blk["mlp_norm"], c.eps)
         gate = jax.nn.silu(jnp.einsum("btd,dm->btm", y, blk["w_gate"].astype(y.dtype)))
         up = jnp.einsum("btd,dm->btm", y, blk["w_up"].astype(y.dtype))
         x = x + jnp.einsum("btm,md->btd", gate * up, blk["w_down"].astype(x.dtype))
-        return x
+        return x, kc, vc
+
+    def _block(self, x, blk, cos, sin, train: bool):
+        return self._block_impl(x, blk, cos, sin, train, None)[0]
 
     def forward_hidden(self, params, input_ids, *, rngs=None, train: bool = False):
         c = self.config
@@ -162,6 +173,38 @@ class LlamaModel:
         logits = self.logits(params, hidden)
         loss, n = cross_entropy_loss(logits, batch["labels"])
         return loss, {"loss": loss, "ntokens": n}
+
+    # --------------------------------------------------------- inference path
+    def init_cache(self, batch_size: int, max_len: int, dtype=None):
+        """Static-shape GQA KV cache — stores num_kv_heads only (the grouped
+        query repeat happens inside attention_with_kv_cache)."""
+        c = self.config
+        dtype = dtype or self.compute_dtype
+        shape = (c.num_layers, batch_size, max_len, c.num_kv_heads, c.head_dim)
+        return {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype),
+                "index": jnp.zeros((), jnp.int32)}
+
+    def _block_cached(self, x, blk, kc, vc, idx, cos, sin):
+        return self._block_impl(x, blk, cos, sin, False, (kc, vc, idx))
+
+    def forward_with_cache(self, params, input_ids, cache):
+        """Prefill (T>1) or decode (T=1) against the KV cache."""
+        c = self.config
+        b, t = input_ids.shape
+        idx = cache["index"]
+        x = params["embed"].astype(self.compute_dtype)[input_ids]
+        cos, sin = rope_frequencies(c.head_dim, c.max_seq_len, c.rope_theta)
+
+        def scan_body(x, layer_in):
+            blk, kc, vc = layer_in
+            x, kc, vc = self._block_cached(x, blk, kc, vc, idx, cos, sin)
+            return x, (kc, vc)
+
+        x, (k_new, v_new) = jax.lax.scan(
+            scan_body, x, (params["blocks"], cache["k"], cache["v"]))
+        hidden = rms_norm(x, params["final_norm"], c.eps)
+        logits = self.logits(params, hidden)
+        return logits, {"k": k_new, "v": v_new, "index": idx + t}
 
     def flops_per_token(self) -> float:
         c = self.config

@@ -69,17 +69,21 @@ def attention_with_kv_cache(
     Returns (out, k_cache, v_cache) with the new tokens written at
     ``cache_index``.
     """
-    b, t, h, dh = q.shape
+    b, t, hq, dh = q.shape
+    hkv = k_cache.shape[2]
     s_max = k_cache.shape[1]
     k_cache = jax.lax.dynamic_update_slice(k_cache, k_new.astype(k_cache.dtype), (0, cache_index, 0, 0))
     v_cache = jax.lax.dynamic_update_slice(v_cache, v_new.astype(v_cache.dtype), (0, cache_index, 0, 0))
     scale = scale if scale is not None else dh ** -0.5
-    logits = jnp.einsum("bthd,bshd->bhts", q, k_cache).astype(jnp.float32) * scale
+    # GQA: q heads grouped over kv heads (hq == hkv * rep; rep == 1 for MHA)
+    rep = hq // hkv
+    qg = q.reshape(b, t, hkv, rep, dh)
+    logits = jnp.einsum("btkrd,bskd->bkrts", qg, k_cache).astype(jnp.float32) * scale
     # positions <= cache_index + offset are valid (causal within the new block)
     pos = jnp.arange(s_max)[None, :]  # [1, S]
     q_pos = cache_index + jnp.arange(t)[:, None]  # [T, 1]
     valid = pos <= q_pos  # [T, S]
-    logits = jnp.where(valid[None, None], logits, jnp.finfo(jnp.float32).min)
+    logits = jnp.where(valid[None, None, None], logits, jnp.finfo(jnp.float32).min)
     probs = jax.nn.softmax(logits, axis=-1).astype(v_cache.dtype)
-    out = jnp.einsum("bhts,bshd->bthd", probs, v_cache)
-    return out, k_cache, v_cache
+    out = jnp.einsum("bkrts,bskd->btkrd", probs, v_cache)
+    return out.reshape(b, t, hq, dh), k_cache, v_cache

@@ -148,6 +148,22 @@ def load_engine_checkpoint(engine, load_dir: str, tag: Optional[str] = None,
     return os.path.join(load_dir, tag), client_state
 
 
+def load_params_for_inference(load_dir: str, template, tag: Optional[str] = None):
+    """Load ONLY the model params from an engine checkpoint, re-keyed onto
+    ``template``'s pytree structure (reference InferenceEngine checkpoint-dict
+    loading, inference/engine.py:338 load_model_with_checkpoint)."""
+    if tag is None:
+        latest = os.path.join(load_dir, "latest")
+        if os.path.exists(latest):
+            with open(latest) as f:
+                tag = f.read().strip()
+        else:
+            raise FileNotFoundError(f"no 'latest' file under {load_dir}")
+    loaded = NativeCheckpointEngine().load(os.path.join(load_dir, tag, "state.npz"))
+    params, _ = _unflatten_into(template, loaded.get("params", {}))
+    return params
+
+
 def save_16bit_model(engine, save_dir: str, save_filename: str = "model_weights.npz"):
     """Consolidated 16-bit weights for serving (reference save_16bit_model:3213
     + zero_to_fp32 analog: with global arrays, consolidation is device_get)."""
