@@ -30,6 +30,28 @@ class ModelSpec(Protocol):
     def logical_axes(self) -> Optional[Any]: ...
 
 
+ATTN_IMPLS = ("dense", "flash", "ring", "ulysses")
+
+
+def sp_attention(attn_impl: str, q, k, v, *, causal: bool = True):
+    """Dispatch to the non-dense attention ops: Pallas flash kernel, or the
+    sequence-parallel ring / Ulysses forms (models stay topology-agnostic —
+    the mesh comes from the globally-initialized topology)."""
+    if attn_impl == "flash":
+        from deepspeed_tpu.ops.flash_attention import flash_attention
+
+        return flash_attention(q, k, v, causal)
+    from deepspeed_tpu.ops.ring_attention import ring_attention, ulysses_attention
+    from deepspeed_tpu.utils import groups
+
+    mesh = groups.get_mesh()
+    if attn_impl == "ring":
+        return ring_attention(q, k, v, mesh=mesh, causal=causal)
+    if attn_impl == "ulysses":
+        return ulysses_attention(q, k, v, mesh=mesh, causal=causal)
+    raise ValueError(f"unknown attn_impl {attn_impl!r}")
+
+
 # ------------------------------------------------------------- shared layers
 def layer_norm(x, scale, bias, eps: float = 1e-5):
     x32 = x.astype(jnp.float32)

@@ -20,7 +20,7 @@ from typing import Any, Dict, Optional
 import jax
 import jax.numpy as jnp
 
-from deepspeed_tpu.models.base import cross_entropy_loss, gelu, layer_norm
+from deepspeed_tpu.models.base import ATTN_IMPLS, cross_entropy_loss, gelu, layer_norm, sp_attention
 from deepspeed_tpu.ops.attention import attention_with_kv_cache, multihead_attention
 
 
@@ -67,11 +67,18 @@ class GPT2Model:
     """Causal-LM ModelSpec. batch = {"input_ids": [B,T] int32, "labels": [B,T]}."""
 
     def __init__(self, config: GPT2Config, compute_dtype=jnp.bfloat16,
-                 remat: bool = False, remat_policy: Optional[str] = None):
+                 remat: bool = False, remat_policy: Optional[str] = None,
+                 attn_impl: str = "dense"):
         self.config = config
         self.compute_dtype = compute_dtype
         self.remat = remat
         self.remat_policy = remat_policy
+        assert attn_impl in ATTN_IMPLS, attn_impl
+        if attn_impl != "dense" and config.dropout > 0.0:
+            raise ValueError(
+                f"attn_impl={attn_impl!r} does not implement attention dropout; "
+                f"set dropout=0.0 or use attn_impl='dense'")
+        self.attn_impl = attn_impl
 
     # ------------------------------------------------------------------- init
     def init(self, rng):
@@ -140,12 +147,15 @@ class GPT2Model:
         k_ = k_.reshape(b, t, h, dh)
         v_ = v_.reshape(b, t, h, dh)
         if cache is None:
-            drop_rng = None
-            if train and c.dropout > 0.0 and rng is not None:
-                rng, drop_rng = jax.random.split(rng)
-            attn = multihead_attention(q, k_, v_, causal=True,
-                                       dropout_rate=c.dropout if train else 0.0,
-                                       dropout_rng=drop_rng)
+            if self.attn_impl != "dense":
+                attn = sp_attention(self.attn_impl, q, k_, v_)
+            else:
+                drop_rng = None
+                if train and c.dropout > 0.0 and rng is not None:
+                    rng, drop_rng = jax.random.split(rng)
+                attn = multihead_attention(q, k_, v_, causal=True,
+                                           dropout_rate=c.dropout if train else 0.0,
+                                           dropout_rng=drop_rng)
             kc = vc = None
         else:
             kc, vc, idx = cache

@@ -14,7 +14,7 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 
-from deepspeed_tpu.models.base import cross_entropy_loss, rms_norm
+from deepspeed_tpu.models.base import ATTN_IMPLS, cross_entropy_loss, rms_norm, sp_attention
 from deepspeed_tpu.ops.attention import attention_with_kv_cache, multihead_attention
 from deepspeed_tpu.ops.rotary import apply_rotary_pos_emb, rope_frequencies
 
@@ -64,11 +64,14 @@ class LlamaModel:
     """Causal-LM ModelSpec: batch = {"input_ids": [B,T], "labels": [B,T]}."""
 
     def __init__(self, config: LlamaConfig, compute_dtype=jnp.bfloat16,
-                 remat: bool = False, remat_policy: Optional[str] = None):
+                 remat: bool = False, remat_policy: Optional[str] = None,
+                 attn_impl: str = "dense"):
         self.config = config
         self.compute_dtype = compute_dtype
         self.remat = remat
         self.remat_policy = remat_policy
+        assert attn_impl in ATTN_IMPLS, attn_impl
+        self.attn_impl = attn_impl
 
     def init(self, rng):
         c = self.config
@@ -130,7 +133,10 @@ class LlamaModel:
                 rep = hq // hkv
                 k_ = jnp.repeat(k_, rep, axis=2)
                 v_ = jnp.repeat(v_, rep, axis=2)
-            attn = multihead_attention(q, k_, v_, causal=True)
+            if self.attn_impl != "dense":
+                attn = sp_attention(self.attn_impl, q, k_, v_)
+            else:
+                attn = multihead_attention(q, k_, v_, causal=True)
             kc = vc = None
         else:
             kc, vc, idx = cache

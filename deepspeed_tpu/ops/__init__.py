@@ -54,3 +54,23 @@ class AttentionBuilder(_registry_mod.PallasOpBuilder):
         from deepspeed_tpu.ops import attention
 
         return attention
+
+
+@register_op_builder
+class FlashAttentionBuilder(_registry_mod.PallasOpBuilder):
+    NAME = "flash_attention"
+
+    def load(self):
+        from deepspeed_tpu.ops import flash_attention
+
+        return flash_attention
+
+
+@register_op_builder
+class RingAttentionBuilder(_registry_mod.PallasOpBuilder):
+    NAME = "ring_attention"
+
+    def load(self):
+        from deepspeed_tpu.ops import ring_attention
+
+        return ring_attention

@@ -1,0 +1,269 @@
+"""Pallas flash attention (TPU kernel) — FlashAttention-2 style.
+
+Reference counterpart: the fused attention CUDA kernels
+(``csrc/transformer/softmax_kernels.cu`` training softmax,
+``csrc/transformer/inference/csrc/softmax.cu``) — on TPU the fused,
+memory-efficient form is a Pallas kernel tiled for the MXU: O(T) VMEM per
+query block instead of materializing the [T, T] score matrix in HBM.
+
+Layout: inputs [B, T, H, Dh] (framework-standard); kernels run per (b·h)
+with a grid over query blocks; K/V for the (b·h) live in VMEM and are
+scanned block-by-block with an online softmax. The backward pass is the
+standard two-kernel FA2 recomputation (dq; dk/dv) using the saved
+log-sum-exp rows. Composes with ring attention (ops/ring_attention.py) for
+sequence lengths beyond one chip's VMEM.
+
+Exposed as ``flash_attention(q, k, v, causal=...)`` with a custom_vjp;
+``interpret=True`` (CPU tests) runs the same kernels in the Pallas
+interpreter, so TPU and test paths share every line of kernel code.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+DEFAULT_BLOCK_Q = 128
+DEFAULT_BLOCK_K = 128
+_NEG_INF = -1e30
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_k: int,
+                causal: bool, scale: float, seq_len: int, block_q: int):
+    qi = pl.program_id(1)
+    q = q_ref[...].astype(jnp.float32) * scale          # [BQ, Dh]
+    bq, dh = q.shape
+    q_pos = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, (bq, block_k), 0)
+
+    nk = seq_len // block_k
+
+    def body(kj, carry):
+        m, l, acc = carry
+        k = k_ref[pl.ds(kj * block_k, block_k), :].astype(jnp.float32)  # [BK, Dh]
+        v = v_ref[pl.ds(kj * block_k, block_k), :].astype(jnp.float32)
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())))  # [BQ, BK]
+        if causal:
+            k_pos = kj * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (bq, block_k), 1)
+            s = jnp.where(k_pos <= q_pos, s, _NEG_INF)
+        m_new = jnp.maximum(m, s.max(axis=-1))
+        p = jnp.exp(s - m_new[:, None])
+        corr = jnp.exp(m - m_new)
+        l = l * corr + p.sum(axis=-1)
+        acc = acc * corr[:, None] + jax.lax.dot(p, v)
+        return m_new, l, acc
+
+    m0 = jnp.full((bq,), _NEG_INF, jnp.float32)
+    l0 = jnp.zeros((bq,), jnp.float32)
+    acc0 = jnp.zeros((bq, dh), jnp.float32)
+    if causal:
+        # skip key blocks strictly after this query block
+        nk_eff = jnp.minimum(nk, (qi * block_q + block_q + block_k - 1) // block_k)
+    else:
+        nk_eff = nk
+    m, l, acc = jax.lax.fori_loop(0, nk_eff, body, (m0, l0, acc0))
+    l_safe = jnp.maximum(l, 1e-20)
+    o_ref[...] = (acc / l_safe[:, None]).astype(o_ref.dtype)
+    lse_ref[...] = (m + jnp.log(l_safe)).astype(jnp.float32)
+
+
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *,
+                   block_k: int, causal: bool, scale: float, seq_len: int,
+                   block_q: int):
+    qi = pl.program_id(1)
+    q = q_ref[...].astype(jnp.float32) * scale
+    do = do_ref[...].astype(jnp.float32)
+    lse = lse_ref[...]
+    delta = delta_ref[...]
+    bq, dh = q.shape
+    q_pos = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, (bq, block_k), 0)
+    nk = seq_len // block_k
+
+    def body(kj, dq):
+        k = k_ref[pl.ds(kj * block_k, block_k), :].astype(jnp.float32)
+        v = v_ref[pl.ds(kj * block_k, block_k), :].astype(jnp.float32)
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())))
+        if causal:
+            k_pos = kj * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (bq, block_k), 1)
+            s = jnp.where(k_pos <= q_pos, s, _NEG_INF)
+        p = jnp.exp(s - lse[:, None])
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())))
+        ds = p * (dp - delta[:, None])
+        return dq + jax.lax.dot(ds, k)
+
+    if causal:
+        nk_eff = jnp.minimum(nk, (qi * block_q + block_q + block_k - 1) // block_k)
+    else:
+        nk_eff = nk
+    dq = jax.lax.fori_loop(0, nk_eff, body, jnp.zeros((bq, dh), jnp.float32))
+    dq_ref[...] = (dq * scale).astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                    dk_ref, dv_ref, *, block_q: int, causal: bool, scale: float,
+                    seq_len: int, block_k: int):
+    kj = pl.program_id(1)
+    k = k_ref[...].astype(jnp.float32)
+    v = v_ref[...].astype(jnp.float32)
+    bk, dh = k.shape
+    k_pos = kj * block_k + jax.lax.broadcasted_iota(jnp.int32, (block_q, bk), 1)
+    nq = seq_len // block_q
+
+    def body(qi, carry):
+        dk, dv = carry
+        q = q_ref[pl.ds(qi * block_q, block_q), :].astype(jnp.float32) * scale
+        do = do_ref[pl.ds(qi * block_q, block_q), :].astype(jnp.float32)
+        lse = lse_ref[pl.ds(qi * block_q, block_q)]
+        delta = delta_ref[pl.ds(qi * block_q, block_q)]
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())))  # [BQ, BK]
+        if causal:
+            q_pos = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, bk), 0)
+            s = jnp.where(k_pos <= q_pos, s, _NEG_INF)
+        p = jnp.exp(s - lse[:, None])
+        dv = dv + jax.lax.dot_general(p, do, (((0,), (0,)), ((), ())))
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())))
+        ds = p * (dp - delta[:, None])
+        dk = dk + jax.lax.dot_general(ds, q, (((0,), (0,)), ((), ())))
+        return dk, dv
+
+    if causal:
+        q_start = (kj * block_k) // block_q  # first query block that sees us
+    else:
+        q_start = 0
+    dk0 = jnp.zeros((bk, dh), jnp.float32)
+    dv0 = jnp.zeros((bk, dh), jnp.float32)
+    dk, dv = jax.lax.fori_loop(q_start, nq, body, (dk0, dv0))
+    # q was loaded pre-scaled, so dk = ds^T @ (q*scale) already carries the
+    # softmax scale — no extra factor here (dq DOES need it: k is unscaled)
+    dk_ref[...] = dk.astype(dk_ref.dtype)
+    dv_ref[...] = dv.astype(dv_ref.dtype)
+
+
+def _reshape_bh(x):
+    b, t, h, dh = x.shape
+    return x.transpose(0, 2, 1, 3).reshape(b * h, t, dh)
+
+
+def _unshape_bh(x, b, h):
+    bh, t, dh = x.shape
+    return x.reshape(b, h, t, dh).transpose(0, 2, 1, 3)
+
+
+def _pick_block(t: int, pref: int) -> int:
+    blk = min(pref, t)
+    while t % blk:
+        blk //= 2
+    return max(blk, 1)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def flash_attention(q, k, v, causal: bool = True, scale: Optional[float] = None,
+                    block_q: int = DEFAULT_BLOCK_Q, block_k: int = DEFAULT_BLOCK_K,
+                    interpret: Optional[bool] = None):
+    """q/k/v: [B, T, H, Dh] → [B, T, H, Dh]. MHA (same head counts)."""
+    out, _ = _flash_fwd(q, k, v, causal, scale, block_q, block_k, interpret)
+    return out
+
+
+def _interpret_default() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+def _flash_fwd(q, k, v, causal, scale, block_q, block_k, interpret):
+    b, t, h, dh = q.shape
+    sc = scale if scale is not None else dh ** -0.5
+    bq = _pick_block(t, block_q)
+    bk = _pick_block(t, block_k)
+    interp = _interpret_default() if interpret is None else interpret
+    qf, kf, vf = _reshape_bh(q), _reshape_bh(k), _reshape_bh(v)
+    grid = (b * h, t // bq)
+    kernel = functools.partial(_fwd_kernel, block_k=bk, causal=causal,
+                               scale=sc, seq_len=t, block_q=bq)
+    out, lse = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((None, bq, dh), lambda bh, qi: (bh, qi, 0)),
+            pl.BlockSpec((None, t, dh), lambda bh, qi: (bh, 0, 0)),
+            pl.BlockSpec((None, t, dh), lambda bh, qi: (bh, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((None, bq, dh), lambda bh, qi: (bh, qi, 0)),
+            pl.BlockSpec((None, bq), lambda bh, qi: (bh, qi)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b * h, t, dh), q.dtype),
+            jax.ShapeDtypeStruct((b * h, t), jnp.float32),
+        ],
+        interpret=interp,
+    )(qf, kf, vf)
+    return _unshape_bh(out, b, h), (qf, kf, vf, out, lse, (b, h))
+
+
+def _flash_fwd_vjp(q, k, v, causal, scale, block_q, block_k, interpret):
+    out, res = _flash_fwd(q, k, v, causal, scale, block_q, block_k, interpret)
+    return out, res
+
+
+def _flash_bwd_vjp(causal, scale, block_q, block_k, interpret, res, g):
+    qf, kf, vf, outf, lse, (b, h) = res
+    bh, t, dh = qf.shape
+    sc = scale if scale is not None else dh ** -0.5
+    bq = _pick_block(t, block_q)
+    bk = _pick_block(t, block_k)
+    interp = _interpret_default() if interpret is None else interpret
+    dof = _reshape_bh(g)
+    delta = jnp.sum(dof.astype(jnp.float32) * outf.astype(jnp.float32), axis=-1)
+
+    dq_kernel = functools.partial(_bwd_dq_kernel, block_k=bk, causal=causal,
+                                  scale=sc, seq_len=t, block_q=bq)
+    dq = pl.pallas_call(
+        dq_kernel,
+        grid=(bh, t // bq),
+        in_specs=[
+            pl.BlockSpec((None, bq, dh), lambda b_, qi: (b_, qi, 0)),
+            pl.BlockSpec((None, t, dh), lambda b_, qi: (b_, 0, 0)),
+            pl.BlockSpec((None, t, dh), lambda b_, qi: (b_, 0, 0)),
+            pl.BlockSpec((None, bq, dh), lambda b_, qi: (b_, qi, 0)),
+            pl.BlockSpec((None, bq), lambda b_, qi: (b_, qi)),
+            pl.BlockSpec((None, bq), lambda b_, qi: (b_, qi)),
+        ],
+        out_specs=pl.BlockSpec((None, bq, dh), lambda b_, qi: (b_, qi, 0)),
+        out_shape=jax.ShapeDtypeStruct((bh, t, dh), qf.dtype),
+        interpret=interp,
+    )(qf, kf, vf, dof, lse, delta)
+
+    dkv_kernel = functools.partial(_bwd_dkv_kernel, block_q=bq, causal=causal,
+                                   scale=sc, seq_len=t, block_k=bk)
+    dk, dv = pl.pallas_call(
+        dkv_kernel,
+        grid=(bh, t // bk),
+        in_specs=[
+            pl.BlockSpec((None, t, dh), lambda b_, kj: (b_, 0, 0)),
+            pl.BlockSpec((None, bk, dh), lambda b_, kj: (b_, kj, 0)),
+            pl.BlockSpec((None, bk, dh), lambda b_, kj: (b_, kj, 0)),
+            pl.BlockSpec((None, t, dh), lambda b_, kj: (b_, 0, 0)),
+            pl.BlockSpec((None, t), lambda b_, kj: (b_, 0)),
+            pl.BlockSpec((None, t), lambda b_, kj: (b_, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((None, bk, dh), lambda b_, kj: (b_, kj, 0)),
+            pl.BlockSpec((None, bk, dh), lambda b_, kj: (b_, kj, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, t, dh), kf.dtype),
+            jax.ShapeDtypeStruct((bh, t, dh), vf.dtype),
+        ],
+        interpret=interp,
+    )(qf, kf, vf, dof, lse, delta)
+
+    return (_unshape_bh(dq, b, h), _unshape_bh(dk, b, h), _unshape_bh(dv, b, h))
+
+
+flash_attention.defvjp(_flash_fwd_vjp, _flash_bwd_vjp)

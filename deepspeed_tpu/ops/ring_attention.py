@@ -1,0 +1,138 @@
+"""Ring attention + Ulysses sequence parallelism — the long-context core.
+
+The reference snapshot has NO sequence parallelism (SURVEY §5.7): its
+long-sequence story is Triton block-sparse attention
+(``deepspeed/ops/sparse_attention/``) and curriculum seqlen. The TPU-native
+long-context mechanisms are:
+
+  * **Ring attention** (`ring_attention`): q/k/v sharded on the sequence dim
+    over the 'seq' mesh axis; K/V blocks rotate around the ICI ring with
+    ``ppermute`` while each device accumulates its queries' attention with an
+    online (flash-style) softmax. Peak memory per device is O(T/S · T/S) per
+    step instead of O(T²); compute overlaps the ring hop. Differentiable
+    (the scan + ppermute transpose replays the reverse ring).
+  * **Ulysses-style all-to-all** (`ulysses_attention`): the later
+    DeepSpeed-Ulysses design — all_to_all swaps the sequence sharding for a
+    *head* sharding, runs ordinary dense attention on full sequences for
+    1/S of the heads, and all_to_alls back.
+
+Both are drop-in replacements for ``multihead_attention`` when the inputs'
+sequence dim is sharded over 'seq'.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from deepspeed_tpu.parallel.topology import SEQ_AXIS
+
+# true -inf (not finfo.min): fully-masked blocks must zero out in the online
+# softmax; the isfinite() guards below depend on it
+_NEG_INF = -jnp.inf
+
+
+def ring_attention(
+    q: jax.Array,  # [B, T, H, Dh] — T globally sharded over 'seq'
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    mesh,
+    causal: bool = True,
+    scale: Optional[float] = None,
+    axis: str = SEQ_AXIS,
+) -> jax.Array:
+    """Blockwise ring attention over the sequence mesh axis."""
+    sp = mesh.shape[axis]
+    if sp == 1:
+        from deepspeed_tpu.ops.attention import multihead_attention
+
+        return multihead_attention(q, k, v, causal=causal, scale=scale)
+    dh = q.shape[-1]
+    sc = scale if scale is not None else dh ** -0.5
+
+    def local(ql, kl, vl):
+        # per-device: ql/kl/vl [B, T/S, H, Dh]
+        b, t_loc, h, _ = ql.shape
+        my = jax.lax.axis_index(axis)
+        q_pos = my * t_loc + jnp.arange(t_loc)          # global query positions
+        perm = [(i, (i + 1) % sp) for i in range(sp)]
+
+        def step(carry, t):
+            kl, vl, m, l, o = carry
+            # kl currently came from source device (my - t) mod S
+            src = (my - t) % sp
+            k_pos = src * t_loc + jnp.arange(t_loc)
+            s = jnp.einsum("bthd,bshd->bhts", ql, kl).astype(jnp.float32) * sc
+            if causal:
+                mask = k_pos[None, :] <= q_pos[:, None]  # [Tq, Tk]
+                s = jnp.where(mask[None, None], s, _NEG_INF)
+            m_new = jnp.maximum(m, s.max(axis=-1))
+            # guard fully-masked rows (exp(-inf - -inf))
+            p = jnp.exp(s - m_new[..., None])
+            p = jnp.where(jnp.isfinite(s), p, 0.0)
+            corr = jnp.exp(m - m_new)
+            corr = jnp.where(jnp.isfinite(m), corr, 0.0)
+            l = l * corr + p.sum(axis=-1)
+            o = o * corr[..., None] + jnp.einsum(
+                "bhts,bshd->bthd", p.astype(vl.dtype), vl).astype(jnp.float32).transpose(0, 2, 1, 3)
+            kl = jax.lax.ppermute(kl, axis, perm)
+            vl = jax.lax.ppermute(vl, axis, perm)
+            return (kl, vl, m_new, l, o), None
+
+        # accumulators become varying over the seq axis after step 1 — mark
+        # the initial values accordingly (shard_map VMA typing)
+        vary = lambda x: jax.lax.pcast(x, (axis,), to="varying")
+        m0 = vary(jnp.full((b, h, t_loc), _NEG_INF, jnp.float32))
+        l0 = vary(jnp.zeros((b, h, t_loc), jnp.float32))
+        o0 = vary(jnp.zeros((b, h, t_loc, dh), jnp.float32))
+        (_, _, m, l, o), _ = jax.lax.scan(
+            step, (kl, vl, m0, l0, o0), jnp.arange(sp))
+        out = o / jnp.maximum(l, 1e-20)[..., None]
+        return out.transpose(0, 2, 1, 3).astype(ql.dtype)  # [B, T/S, H, Dh]
+
+    spec = P(None, axis)
+    return jax.shard_map(local, mesh=mesh, in_specs=(spec, spec, spec),
+                         out_specs=spec, axis_names={axis})(q, k, v)
+
+
+def ulysses_attention(
+    q: jax.Array,  # [B, T, H, Dh] — T sharded over 'seq'; H % sp == 0
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    mesh,
+    causal: bool = True,
+    scale: Optional[float] = None,
+    axis: str = SEQ_AXIS,
+) -> jax.Array:
+    """DeepSpeed-Ulysses-style attention: all_to_all head-scatter, dense
+    attention on full sequences for H/S heads, all_to_all back."""
+    sp = mesh.shape[axis]
+    from deepspeed_tpu.ops.attention import multihead_attention
+
+    if sp == 1:
+        return multihead_attention(q, k, v, causal=causal, scale=scale)
+    assert q.shape[2] % sp == 0, (
+        f"ulysses needs heads ({q.shape[2]}) divisible by sp ({sp})")
+
+    def local(ql, kl, vl):
+        # [B, T/S, H, Dh] → all_to_all → [B, T, H/S, Dh]
+        def scatter(x):
+            return jax.lax.all_to_all(x, axis, split_axis=2, concat_axis=1,
+                                      tiled=True)
+
+        def gather(x):
+            return jax.lax.all_to_all(x, axis, split_axis=1, concat_axis=2,
+                                      tiled=True)
+
+        qf, kf, vf = scatter(ql), scatter(kl), scatter(vl)
+        out = multihead_attention(qf, kf, vf, causal=causal, scale=scale)
+        return gather(out)
+
+    spec = P(None, axis)
+    return jax.shard_map(local, mesh=mesh, in_specs=(spec, spec, spec),
+                         out_specs=spec, axis_names={axis})(q, k, v)
