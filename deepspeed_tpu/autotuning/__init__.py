@@ -1,0 +1,3 @@
+from deepspeed_tpu.autotuning.autotuner import Autotuner, AutotuningConfig
+
+__all__ = ["Autotuner", "AutotuningConfig"]

@@ -1,0 +1,16 @@
+from deepspeed_tpu.compression.compress import (
+    CompressionScheduler,
+    init_compression,
+    redundancy_clean,
+)
+from deepspeed_tpu.compression.quantize import (
+    dequantize_int8,
+    fake_quantize,
+    magnitude_prune_mask,
+    quantize_int8,
+    row_prune_mask,
+)
+
+__all__ = ["init_compression", "redundancy_clean", "CompressionScheduler",
+           "fake_quantize", "quantize_int8", "dequantize_int8",
+           "magnitude_prune_mask", "row_prune_mask"]

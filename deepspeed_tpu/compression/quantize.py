@@ -1,0 +1,97 @@
+"""Quantization / pruning primitives — analog of the reference's
+``csrc/quantization`` CUDA kernels (fake_quantizer.cu, quantize.cu; SURVEY
+§2.4) and the ``compression/basic_layer.py`` QuantAct/LinearLayer_Compress
+math. Pure jnp: XLA fuses quant/dequant into the surrounding matmuls on TPU
+(the CUDA kernels exist to do exactly that fusion by hand).
+
+All functions use the straight-through estimator (STE) for training: the
+forward quantizes, the backward passes gradients through unchanged —
+identical semantics to the reference's fake quantization.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+@jax.custom_vjp
+def _ste_round(x):
+    return jnp.round(x)
+
+
+def _ste_round_fwd(x):
+    return jnp.round(x), None
+
+
+def _ste_round_bwd(_, g):
+    return (g,)
+
+
+_ste_round.defvjp(_ste_round_fwd, _ste_round_bwd)
+
+
+def fake_quantize(x: jax.Array, bits: int = 8, *, symmetric: bool = True,
+                  per_channel_axis: Optional[int] = None) -> jax.Array:
+    """Quantize→dequantize with STE (reference fake_quantizer.cu sym/asym)."""
+    if per_channel_axis is not None:
+        axes = tuple(i for i in range(x.ndim) if i != per_channel_axis)
+    else:
+        axes = tuple(range(x.ndim))
+    x32 = x.astype(jnp.float32)
+    if symmetric:
+        qmax = 2.0 ** (bits - 1) - 1
+        scale = jnp.max(jnp.abs(x32), axis=axes, keepdims=True) / qmax
+        scale = jnp.maximum(scale, 1e-10)
+        q = jnp.clip(_ste_round(x32 / scale), -qmax - 1, qmax)
+        return (q * scale).astype(x.dtype)
+    qmax = 2.0 ** bits - 1
+    lo = jnp.min(x32, axis=axes, keepdims=True)
+    hi = jnp.max(x32, axis=axes, keepdims=True)
+    scale = jnp.maximum(hi - lo, 1e-10) / qmax
+    q = jnp.clip(_ste_round((x32 - lo) / scale), 0, qmax)
+    return (q * scale + lo).astype(x.dtype)
+
+
+def quantize_int8(x: jax.Array, *, per_channel_axis: Optional[int] = None
+                  ) -> Tuple[jax.Array, jax.Array]:
+    """Real int8 quantization → (int8 values, fp32 scales). Used by MoQ and
+    int8 inference paths (reference quantize.cu)."""
+    if per_channel_axis is not None:
+        axes = tuple(i for i in range(x.ndim) if i != per_channel_axis)
+    else:
+        axes = tuple(range(x.ndim))
+    x32 = x.astype(jnp.float32)
+    scale = jnp.max(jnp.abs(x32), axis=axes, keepdims=True) / 127.0
+    scale = jnp.maximum(scale, 1e-10)
+    q = jnp.clip(jnp.round(x32 / scale), -128, 127).astype(jnp.int8)
+    return q, scale
+
+
+def dequantize_int8(q: jax.Array, scale: jax.Array, dtype=jnp.bfloat16) -> jax.Array:
+    return (q.astype(jnp.float32) * scale).astype(dtype)
+
+
+def magnitude_prune_mask(w: jax.Array, sparsity: float) -> jax.Array:
+    """Unstructured magnitude pruning mask (reference sparse_pruning,
+    compression/helper.py): keep the largest (1-sparsity) fraction."""
+    flat = jnp.abs(w).reshape(-1)
+    k = int(flat.size * (1.0 - sparsity))
+    k = max(k, 1)
+    threshold = jax.lax.top_k(flat, k)[0][-1]
+    return (jnp.abs(w) >= threshold).astype(w.dtype)
+
+
+def row_prune_mask(w: jax.Array, ratio: float, axis: int = 0) -> jax.Array:
+    """Structured row/head pruning mask: zero whole slices along ``axis`` by
+    L1 norm (reference row_pruning / head_pruning)."""
+    other = tuple(i for i in range(w.ndim) if i != axis)
+    norms = jnp.sum(jnp.abs(w), axis=other)
+    keep = max(int(norms.size * (1.0 - ratio)), 1)
+    threshold = jax.lax.top_k(norms, keep)[0][-1]
+    mask1d = (norms >= threshold).astype(w.dtype)
+    shape = [1] * w.ndim
+    shape[axis] = norms.size
+    return mask1d.reshape(shape)

@@ -1,0 +1,16 @@
+from deepspeed_tpu.elasticity.config import (
+    ElasticityConfig,
+    ElasticityConfigError,
+    ElasticityError,
+    ElasticityIncompatibleWorldSize,
+)
+from deepspeed_tpu.elasticity.elasticity import (
+    compute_elastic_config,
+    elasticity_enabled,
+    get_candidate_batch_sizes,
+    get_valid_gpus,
+)
+
+__all__ = ["ElasticityConfig", "ElasticityConfigError", "ElasticityError",
+           "ElasticityIncompatibleWorldSize", "compute_elastic_config",
+           "elasticity_enabled", "get_candidate_batch_sizes", "get_valid_gpus"]

@@ -1,0 +1,10 @@
+from deepspeed_tpu.profiling.config import DeepSpeedFlopsProfilerConfig
+from deepspeed_tpu.profiling.flops_profiler import (
+    FlopsProfiler,
+    compiled_cost,
+    get_model_profile,
+    jaxpr_op_breakdown,
+)
+
+__all__ = ["DeepSpeedFlopsProfilerConfig", "FlopsProfiler", "compiled_cost",
+           "get_model_profile", "jaxpr_op_breakdown"]

@@ -1,0 +1,195 @@
+"""FLOPS profiler — analog of reference
+``deepspeed/profiling/flops_profiler/profiler.py`` (FlopsProfiler:23,
+1294 LoC of module-hook MAC counting).
+
+TPU-native redesign: instead of wrapping every nn.Module method with Python
+hooks, the profile comes from XLA itself — ``jax.jit(fn).lower().compile()
+.cost_analysis()`` returns the compiler's own flops/bytes estimates for the
+WHOLE optimized program (post-fusion, the numbers that actually hit the MXU),
+and ``jaxpr`` traversal gives the per-primitive breakdown the reference
+reports per-module. This is both cheaper (no per-step overhead at all) and
+more truthful than hook-based MAC counting.
+
+API parity: ``FlopsProfiler`` with ``start_profile/stop_profile/
+get_total_flops/get_total_params/get_total_duration/print_model_profile``;
+``get_model_profile(model, batch)`` one-shot helper (reference
+flops_profiler/profiler.py get_model_profile:1103).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import jax
+import numpy as np
+
+
+def _fmt_flops(f: float) -> str:
+    for unit in ("", "K", "M", "G", "T", "P"):
+        if abs(f) < 1000:
+            return f"{f:.2f} {unit}FLOPs"
+        f /= 1000
+    return f"{f:.2f} EFLOPs"
+
+
+def _fmt_params(n: float) -> str:
+    for unit in ("", "k", "M", "B", "T"):
+        if abs(n) < 1000:
+            return f"{n:.2f} {unit}"
+        n /= 1000
+    return f"{n:.2f} Q"
+
+
+def compiled_cost(fn: Callable, *args, static_argnums=()) -> Dict[str, float]:
+    """XLA cost analysis of ``jit(fn)(*args)`` — flops / bytes accessed."""
+    compiled = jax.jit(fn, static_argnums=static_argnums).lower(*args).compile()
+    try:
+        ca = compiled.cost_analysis()
+        if isinstance(ca, list):  # older jax returns [dict]
+            ca = ca[0]
+    except Exception:
+        ca = {}
+    return {
+        "flops": float(ca.get("flops", 0.0)),
+        "bytes_accessed": float(ca.get("bytes accessed", 0.0)),
+        "cost_analysis": dict(ca) if ca else {},
+    }
+
+
+def jaxpr_op_breakdown(fn: Callable, *args) -> Dict[str, Dict[str, float]]:
+    """Per-primitive flop/count breakdown from the jaxpr (the analog of the
+    reference's per-module tree, at primitive granularity)."""
+    jaxpr = jax.make_jaxpr(fn)(*args)
+    counts: Dict[str, Dict[str, float]] = {}
+
+    def sub_jaxprs(params):
+        for v in params.values():
+            if hasattr(v, "eqns"):
+                yield v
+            elif hasattr(v, "jaxpr"):  # ClosedJaxpr
+                yield v.jaxpr
+            elif isinstance(v, (tuple, list)):
+                for item in v:
+                    if hasattr(item, "eqns"):
+                        yield item
+                    elif hasattr(item, "jaxpr"):
+                        yield item.jaxpr
+
+    def visit(jxp):
+        for eqn in jxp.eqns:
+            name = eqn.primitive.name
+            entry = counts.setdefault(name, {"count": 0, "flops": 0.0})
+            entry["count"] += 1
+            entry["flops"] += _eqn_flops(eqn)
+            for sub in sub_jaxprs(eqn.params):
+                visit(sub)
+
+    visit(jaxpr.jaxpr)
+    return counts
+
+
+def _eqn_flops(eqn) -> float:
+    """First-order flop estimate per primitive."""
+    name = eqn.primitive.name
+    try:
+        if name in ("dot_general", "conv_general_dilated"):
+            out = eqn.outvars[0].aval
+            if name == "dot_general":
+                dims = eqn.params["dimension_numbers"]
+                lhs = eqn.invars[0].aval
+                contract = dims[0][0]
+                k = int(np.prod([lhs.shape[i] for i in contract])) if contract else 1
+                return 2.0 * float(np.prod(out.shape)) * k
+            return 2.0 * float(np.prod(out.shape))
+        if name in ("add", "mul", "sub", "div", "max", "min", "exp", "log",
+                    "tanh", "rsqrt", "erf", "logistic"):
+            return float(np.prod(eqn.outvars[0].aval.shape))
+    except Exception:
+        pass
+    return 0.0
+
+
+class FlopsProfiler:
+    """reference FlopsProfiler:23 API on top of compiled-cost analysis."""
+
+    def __init__(self, model=None, ds_engine=None):
+        self.model = model
+        self.ds_engine = ds_engine
+        self._cost: Dict[str, float] = {}
+        self._params: Optional[int] = None
+        self._t0: Optional[float] = None
+        self._duration = 0.0
+        self.started = False
+
+    def start_profile(self, ignore_list=None):
+        self.started = True
+        self._t0 = time.time()
+
+    def stop_profile(self):
+        if self._t0 is not None:
+            self._duration = time.time() - self._t0
+        self.started = False
+
+    def profile_fn(self, fn, *args):
+        self._cost = compiled_cost(fn, *args)
+        return self._cost
+
+    # ---- totals (reference get_total_* API)
+    def get_total_flops(self, as_string: bool = False):
+        f = self._cost.get("flops", 0.0)
+        return _fmt_flops(f) if as_string else f
+
+    def get_total_duration(self, as_string: bool = False):
+        return f"{self._duration:.2f} s" if as_string else self._duration
+
+    def get_total_params(self, as_string: bool = False):
+        n = self._params
+        if n is None and self.model is not None and hasattr(self.model, "init"):
+            shapes = jax.eval_shape(self.model.init, jax.random.PRNGKey(0))
+            n = sum(int(np.prod(l.shape)) for l in jax.tree_util.tree_leaves(shapes))
+            self._params = n
+        n = n or 0
+        return _fmt_params(float(n)) if as_string else n
+
+    def print_model_profile(self, profile_step=1, module_depth=-1, top_modules=1,
+                            detailed=True, output_file=None):
+        lines = [
+            "-------------------------- DeepSpeed-TPU Flops Profiler "
+            "--------------------------",
+            f"params:                 {self.get_total_params(as_string=True)}",
+            f"fwd flops (compiled):   {self.get_total_flops(as_string=True)}",
+            f"bytes accessed:         {self._cost.get('bytes_accessed', 0.0):.3e}",
+            f"profile duration:       {self.get_total_duration(as_string=True)}",
+        ]
+        text = "\n".join(lines)
+        if output_file:
+            with open(output_file, "w") as f:
+                f.write(text + "\n")
+        else:
+            print(text)
+        return text
+
+    def end_profile(self):
+        self.stop_profile()
+
+
+def get_model_profile(model, batch, *, rng=None, as_string: bool = True,
+                      print_profile: bool = False) -> Tuple[Any, Any, Any]:
+    """One-shot (flops, macs, params) like reference get_model_profile:1103.
+    ``macs`` is flops/2 by the usual convention."""
+    rng = rng if rng is not None else jax.random.PRNGKey(0)
+    params = jax.jit(model.init)(rng)
+    prof = FlopsProfiler(model=model)
+    prof.start_profile()
+    cost = prof.profile_fn(lambda p, b: model.apply(p, b, rngs=None, train=False)[0],
+                           params, batch)
+    prof.stop_profile()
+    if print_profile:
+        prof.print_model_profile()
+    flops = cost["flops"]
+    macs = flops / 2.0
+    n_params = prof.get_total_params()
+    if as_string:
+        return (_fmt_flops(flops), _fmt_params(macs) + "MACs", _fmt_params(float(n_params)))
+    return flops, macs, n_params

@@ -1,0 +1,81 @@
+"""Random layerwise token dropping (random-LTD) — analog of reference
+``runtime/data_pipeline/data_routing/`` (basic_layer.py RandomLayerTokenDrop,
+scheduler.py RandomLTDScheduler) + the ``csrc/random_ltd`` CUDA kernels
+(token_sort.cu / gather_scatter.cu, SURVEY §2.4).
+
+The CUDA token gather/scatter kernels become jnp takes — XLA fuses them into
+the surrounding layers on TPU; static shapes are preserved by keeping the
+kept-token count a python int per compiled step (the scheduler changes it
+across steps, which recompiles on a small ladder of sizes, matching how the
+reference reserves per-seqlen kernels).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def gather_tokens(x: jax.Array, indices: jax.Array) -> jax.Array:
+    """x: [B, T, D]; indices: [B, T_keep] → [B, T_keep, D]
+    (csrc/random_ltd/gather_scatter.cu gather analog)."""
+    return jnp.take_along_axis(x, indices[..., None], axis=1)
+
+
+def scatter_tokens(full: jax.Array, kept: jax.Array, indices: jax.Array) -> jax.Array:
+    """Write ``kept`` back into ``full`` at ``indices`` (scatter analog)."""
+    b, tk = indices.shape
+    bidx = jnp.arange(b)[:, None]
+    return full.at[bidx, indices].set(kept)
+
+
+def sample_token_indices(rng, batch: int, seq_len: int, keep: int) -> jax.Array:
+    """Sorted random subset of token positions per batch row (the token_sort.cu
+    analog: sorted so relative order — and causality — is preserved)."""
+    noise = jax.random.uniform(rng, (batch, seq_len))
+    idx = jnp.argsort(noise, axis=-1)[:, :keep]
+    return jnp.sort(idx, axis=-1)
+
+
+def random_ltd_token_drop(x: jax.Array, rng, keep: int) -> Tuple[jax.Array, jax.Array]:
+    """Drop tokens for one layer: returns (kept_tokens, indices)."""
+    b, t = x.shape[0], x.shape[1]
+    idx = sample_token_indices(rng, b, t, keep)
+    return gather_tokens(x, idx), idx
+
+
+class RandomLTDScheduler:
+    """Kept-token schedule (reference data_routing/scheduler.py): linear ramp
+    from ``start_seq`` to ``full_seq`` over ``total_steps``, stepping in
+    ``increment`` granules to bound recompiles."""
+
+    def __init__(self, config: Dict):
+        cfg = config.get("random_ltd", config)
+        self.start_seq = cfg.get("random_ltd_schedule", {}).get(
+            "min_value", cfg.get("min_value", 128))
+        self.full_seq = cfg.get("random_ltd_schedule", {}).get(
+            "max_value", cfg.get("max_value", 512))
+        sched = cfg.get("random_ltd_schedule", cfg)
+        self.total_steps = sched.get("schedule_config", sched).get(
+            "total_layer_tokens_steps", sched.get("total_steps", 1000))
+        self.increment = sched.get("schedule_config", sched).get(
+            "seq_per_step", sched.get("increment", 16))
+        self.current_seq = self.start_seq
+
+    def update_seq(self, global_step: int) -> int:
+        frac = min(global_step / max(self.total_steps, 1), 1.0)
+        seq = self.start_seq + (self.full_seq - self.start_seq) * frac
+        seq = int(seq // self.increment) * self.increment
+        self.current_seq = max(self.start_seq, min(seq, self.full_seq))
+        return self.current_seq
+
+    def get_current_seq(self) -> int:
+        return self.current_seq
+
+    def state_dict(self) -> Dict:
+        return {"current_seq": self.current_seq}
+
+    def load_state_dict(self, sd: Dict):
+        self.current_seq = sd["current_seq"]
