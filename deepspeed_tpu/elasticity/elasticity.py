@@ -64,9 +64,9 @@ def compute_elastic_config(ds_config: Dict, target_deepspeed_version: str = "",
                            world_size: int = 0, return_microbatch: bool = False):
     """reference compute_elastic_config:233.
 
-    Returns (final_batch_size, valid_gpus[, micro_batch]) — with
-    ``world_size`` > 0 also validates compatibility and picks the largest
-    micro-batch that solves batch = micro * gas * world.
+    Returns ``(final_batch_size, valid_gpus)``; with ``world_size`` > 0 also
+    validates compatibility and returns the largest micro-batch that solves
+    batch = micro * gas * world as a third element.
     """
     cfg = ElasticityConfig(**ds_config.get("elasticity", {})).validate()
     if not cfg.enabled:
@@ -88,11 +88,10 @@ def compute_elastic_config(ds_config: Dict, target_deepspeed_version: str = "",
             raise ElasticityIncompatibleWorldSize(
                 f"world size {world_size} is not in the valid set "
                 f"{valid_gpus} for elastic batch {final_batch}")
-        if return_microbatch:
-            micro = max(m for m in cfg.micro_batch_sizes
-                        if final_batch % (m * world_size) == 0)
-            return final_batch, valid_gpus, micro
-        return final_batch, valid_gpus
+        micro = max(m for m in cfg.micro_batch_sizes
+                    if final_batch % (m * world_size) == 0)
+        return final_batch, valid_gpus, micro
     if return_microbatch:
-        return final_batch, valid_gpus, None
+        micro = max(m for m in cfg.micro_batch_sizes if final_batch % m == 0)
+        return final_batch, valid_gpus, micro
     return final_batch, valid_gpus

@@ -74,3 +74,50 @@ class RingAttentionBuilder(_registry_mod.PallasOpBuilder):
         from deepspeed_tpu.ops import ring_attention
 
         return ring_attention
+
+
+def _native_builder_base():
+    from deepspeed_tpu.ops.native.builder import NativeOpBuilder
+
+    return NativeOpBuilder
+
+
+class _NativeBuilderProxy(_registry_mod.OpBuilder):
+    """Defer importing the native builder machinery until first use."""
+
+    SOURCES: list = []
+    WANT_OPENMP = False
+    WANT_SIMD = False
+
+    def _impl(self):
+        cached = getattr(self, "_impl_cache", None)
+        if cached is None:
+            base = _native_builder_base()
+            cls = type(self.NAME, (base,), {
+                "NAME": self.NAME, "SOURCES": self.SOURCES,
+                "WANT_OPENMP": self.WANT_OPENMP, "WANT_SIMD": self.WANT_SIMD,
+            })
+            cached = self._impl_cache = cls(self.accelerator)
+        return cached
+
+    def is_compatible(self, verbose: bool = False) -> bool:
+        return self._impl().is_compatible(verbose)
+
+    def compatibility_reason(self) -> str:
+        return self._impl().compatibility_reason()
+
+    def load_library(self):
+        return self._impl().load_library()
+
+
+@register_op_builder
+class AsyncIOBuilder(_NativeBuilderProxy):
+    """Native async file IO engine (reference csrc/aio; op name 'async_io')."""
+
+    NAME = "async_io"
+    SOURCES = ["aio/dstpu_aio.cpp"]
+
+    def load(self):
+        from deepspeed_tpu.ops import aio
+
+        return aio

@@ -1,0 +1,129 @@
+"""Python surface over the native C++ async IO engine (csrc/aio/dstpu_aio.cpp).
+
+API parity with the reference's aio op (csrc/aio/py_lib/deepspeed_py_aio_handle.cpp
+via ops/op_builder async_io): an ``AsyncIOHandle`` with
+``async_pread/async_pwrite/wait`` plus sync variants — operating on numpy
+arrays (host memory) instead of torch CPU tensors.  Used by
+``runtime/swap_tensor`` for ZeRO-Infinity-style param/optimizer swapping.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+from typing import Optional
+
+import numpy as np
+
+_LIB = None
+
+
+def _lib() -> ctypes.CDLL:
+    global _LIB
+    if _LIB is None:
+        from deepspeed_tpu.ops import AsyncIOBuilder
+
+        lib = AsyncIOBuilder().load_library()
+        lib.dstpu_aio_create.restype = ctypes.c_void_p
+        lib.dstpu_aio_create.argtypes = [ctypes.c_uint64, ctypes.c_int, ctypes.c_int]
+        lib.dstpu_aio_destroy.argtypes = [ctypes.c_void_p]
+        for fn in (lib.dstpu_aio_pread, lib.dstpu_aio_pwrite):
+            fn.restype = ctypes.c_int64
+            fn.argtypes = [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_void_p,
+                           ctypes.c_uint64, ctypes.c_uint64]
+        for fn in (lib.dstpu_aio_sync_pread, lib.dstpu_aio_sync_pwrite):
+            fn.restype = ctypes.c_int
+            fn.argtypes = [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_void_p,
+                           ctypes.c_uint64, ctypes.c_uint64]
+        lib.dstpu_aio_wait.restype = ctypes.c_int
+        lib.dstpu_aio_wait.argtypes = [ctypes.c_void_p, ctypes.c_int64]
+        lib.dstpu_aio_wait_all.restype = ctypes.c_int
+        lib.dstpu_aio_wait_all.argtypes = [ctypes.c_void_p]
+        lib.dstpu_aio_block_size.restype = ctypes.c_uint64
+        lib.dstpu_aio_block_size.argtypes = [ctypes.c_void_p]
+        lib.dstpu_aio_queue_depth.restype = ctypes.c_int
+        lib.dstpu_aio_queue_depth.argtypes = [ctypes.c_void_p]
+        lib.dstpu_aio_thread_count.restype = ctypes.c_int
+        lib.dstpu_aio_thread_count.argtypes = [ctypes.c_void_p]
+        _LIB = lib
+    return _LIB
+
+
+def _as_buffer(arr: np.ndarray):
+    assert arr.flags["C_CONTIGUOUS"], "aio requires contiguous buffers"
+    return arr.ctypes.data_as(ctypes.c_void_p), arr.nbytes
+
+
+class AsyncIOHandle:
+    """Reference ``aio_handle`` analog: pool of IO threads + request queue."""
+
+    def __init__(self, block_size: int = 1 << 20, queue_depth: int = 32,
+                 single_submit: bool = False, overlap_events: bool = True,
+                 num_threads: int = 8):
+        self._lib = _lib()
+        self._h = self._lib.dstpu_aio_create(block_size, queue_depth, num_threads)
+        if not self._h:
+            raise RuntimeError("failed to create aio engine")
+        # kept for config parity / ds_report
+        self.single_submit = single_submit
+        self.overlap_events = overlap_events
+
+    # -- introspection (reference get_block_size/get_queue_depth/...)
+    def get_block_size(self) -> int:
+        return self._lib.dstpu_aio_block_size(self._h)
+
+    def get_queue_depth(self) -> int:
+        return self._lib.dstpu_aio_queue_depth(self._h)
+
+    def get_thread_count(self) -> int:
+        return self._lib.dstpu_aio_thread_count(self._h)
+
+    # -- async ops: buffer must stay alive until wait()
+    def async_pread(self, buffer: np.ndarray, filename: str, offset: int = 0) -> int:
+        ptr, nbytes = _as_buffer(buffer)
+        rid = self._lib.dstpu_aio_pread(self._h, os.fsencode(filename), ptr,
+                                        nbytes, offset)
+        if rid < 0:
+            raise OSError(-rid, f"aio pread submit failed for {filename}")
+        return rid
+
+    def async_pwrite(self, buffer: np.ndarray, filename: str, offset: int = 0) -> int:
+        ptr, nbytes = _as_buffer(buffer)
+        rid = self._lib.dstpu_aio_pwrite(self._h, os.fsencode(filename), ptr,
+                                         nbytes, offset)
+        if rid < 0:
+            raise OSError(-rid, f"aio pwrite submit failed for {filename}")
+        return rid
+
+    def wait(self, request_id: Optional[int] = None) -> int:
+        """Wait for one request (or all inflight when id is None)."""
+        if request_id is None:
+            rc = self._lib.dstpu_aio_wait_all(self._h)
+        else:
+            rc = self._lib.dstpu_aio_wait(self._h, request_id)
+        if rc < 0:
+            raise OSError(-rc, "aio request failed")
+        return rc
+
+    # -- sync ops
+    def sync_pread(self, buffer: np.ndarray, filename: str, offset: int = 0):
+        ptr, nbytes = _as_buffer(buffer)
+        rc = self._lib.dstpu_aio_sync_pread(self._h, os.fsencode(filename), ptr,
+                                            nbytes, offset)
+        if rc < 0:
+            raise OSError(-rc, f"aio sync pread failed for {filename}")
+
+    def sync_pwrite(self, buffer: np.ndarray, filename: str, offset: int = 0):
+        ptr, nbytes = _as_buffer(buffer)
+        rc = self._lib.dstpu_aio_sync_pwrite(self._h, os.fsencode(filename), ptr,
+                                             nbytes, offset)
+        if rc < 0:
+            raise OSError(-rc, f"aio sync pwrite failed for {filename}")
+
+    def __del__(self):
+        try:
+            if getattr(self, "_h", None):
+                self._lib.dstpu_aio_destroy(self._h)
+                self._h = None
+        except Exception:
+            pass

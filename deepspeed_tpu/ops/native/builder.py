@@ -1,0 +1,132 @@
+"""JIT builder for native C++ host-side ops.
+
+The reference compiles CUDA/C++ torch extensions at first ``load()``
+(op_builder/builder.py:434-497: hash sources, compile into a per-user build
+dir, dlopen).  Here the native ops are plain C shared libraries bound via
+ctypes — no torch, no pybind11 — because they operate on raw host memory
+(numpy buffers) handed over by the JAX host runtime:
+
+  sources → g++ -O3 -fPIC -shared (-fopenmp, -mavx2 when supported)
+          → ~/.cache/dstpu_ops/<name>-<hash>.so → ctypes.CDLL
+
+Compatibility detection mirrors ``OpBuilder.is_compatible``: a missing
+toolchain or failed SIMD probe downgrades flags rather than failing, and
+callers can interrogate availability via the op registry.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import os
+import shutil
+import subprocess
+import tempfile
+from pathlib import Path
+from typing import List, Optional
+
+from deepspeed_tpu.ops.registry import OpBuilder
+from deepspeed_tpu.utils.logging import logger
+
+_REPO_CSRC = Path(__file__).resolve().parents[3] / "csrc"
+
+
+def _build_dir() -> Path:
+    d = os.environ.get("DSTPU_BUILD_DIR")
+    if d:
+        p = Path(d)
+    else:
+        p = Path(os.path.expanduser("~/.cache/dstpu_ops"))
+    p.mkdir(parents=True, exist_ok=True)
+    return p
+
+
+def _compiler() -> Optional[str]:
+    for cc in ("g++", "c++", "clang++"):
+        if shutil.which(cc):
+            return cc
+    return None
+
+
+def _probe_flag(cc: str, flag: str) -> bool:
+    """Does the toolchain accept ``flag``? (cpu-arch detection analog of
+    reference builder.py:318 SIMD width probing)."""
+    with tempfile.TemporaryDirectory() as td:
+        src = Path(td) / "probe.cpp"
+        src.write_text("int main(){return 0;}\n")
+        try:
+            r = subprocess.run([cc, flag, str(src), "-o", str(Path(td) / "a.out")],
+                               capture_output=True, timeout=60)
+            return r.returncode == 0
+        except Exception:
+            return False
+
+
+_FLAG_CACHE: dict = {}
+
+
+def _supported(cc: str, flag: str) -> bool:
+    key = (cc, flag)
+    if key not in _FLAG_CACHE:
+        _FLAG_CACHE[key] = _probe_flag(cc, flag)
+    return _FLAG_CACHE[key]
+
+
+def build_native_lib(name: str, sources: List[str], extra_flags: List[str] = (),
+                     want_openmp: bool = False, want_simd: bool = False) -> Path:
+    """Compile ``sources`` (paths relative to csrc/) into a cached .so."""
+    cc = _compiler()
+    if cc is None:
+        raise RuntimeError("no C++ compiler found (g++/clang++)")
+    srcs = [str(_REPO_CSRC / s) for s in sources]
+    flags = [cc, "-O3", "-std=c++17", "-fPIC", "-shared", "-pthread"]
+    if want_openmp and _supported(cc, "-fopenmp"):
+        flags.append("-fopenmp")
+    if want_simd:
+        for simd in ("-mavx512f", "-mavx2"):
+            if _supported(cc, simd):
+                flags.append(simd)
+                break
+    flags += list(extra_flags)
+    h = hashlib.sha256()
+    for s in srcs:
+        h.update(Path(s).read_bytes())
+    h.update(" ".join(flags).encode())  # compiler + resolved flags key the cache
+    out = _build_dir() / f"{name}-{h.hexdigest()[:16]}.so"
+    if out.exists():
+        return out
+    tmp = f"{out}.{os.getpid()}.tmp"  # unique per process: concurrent ranks race
+    cmd = flags + srcs + ["-o", tmp]
+    logger.info(f"building native op '{name}': {' '.join(cmd)}")
+    r = subprocess.run(cmd, capture_output=True, text=True, timeout=600)
+    if r.returncode != 0:
+        raise RuntimeError(f"native build of '{name}' failed:\n{r.stderr}")
+    os.replace(tmp, out)
+    return out
+
+
+class NativeOpBuilder(OpBuilder):
+    """Base for ops backed by a C++ shared library (AIO, CPU optimizers)."""
+
+    SOURCES: List[str] = []
+    WANT_OPENMP = False
+    WANT_SIMD = False
+
+    def is_compatible(self, verbose: bool = False) -> bool:
+        if _compiler() is None:
+            if verbose:
+                logger.warning(f"{self.NAME}: no C++ compiler on PATH")
+            return False
+        return all((_REPO_CSRC / s).exists() for s in self.SOURCES)
+
+    def compatibility_reason(self) -> str:
+        if _compiler() is None:
+            return "no C++ compiler found"
+        missing = [s for s in self.SOURCES if not (_REPO_CSRC / s).exists()]
+        return f"missing sources: {missing}" if missing else "compatible"
+
+    def load_library(self) -> ctypes.CDLL:
+        path = build_native_lib(self.NAME, self.SOURCES,
+                                want_openmp=self.WANT_OPENMP,
+                                want_simd=self.WANT_SIMD)
+        return ctypes.CDLL(str(path))

@@ -1,0 +1,10 @@
+from deepspeed_tpu.runtime.swap_tensor.buffer_pool import SwapBufferPool
+from deepspeed_tpu.runtime.swap_tensor.async_swapper import AsyncTensorSwapper
+from deepspeed_tpu.runtime.swap_tensor.partitioned_param_swapper import (
+    AsyncPartitionedParameterSwapper,
+    PartitionedParamStatus,
+)
+from deepspeed_tpu.runtime.swap_tensor.optimizer_swapper import (
+    OptimizerSwapper,
+    PipelinedOptimizerSwapper,
+)

@@ -1,0 +1,103 @@
+"""Async tensor swapping core.
+
+Reference analog: ``AsyncTensorSwapper`` (runtime/swap_tensor/async_swapper.py)
+— stream tensors out to fast storage without blocking the training loop, and
+bring them back on demand.  Tensors here are numpy host arrays (the host side
+of JAX arrays); each named tensor maps to one file under the swap folder and
+swaps ride the native C++ AIO engine (csrc/aio/dstpu_aio.cpp).
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass
+from typing import Dict, Optional, Tuple
+
+import numpy as np
+
+from deepspeed_tpu.utils.logging import logger
+
+
+@dataclass
+class _Inflight:
+    request_id: int
+    buffer: np.ndarray
+    write: bool
+
+
+class AsyncTensorSwapper:
+    def __init__(self, swap_folder: str, aio_handle=None, num_threads: int = 8,
+                 block_size: int = 1 << 20):
+        os.makedirs(swap_folder, exist_ok=True)
+        self.swap_folder = swap_folder
+        if aio_handle is None:
+            from deepspeed_tpu.ops.aio import AsyncIOHandle
+
+            aio_handle = AsyncIOHandle(block_size=block_size,
+                                       num_threads=num_threads)
+        self.aio = aio_handle
+        self._inflight: Dict[str, _Inflight] = {}
+        self._meta: Dict[str, Tuple[Tuple[int, ...], np.dtype]] = {}
+        # reference AsyncTensorSwapper accounting
+        self.num_elements_swapped = 0
+
+    def _path(self, name: str) -> str:
+        return os.path.join(self.swap_folder, name.replace("/", "__") + ".swp")
+
+    def swap_out(self, name: str, array: np.ndarray, async_op: bool = True) -> None:
+        """Write ``array`` to storage; the array must stay alive until
+        synchronize() when async."""
+        self.synchronize(name)  # a pending op on this name must not race us
+        array = np.ascontiguousarray(array)
+        self._meta[name] = (array.shape, array.dtype)
+        rid = self.aio.async_pwrite(array, self._path(name))
+        self._inflight[name] = _Inflight(rid, array, write=True)
+        self.num_elements_swapped += array.size
+        if not async_op:
+            self.synchronize(name)
+
+    def swap_in(self, name: str, async_op: bool = True,
+                out: Optional[np.ndarray] = None) -> Optional[np.ndarray]:
+        """Read the named tensor back. With async_op, returns None and the
+        result is claimed via wait_in()."""
+        assert name in self._meta, f"'{name}' was never swapped out"
+        self.synchronize(name)  # complete any pending write before reading
+        shape, dtype = self._meta[name]
+        buf = out if out is not None else np.empty(shape, dtype)
+        rid = self.aio.async_pread(buf, self._path(name))
+        self._inflight[name] = _Inflight(rid, buf, write=False)
+        if async_op:
+            return None
+        return self.wait_in(name)
+
+    def wait_in(self, name: str) -> np.ndarray:
+        fl = self._inflight.pop(name)
+        assert not fl.write, f"wait_in('{name}') on a swap-out request"
+        self.aio.wait(fl.request_id)
+        return fl.buffer
+
+    def synchronize(self, name: Optional[str] = None) -> None:
+        """Complete one named request or all inflight IO."""
+        if name is not None:
+            fl = self._inflight.pop(name, None)
+            if fl is not None:
+                self.aio.wait(fl.request_id)
+            return
+        for n in list(self._inflight):
+            self.synchronize(n)
+
+    def contains(self, name: str) -> bool:
+        return name in self._meta
+
+    def meta(self, name: str) -> Tuple[Tuple[int, ...], np.dtype]:
+        """(shape, dtype) of a swapped-out tensor."""
+        assert name in self._meta, f"'{name}' was never swapped out"
+        return self._meta[name]
+
+    def release(self, name: str) -> None:
+        self.synchronize(name)
+        self._meta.pop(name, None)
+        try:
+            os.remove(self._path(name))
+        except OSError:
+            pass

@@ -1,0 +1,55 @@
+"""Reusable host swap buffers.
+
+Analog of the reference's pinned-buffer pool
+(csrc/aio/py_lib/deepspeed_pin_tensor.cpp + runtime/swap_tensor/utils.py
+SwapBufferPool/SwapBufferManager): fixed-count, fixed-size aligned numpy
+buffers recycled across swap operations so steady-state swapping does no
+allocation.  On TPU hosts there is no cudaHostRegister; page-aligned numpy
+memory is what the dma/IO path wants.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+ALIGNMENT = 4096  # O_DIRECT-friendly
+
+
+def aligned_empty(nbytes: int, dtype=np.uint8) -> np.ndarray:
+    """Allocate a page-aligned 1-D buffer of at least nbytes."""
+    pad = ALIGNMENT
+    raw = np.empty(nbytes + pad, dtype=np.uint8)
+    off = (-raw.ctypes.data) % ALIGNMENT
+    return raw[off:off + nbytes].view(dtype)
+
+
+class SwapBufferPool:
+    """count × size pool with checkout/checkin semantics (reference
+    SwapBufferManager, runtime/swap_tensor/utils.py:115)."""
+
+    def __init__(self, buffer_size_bytes: int, count: int):
+        self.buffer_size = int(buffer_size_bytes)
+        self._free: List[np.ndarray] = [aligned_empty(self.buffer_size)
+                                        for _ in range(count)]
+        self._used: Dict[int, np.ndarray] = {}
+
+    def available(self) -> int:
+        return len(self._free)
+
+    def get(self, nbytes: int) -> Optional[np.ndarray]:
+        """Checkout a buffer view of exactly nbytes (None if exhausted or
+        oversized — caller falls back to a one-off allocation)."""
+        if nbytes > self.buffer_size or not self._free:
+            return None
+        buf = self._free.pop()
+        self._used[buf.ctypes.data] = buf
+        return buf[:nbytes]
+
+    def put(self, view: np.ndarray) -> None:
+        # checked-out views are prefix slices, so the view's data pointer is
+        # the pool buffer's start address regardless of dtype reshapes
+        buf = self._used.pop(view.ctypes.data, None)
+        if buf is not None:
+            self._free.append(buf)

@@ -1,0 +1,115 @@
+"""NVMe residency for ZeRO-3 parameter partitions.
+
+Reference analog: ``AsyncPartitionedParameterSwapper``
+(runtime/swap_tensor/partitioned_param_swapper.py:36) — each rank's shard of
+each parameter can live on fast storage instead of HBM/host RAM; shards are
+prefetched (async read into pooled buffers) ahead of use and released (or
+written back) after.  The reference tracks status on the torch Parameter
+(``ds_tensor.status``); here the swapper owns the status map keyed by param
+name, and the engine's host-offload path asks for shards around each
+sub-group optimizer step.
+"""
+
+from __future__ import annotations
+
+import os
+from enum import Enum
+from typing import Dict, Iterable, List, Optional
+
+import numpy as np
+
+from deepspeed_tpu.runtime.swap_tensor.async_swapper import AsyncTensorSwapper
+from deepspeed_tpu.runtime.swap_tensor.buffer_pool import SwapBufferPool
+
+
+class PartitionedParamStatus(Enum):
+    AVAILABLE = 1      # shard resident in host memory
+    NOT_AVAILABLE = 2  # shard on storage only
+    INFLIGHT = 3       # read submitted, not yet complete
+
+
+class AsyncPartitionedParameterSwapper:
+    def __init__(self, swap_folder: str, buffer_count: int = 5,
+                 buffer_size: int = int(1e8), aio_handle=None):
+        self.swapper = AsyncTensorSwapper(os.path.join(swap_folder, "params"),
+                                          aio_handle=aio_handle)
+        self.pool = SwapBufferPool(buffer_size, buffer_count)
+        self.status: Dict[str, PartitionedParamStatus] = {}
+        self._resident: Dict[str, np.ndarray] = {}
+        self._pooled: Dict[str, bool] = {}
+
+    # -- write path -------------------------------------------------------
+    def swap_out_and_release(self, name: str, shard: np.ndarray,
+                             async_op: bool = True) -> None:
+        """Persist a shard and drop host residency (reference
+        swap_out_and_release)."""
+        self.swapper.swap_out(name, shard, async_op=async_op)
+        if not async_op:
+            self._drop(name)
+        # async release happens at synchronize_writes()
+        self.status[name] = PartitionedParamStatus.NOT_AVAILABLE
+
+    def synchronize_writes(self) -> None:
+        self.swapper.synchronize()
+        for name, st in list(self.status.items()):
+            if st == PartitionedParamStatus.NOT_AVAILABLE:
+                self._drop(name)
+
+    # -- read path --------------------------------------------------------
+    def swap_in(self, names: Iterable[str], async_op: bool = True) -> None:
+        """Submit reads for shards (prefetch when async)."""
+        for name in names:
+            if self.status.get(name) in (PartitionedParamStatus.AVAILABLE,
+                                         PartitionedParamStatus.INFLIGHT):
+                continue
+            self._drop(name)  # recycle any stale resident buffer first
+            shape, dtype = self.swapper.meta(name)
+            nbytes = int(np.prod(shape)) * np.dtype(dtype).itemsize
+            buf = self.pool.get(nbytes)
+            pooled = buf is not None
+            out = buf.view(dtype).reshape(shape) if pooled else None
+            self.swapper.swap_in(name, async_op=True, out=out)
+            self.status[name] = PartitionedParamStatus.INFLIGHT
+            self._pooled[name] = pooled
+        if not async_op:
+            self.synchronize_reads()
+
+    def synchronize_reads(self) -> None:
+        for name in list(self.status):
+            self._complete_inflight(name)
+
+    def get(self, name: str) -> np.ndarray:
+        """Host array for an AVAILABLE shard (blocks if inflight)."""
+        self._complete_inflight(name)
+        assert self.status.get(name) == PartitionedParamStatus.AVAILABLE, \
+            f"shard '{name}' is not resident (status={self.status.get(name)})"
+        return self._resident[name]
+
+    def release(self, name: str) -> None:
+        """Drop host residency without touching storage."""
+        self._complete_inflight(name)
+        self._drop(name)
+        if name in self.swapper._meta:
+            self.status[name] = PartitionedParamStatus.NOT_AVAILABLE
+
+    def remove(self, name: str) -> None:
+        """Forget the shard entirely (storage + host)."""
+        self._complete_inflight(name)
+        self._drop(name)
+        self.swapper.release(name)
+        self.status.pop(name, None)
+
+    def _complete_inflight(self, name: str) -> None:
+        """An INFLIGHT read must finish before its buffer can be recycled."""
+        if self.status.get(name) == PartitionedParamStatus.INFLIGHT:
+            self._resident[name] = self.swapper.wait_in(name)
+            self.status[name] = PartitionedParamStatus.AVAILABLE
+
+    def available_swap_in_buffers(self) -> int:
+        return self.pool.available()
+
+    def _drop(self, name: str) -> None:
+        arr = self._resident.pop(name, None)
+        if arr is not None and self._pooled.pop(name, False):
+            base = arr.view(np.uint8).reshape(-1)
+            self.pool.put(base)
