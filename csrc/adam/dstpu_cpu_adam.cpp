@@ -1,0 +1,76 @@
+// dstpu_cpu_adam — vectorized host optimizer kernels for ZeRO-Offload.
+//
+// Reference analog: csrc/adam/cpu_adam.cpp + csrc/adagrad/cpu_adagrad.cpp —
+// the optimizer step for host-resident (offloaded) state.  The reference
+// hand-writes AVX2/AVX512 intrinsics; here the loops are written so the
+// compiler auto-vectorizes them (built with -O3 -mavx2/-mavx512f -fopenmp by
+// the native op builder), which reaches the same memory-bound roofline on
+// modern toolchains without per-ISA code paths.
+//
+// All arrays are dense fp32 host buffers (numpy-owned).  The fp32→bf16 copy
+// kernel produces the compute-dtype image that gets pushed back to the
+// device after the step (the reference's fp16 param copy, cpu_adam.h).
+
+#include <cmath>
+#include <cstddef>
+#include <cstdint>
+
+extern "C" {
+
+// One Adam/AdamW step over a flat shard.  step is the 1-based step count
+// AFTER this update (bias correction uses it directly).
+void dstpu_adam_step(float* params, const float* grads, float* exp_avg,
+                     float* exp_avg_sq, uint64_t n, int64_t step, float lr,
+                     float beta1, float beta2, float eps, float weight_decay,
+                     int adamw_mode, int bias_correction) {
+  float bc1 = 1.0f, bc2 = 1.0f;
+  if (bias_correction) {
+    bc1 = 1.0f - std::pow(beta1, (float)step);
+    bc2 = 1.0f - std::pow(beta2, (float)step);
+  }
+  const float one_m_b1 = 1.0f - beta1;
+  const float one_m_b2 = 1.0f - beta2;
+#pragma omp parallel for simd schedule(static)
+  for (int64_t i = 0; i < (int64_t)n; ++i) {
+    float g = grads[i];
+    if (weight_decay > 0.0f && !adamw_mode) g += weight_decay * params[i];
+    float m = beta1 * exp_avg[i] + one_m_b1 * g;
+    float v = beta2 * exp_avg_sq[i] + one_m_b2 * g * g;
+    exp_avg[i] = m;
+    exp_avg_sq[i] = v;
+    float update = (m / bc1) / (std::sqrt(v / bc2) + eps);
+    if (weight_decay > 0.0f && adamw_mode) update += weight_decay * params[i];
+    params[i] -= lr * update;
+  }
+}
+
+void dstpu_adagrad_step(float* params, const float* grads, float* sum_sq,
+                        uint64_t n, float lr, float eps, float weight_decay) {
+#pragma omp parallel for simd schedule(static)
+  for (int64_t i = 0; i < (int64_t)n; ++i) {
+    float g = grads[i];
+    if (weight_decay > 0.0f) g += weight_decay * params[i];
+    float s = sum_sq[i] + g * g;
+    sum_sq[i] = s;
+    params[i] -= lr * g / (std::sqrt(s) + eps);
+  }
+}
+
+// fp32 → bf16 (round-to-nearest-even), for pushing compute-dtype params back
+// to the device.
+void dstpu_copy_f32_to_bf16(const float* src, uint16_t* dst, uint64_t n) {
+#pragma omp parallel for simd schedule(static)
+  for (int64_t i = 0; i < (int64_t)n; ++i) {
+    uint32_t bits;
+    __builtin_memcpy(&bits, &src[i], 4);
+    if ((bits & 0x7F800000u) == 0x7F800000u && (bits & 0x007FFFFFu)) {
+      dst[i] = 0x7FC0;  // NaN: RNE carry could silently flip it to +/-0 or Inf
+      continue;
+    }
+    uint32_t lsb = (bits >> 16) & 1u;
+    uint32_t rounded = bits + 0x7FFFu + lsb;
+    dst[i] = (uint16_t)(rounded >> 16);
+  }
+}
+
+}  // extern "C"

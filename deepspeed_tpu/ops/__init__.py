@@ -121,3 +121,19 @@ class AsyncIOBuilder(_NativeBuilderProxy):
         from deepspeed_tpu.ops import aio
 
         return aio
+
+
+@register_op_builder
+class CPUAdamNativeBuilder(_NativeBuilderProxy):
+    """Native vectorized host Adam/Adagrad kernels (reference csrc/adam/
+    cpu_adam.cpp); used by the ZeRO-Offload host optimizer step."""
+
+    NAME = "cpu_adam_native"
+    SOURCES = ["adam/dstpu_cpu_adam.cpp"]
+    WANT_OPENMP = True
+    WANT_SIMD = True
+
+    def load(self):
+        from deepspeed_tpu.ops import cpu_adam_native
+
+        return cpu_adam_native

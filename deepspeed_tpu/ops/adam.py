@@ -81,19 +81,16 @@ class FusedAdam:
 
         def upd(p, g, m, v):
             g = g.astype(m.dtype)
+            if self.weight_decay > 0.0 and not self.adam_w_mode:
+                # L2 mode folds decay into the gradient before the moments
+                g = g + self.weight_decay * p.astype(g.dtype)
             m_new = b1 * m + (1.0 - b1) * g
             v_new = b2 * v + (1.0 - b2) * (g * g)
             m_hat = m_new / bc1
             v_hat = v_new / bc2
             update = m_hat / (jnp.sqrt(v_hat) + self.eps)
-            if self.weight_decay > 0.0:
-                if self.adam_w_mode:
-                    update = update + self.weight_decay * p.astype(update.dtype)
-                else:
-                    # L2 mode folds decay into the gradient: approximated by
-                    # adding decay*p to the update pre-moment in the reference;
-                    # here applied on the update for the same fixed point.
-                    update = update + self.weight_decay * p.astype(update.dtype)
+            if self.weight_decay > 0.0 and self.adam_w_mode:
+                update = update + self.weight_decay * p.astype(update.dtype)
             p_new = p.astype(jnp.float32) - lr * update
             return p_new.astype(p.dtype), m_new, v_new
 

@@ -1,0 +1,81 @@
+"""ctypes surface over the native CPU optimizer kernels
+(csrc/adam/dstpu_cpu_adam.cpp; reference ops/adam/cpu_adam.py:13
+DeepSpeedCPUAdam binding).
+
+Operates in place on flat fp32 numpy buffers — the host-resident master
+params and moments of the ZeRO-Offload path (runtime/zero/offload.py).
+"""
+
+from __future__ import annotations
+
+import ctypes
+
+import numpy as np
+
+_LIB = None
+
+
+def _lib() -> ctypes.CDLL:
+    global _LIB
+    if _LIB is None:
+        from deepspeed_tpu.ops import CPUAdamNativeBuilder
+
+        lib = CPUAdamNativeBuilder().load_library()
+        lib.dstpu_adam_step.argtypes = [
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
+            ctypes.c_uint64, ctypes.c_int64, ctypes.c_float, ctypes.c_float,
+            ctypes.c_float, ctypes.c_float, ctypes.c_float, ctypes.c_int,
+            ctypes.c_int]
+        lib.dstpu_adagrad_step.argtypes = [
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
+            ctypes.c_uint64, ctypes.c_float, ctypes.c_float, ctypes.c_float]
+        lib.dstpu_copy_f32_to_bf16.argtypes = [
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_uint64]
+        _LIB = lib
+    return _LIB
+
+
+def _ptr(a: np.ndarray):
+    assert a.flags["C_CONTIGUOUS"]
+    return a.ctypes.data_as(ctypes.c_void_p)
+
+
+def available() -> bool:
+    from deepspeed_tpu.ops import get_op_builder
+
+    return get_op_builder("cpu_adam_native")().is_compatible()
+
+
+def adam_step(params: np.ndarray, grads: np.ndarray, exp_avg: np.ndarray,
+              exp_avg_sq: np.ndarray, step: int, lr: float,
+              betas=(0.9, 0.999), eps: float = 1e-8,
+              weight_decay: float = 0.0, adamw_mode: bool = True,
+              bias_correction: bool = True) -> None:
+    """In-place Adam/AdamW on flat fp32 host buffers. ``step`` is the 1-based
+    count including this update."""
+    for a in (params, grads, exp_avg, exp_avg_sq):
+        assert a.dtype == np.float32 and a.size == params.size
+    _lib().dstpu_adam_step(_ptr(params), _ptr(grads), _ptr(exp_avg),
+                           _ptr(exp_avg_sq), params.size, step, lr,
+                           betas[0], betas[1], eps, weight_decay,
+                           int(adamw_mode), int(bias_correction))
+
+
+def adagrad_step(params: np.ndarray, grads: np.ndarray, sum_sq: np.ndarray,
+                 lr: float, eps: float = 1e-10,
+                 weight_decay: float = 0.0) -> None:
+    for a in (params, grads, sum_sq):
+        assert a.dtype == np.float32 and a.size == params.size
+    _lib().dstpu_adagrad_step(_ptr(params), _ptr(grads), _ptr(sum_sq),
+                              params.size, lr, eps, weight_decay)
+
+
+def copy_f32_to_bf16(src: np.ndarray) -> np.ndarray:
+    """fp32 → bf16 image (as uint16 bit pattern viewed via ml_dtypes)."""
+    assert src.dtype == np.float32
+    out = np.empty(src.shape, np.uint16)
+    _lib().dstpu_copy_f32_to_bf16(_ptr(np.ascontiguousarray(src)), _ptr(out),
+                                  src.size)
+    import ml_dtypes
+
+    return out.view(ml_dtypes.bfloat16)

@@ -65,6 +65,12 @@ def save_engine_checkpoint(engine, save_dir: str, tag: Optional[str] = None,
         "scaler": state.scaler,
         "__meta__": {"global_step": int(jax.device_get(state.global_step))},
     }
+    host_opt = getattr(engine, "_host_opt", None)
+    if host_opt is not None:
+        # ZeRO-Offload: the authoritative fp32 masters + moments are host-side
+        hsd = host_opt.state_dict()
+        state_dict["host_opt"] = hsd["state"]
+        state_dict["__meta__"]["host_opt_step"] = hsd["step"]
     ckpt_engine.save(state_dict, path)
 
     cs = {
@@ -109,7 +115,17 @@ def load_engine_checkpoint(engine, load_dir: str, tag: Optional[str] = None,
 
     params, missing_p = _unflatten_into(engine.state.params, loaded.get("params", {}))
     params = jax.device_put(params, engine.master_shardings)
-    if load_optimizer_states and not load_module_only and "opt_state" in loaded:
+    host_opt = getattr(engine, "_host_opt", None)
+    if load_optimizer_states and not load_module_only and host_opt is not None \
+            and "host_opt" in loaded:
+        template = host_opt.state_dict()["state"]
+        hstate, _ = _unflatten_into(template, loaded["host_opt"], strict=False)
+        host_opt.load_state_dict({
+            "step": int(loaded.get("__meta__", {}).get("host_opt_step", 0)),
+            "state": hstate})
+        opt_state = engine.state.opt_state
+    elif load_optimizer_states and not load_module_only and "opt_state" in loaded \
+            and engine.opt_shardings is not None and engine.opt_shardings != {}:
         opt_state, _ = _unflatten_into(engine.state.opt_state, loaded["opt_state"],
                                        strict=False)
         opt_state = jax.device_put(opt_state, engine.opt_shardings)

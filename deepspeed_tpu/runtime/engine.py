@@ -123,6 +123,20 @@ class DeepSpeedEngine:
         if optimizer is None:
             optimizer = build_optimizer("adam", {"lr": 1e-3})
         self.optimizer = optimizer
+
+        # ---- host (ZeRO-Offload/Infinity) optimizer: fp32 master + moments in
+        # host RAM or on NVMe, step on CPU via the native kernel
+        # (reference stage_1_and_2.py:1031 cpu-offload, stage3.py:1735 + swap)
+        self._host_opt = None
+        if self.offload_optimizer:
+            from deepspeed_tpu.runtime.zero.offload import HostOffloadOptimizer
+
+            try:
+                self._host_opt = HostOffloadOptimizer(
+                    optimizer, zc.offload_optimizer, self.compute_dtype)
+            except ValueError as e:
+                log_dist(f"offload_optimizer: {e}; keeping device-state path",
+                         ranks=[0])
         self.client_lr_scheduler = lr_scheduler
         if lr_scheduler is None and config.scheduler_name is not None:
             lr_scheduler = build_lr_scheduler(config.scheduler_name,
@@ -195,9 +209,13 @@ class DeepSpeedEngine:
         mem_kind = "pinned_host" if (self.offload_optimizer and
                                      self.accelerator.name() == "tpu") else None
         self.master_shardings = self.plan.shardings(self.master_specs)
-        opt_state_shape = jax.eval_shape(self.optimizer.init, params_shape)
-        self.opt_specs = self._specs_like(opt_state_shape)
-        self.opt_shardings = self.plan.shardings(self.opt_specs, memory_kind=mem_kind)
+        if self._host_opt is None:
+            opt_state_shape = jax.eval_shape(self.optimizer.init, params_shape)
+            self.opt_specs = self._specs_like(opt_state_shape)
+            self.opt_shardings = self.plan.shardings(self.opt_specs, memory_kind=mem_kind)
+        else:  # optimizer state lives host-side in self._host_opt
+            self.opt_specs = None
+            self.opt_shardings = {}
         self._replicated = NamedSharding(mesh, P())
         self.state_shardings = TrainState(
             params=self.master_shardings,
@@ -235,8 +253,20 @@ class DeepSpeedEngine:
     def _init_state(self) -> TrainState:
         init_params = jax.jit(self.module.init, out_shardings=self.master_shardings)
         params = init_params(self._init_rng)
-        opt_state = jax.jit(self.optimizer.init, out_shardings=self.opt_shardings)(params)
+        self._params_treedef = jax.tree_util.tree_structure(params)
         scaler_state = self.loss_scaler.init()
+        if self._host_opt is not None:
+            # masters go to host; device keeps only the compute-dtype image
+            self._host_opt.init(params)
+            cast = jax.jit(
+                lambda p: jax.tree_util.tree_map(
+                    lambda x: x.astype(self.compute_dtype)
+                    if x.dtype == jnp.float32 else x, p),
+                out_shardings=self.master_shardings, donate_argnums=0)
+            return TrainState(params=cast(params), opt_state={},
+                              scaler=scaler_state,
+                              global_step=jnp.zeros((), jnp.int32))
+        opt_state = jax.jit(self.optimizer.init, out_shardings=self.opt_shardings)(params)
         return TrainState(params=params, opt_state=opt_state, scaler=scaler_state,
                           global_step=jnp.zeros((), jnp.int32))
 
@@ -289,29 +319,86 @@ class DeepSpeedEngine:
                                global_step=state.global_step + 1 - overflow.astype(jnp.int32))
         return new_state, overflow, norm
 
+    # ---------------------------------------------------- shared step pieces
+    def _scan_micro_grads(self, state: TrainState, batch, rng):
+        """Grad-accumulation scan over the gas microbatches (shared by the
+        fused device step and the host-offload grad step)."""
+        scale = state.scaler.cur_scale
+
+        def micro(carry, mb_and_i):
+            grads_acc, loss_acc = carry
+            mb, i = mb_and_i
+            sub = jax.random.fold_in(rng, i)
+            _, grads, metrics = self._micro_loss_and_grads(
+                state.params, mb, scale, sub)
+            grads_acc = jax.tree_util.tree_map(jnp.add, grads_acc, grads)
+            return (grads_acc, loss_acc + metrics["loss"]), None
+
+        grads0 = jax.tree_util.tree_map(
+            lambda p, s: jax.lax.with_sharding_constraint(
+                jnp.zeros(p.shape, jnp.float32), NamedSharding(self.mesh, s)),
+            state.params, self.grad_specs)
+        (grads, loss_sum), _ = jax.lax.scan(
+            micro, (grads0, jnp.zeros((), jnp.float32)),
+            (batch, jnp.arange(self.gas)))
+        return grads, loss_sum
+
+    def _unscale_epilogue(self, grads, scaler):
+        """gas-mean + loss-scale unscale + overflow/norm (shared epilogue of
+        both host-step entry points)."""
+        inv = 1.0 / (self.gas * scaler.cur_scale)
+        grads = jax.tree_util.tree_map(lambda g: g * inv, grads)
+        overflow = has_inf_or_nan(grads) if self.fp16_enabled \
+            else jnp.zeros((), bool)
+        return grads, overflow, global_grad_norm(grads)
+
+    # ---------------------------------------------------- host (offload) step
+    def _build_grad_step(self):
+        """Compiled grad-accumulation-only step for the host-optimizer path:
+        returns mean unscaled grads + metrics; the optimizer update happens
+        on the CPU (ZeRO-Offload semantics)."""
+
+        def grad_step(state: TrainState, batch, rng):
+            grads, loss_sum = self._scan_micro_grads(state, batch, rng)
+            grads, overflow, norm = self._unscale_epilogue(grads, state.scaler)
+            metrics = {"loss": loss_sum / self.gas, "overflow": overflow,
+                       "grad_norm": norm, "loss_scale": state.scaler.cur_scale}
+            return grads, metrics
+
+        self._compiled_grad_step = jax.jit(grad_step)
+        return self._compiled_grad_step
+
+    def _host_apply(self, grads, overflow: bool, norm: float, lr):
+        """CPU optimizer update on host masters; push compute-dtype params
+        back (reference cpu-offload step: grads→CPU, Adam, params→device)."""
+        new_scaler = jax.device_put(
+            self.loss_scaler.update(self.state.scaler, jnp.asarray(overflow)),
+            jax.tree_util.tree_map(lambda _: self._replicated, self.state.scaler))
+        if overflow:
+            self.skipped_steps += 1
+            self.state = self.state._replace(scaler=new_scaler)
+            return
+        clip = self.config.gradient_clipping
+        factor = min(1.0, clip / (norm + 1e-6)) if clip and clip > 0 else 1.0
+        flat, _ = jax.tree_util.tree_flatten_with_path(grads)
+        host_leaves = jax.device_get([leaf for _, leaf in flat])  # one batched D2H
+        grads_host = {jax.tree_util.keystr(path): leaf
+                      for (path, _), leaf in zip(flat, host_leaves)}
+        out = self._host_opt.step(grads_host, lr=float(np.asarray(lr)),
+                                  grad_scale=factor)
+        new_params = jax.tree_util.tree_unflatten(
+            self._params_treedef, [out[n] for n in self._host_opt._names])
+        self.state = TrainState(
+            params=jax.device_put(new_params, self.master_shardings),
+            opt_state={}, scaler=new_scaler,
+            global_step=self.state.global_step + 1)
+
     # -------------------------------------------------------- fused train step
     def _build_train_step(self):
         gas = self.gas
 
         def train_step(state: TrainState, batch, lr, rng):
-            scale = state.scaler.cur_scale
-
-            def micro(carry, mb_and_i):
-                grads_acc, loss_acc = carry
-                mb, i = mb_and_i
-                sub = jax.random.fold_in(rng, i)
-                scaled_loss, grads, metrics = self._micro_loss_and_grads(
-                    state.params, mb, scale, sub)
-                grads_acc = jax.tree_util.tree_map(jnp.add, grads_acc, grads)
-                return (grads_acc, loss_acc + metrics["loss"]), None
-
-            grads0 = jax.tree_util.tree_map(
-                lambda p, s: jax.lax.with_sharding_constraint(
-                    jnp.zeros(p.shape, jnp.float32), NamedSharding(self.mesh, s)),
-                state.params, self.grad_specs)
-            (grads, loss_sum), _ = jax.lax.scan(
-                micro, (grads0, jnp.zeros((), jnp.float32)),
-                (batch, jnp.arange(gas)))
+            grads, loss_sum = self._scan_micro_grads(state, batch, rng)
             grads = jax.tree_util.tree_map(lambda g: g / gas, grads)
             new_state, overflow, norm = self._apply_grads(state, grads, lr)
             metrics = {"loss": loss_sum / gas, "overflow": overflow, "grad_norm": norm,
@@ -353,6 +440,8 @@ class DeepSpeedEngine:
         return self._run_fused_step(batch)
 
     def _run_fused_step(self, batch):
+        if self._host_opt is not None:
+            return self._run_host_step(batch)
         if self._compiled_train_step is None:
             self._build_train_step()
         self.tput_timer.start()
@@ -361,6 +450,28 @@ class DeepSpeedEngine:
         rng = jax.random.fold_in(self._dropout_rng, self.global_steps)
         batch = jax.device_put(batch, self._gas_batch_shardings(batch))
         self.state, metrics = self._compiled_train_step(self.state, batch, lr, rng)
+        self._global_grad_norm = metrics["grad_norm"]
+        self.micro_steps += self.gas
+        self.global_steps += 1
+        if self.lr_scheduler is not None:
+            self.lr_scheduler.step()
+        self._after_step(metrics)
+        self.timers(TRAIN_BATCH_TIMER).stop(record=True)
+        self.tput_timer.stop(global_step=True)
+        return metrics["loss"]
+
+    def _run_host_step(self, batch):
+        if getattr(self, "_compiled_grad_step", None) is None:
+            self._build_grad_step()
+        self.tput_timer.start()
+        self.timers(TRAIN_BATCH_TIMER).start()
+        lr = self.get_lr()[0]
+        rng = jax.random.fold_in(self._dropout_rng, self.global_steps)
+        batch = jax.device_put(batch, self._gas_batch_shardings(batch))
+        grads, metrics = self._compiled_grad_step(self.state, batch, rng)
+        overflow = bool(jax.device_get(metrics["overflow"]))
+        norm = float(jax.device_get(metrics["grad_norm"]))
+        self._host_apply(grads, overflow, norm, lr)
         self._global_grad_norm = metrics["grad_norm"]
         self.micro_steps += self.gas
         self.global_steps += 1
@@ -436,6 +547,26 @@ class DeepSpeedEngine:
         """Apply optimizer at gas boundary (reference step:1951)."""
         self.timers(STEP_GLOBAL_TIMER).start()
         at_boundary = self.is_gradient_accumulation_boundary()
+        if at_boundary and self._host_opt is not None:
+            assert self._acc_count == self.gas, (
+                f"step() at boundary needs {self.gas} backward() calls, "
+                f"got {self._acc_count}")
+            if getattr(self, "_compiled_prep_grads", None) is None:
+                self._compiled_prep_grads = jax.jit(
+                    self._unscale_epilogue, donate_argnums=(0,))
+            grads, overflow, norm = self._compiled_prep_grads(
+                self._grad_acc, self.state.scaler)
+            self._host_apply(grads, bool(jax.device_get(overflow)),
+                             float(jax.device_get(norm)), self.get_lr()[0])
+            self._grad_acc = None
+            self._acc_count = 0
+            self._global_grad_norm = norm
+            self.global_steps += 1
+            if self.lr_scheduler is not None:
+                self.lr_scheduler.step()
+            self.micro_steps += 1
+            self.timers(STEP_GLOBAL_TIMER).stop()
+            return
         if at_boundary:
             assert self._acc_count == self.gas, (
                 f"step() at boundary needs {self.gas} backward() calls, "
