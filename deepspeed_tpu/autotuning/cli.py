@@ -1,0 +1,118 @@
+"""CLI autotuning driver (`dstpu --autotuning=tune ...`).
+
+Reference analog: ``launcher/runner.py:348 run_autotuning`` →
+``Autotuner.tune`` (autotuning/autotuner.py:404): generate candidate configs
+(ZeRO stage × micro-batch), run the user script once per experiment as a
+subprocess, collect each run's reported metric, pick the best config.
+
+Experiment contract: the child runs with
+  DSTPU_AUTOTUNING_CONFIG=<path>  — config overrides (json) to merge
+  DSTPU_AUTOTUNING_RESULT=<path>  — child writes {"metric": float} here
+(the engine writes the result automatically when it sees the env var; user
+scripts can also write it directly).  Results land in
+``autotuning_results/`` with the winning config in ``autotuning_results/
+best_config.json`` (reference autotuner output layout).
+"""
+
+from __future__ import annotations
+
+import itertools
+import json
+import os
+import subprocess
+import sys
+import time
+from typing import Dict, List, Optional
+
+from deepspeed_tpu.utils.logging import logger
+
+DEFAULT_MICRO_BATCHES = (1, 2, 4, 8)
+DEFAULT_ZERO_STAGES = (0, 1, 2, 3)
+
+
+def build_experiment_space(micro_batches=DEFAULT_MICRO_BATCHES,
+                           zero_stages=DEFAULT_ZERO_STAGES) -> List[Dict]:
+    """Candidate config overrides (the reference's tuning-space templates,
+    autotuning/config_templates/)."""
+    return [{"zero_optimization": {"stage": stage},
+             "train_micro_batch_size_per_gpu": mb}
+            for stage, mb in itertools.product(zero_stages, micro_batches)]
+
+
+def run_experiment(cmd: List[str], overrides: Dict, exp_dir: str,
+                   timeout_s: float = 600.0) -> Optional[float]:
+    """Run one candidate; returns its metric (higher is better) or None."""
+    os.makedirs(exp_dir, exist_ok=True)
+    cfg_path = os.path.join(exp_dir, "overrides.json")
+    result_path = os.path.join(exp_dir, "result.json")
+    with open(cfg_path, "w") as f:
+        json.dump(overrides, f)
+    env = os.environ.copy()
+    env["DSTPU_AUTOTUNING_CONFIG"] = cfg_path
+    env["DSTPU_AUTOTUNING_RESULT"] = result_path
+    try:
+        proc = subprocess.run(cmd, env=env, capture_output=True, text=True,
+                              timeout=timeout_s)
+    except subprocess.TimeoutExpired:
+        logger.warning(f"experiment {exp_dir}: timed out")
+        return None
+    with open(os.path.join(exp_dir, "stdout.log"), "w") as f:
+        f.write(proc.stdout)
+    with open(os.path.join(exp_dir, "stderr.log"), "w") as f:
+        f.write(proc.stderr)
+    if proc.returncode != 0:
+        logger.warning(f"experiment {exp_dir}: exit {proc.returncode} "
+                       f"(often OOM/invalid combo — pruned)")
+        return None
+    if not os.path.exists(result_path):
+        logger.warning(f"experiment {exp_dir}: no result file written")
+        return None
+    with open(result_path) as f:
+        return float(json.load(f)["metric"])
+
+
+def run_autotuning(args, active_resources, experiments: Optional[List[Dict]] = None,
+                   results_dir: str = "autotuning_results") -> Optional[str]:
+    """Drive the experiment sweep (reference Autotuner.tune:404).
+
+    Experiments run on the LOCAL node through the per-node launcher (all
+    local slots), which is how throughput-representative profiling works on
+    a TPU host; the caller (launcher/runner.py) then launches the best
+    config on the full resource pool when ``--autotuning=run``.
+
+    Returns the path to the winning overrides file, or None if every
+    experiment failed.
+    """
+    experiments = experiments or build_experiment_space()
+    # route through the per-node launcher so experiments see the same rank
+    # env/world as a real single-node run
+    from deepspeed_tpu.launcher.runner import build_launch_command
+
+    local_host = next(iter(active_resources))
+    local = {local_host: active_resources[local_host]}
+    cmd = build_launch_command(args, local, node_rank=0, host=local_host)
+    best_metric, best_cfg = None, None
+    os.makedirs(results_dir, exist_ok=True)
+    records = []
+    for i, overrides in enumerate(experiments):
+        exp_dir = os.path.join(results_dir, f"exp_{i}")
+        t0 = time.time()
+        metric = run_experiment(cmd, overrides, exp_dir)
+        records.append({"exp": i, "overrides": overrides, "metric": metric,
+                        "wall_s": round(time.time() - t0, 2)})
+        logger.info(f"autotuning exp {i}/{len(experiments)}: "
+                    f"{overrides} -> {metric}")
+        if metric is not None and (best_metric is None or metric > best_metric):
+            best_metric, best_cfg = metric, overrides
+    with open(os.path.join(results_dir, "summary.json"), "w") as f:
+        json.dump(records, f, indent=2)
+    if best_cfg is None:
+        logger.error("autotuning: no experiment produced a metric")
+        return None
+    with open(os.path.join(results_dir, "best_config.json"), "w") as f:
+        json.dump({"metric": best_metric, "config": best_cfg}, f, indent=2)
+    best_path = os.path.join(results_dir, "best_overrides.json")
+    with open(best_path, "w") as f:
+        json.dump(best_cfg, f)
+    logger.info(f"autotuning: best {best_metric} with {best_cfg}")
+    return best_path

@@ -1,0 +1,92 @@
+"""Collective micro-benchmark (`dstpu_bench`).
+
+Reference analog: ``bin/ds_bench`` → deepspeed communication benchmarks —
+sweep message sizes through the collectives and report algorithm/bus
+bandwidth.  Here each collective is a jitted `shard_map` program over the
+local mesh, so the numbers reflect the real XLA/ICI path the framework
+trains with.
+"""
+
+from __future__ import annotations
+
+import argparse
+import time
+from typing import List
+
+
+def _human(nbytes: float) -> str:
+    for unit in ("B", "KB", "MB", "GB"):
+        if nbytes < 1024:
+            return f"{nbytes:.1f}{unit}"
+        nbytes /= 1024
+    return f"{nbytes:.1f}TB"
+
+
+def run_collective_bench(op: str = "all_reduce", sizes: List[int] = None,
+                         trials: int = 10, dtype_str: str = "float32"):
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, PartitionSpec as P
+    from jax.experimental.shard_map import shard_map
+
+    devices = jax.devices()
+    n = len(devices)
+    mesh = Mesh(devices, ("x",))
+    dtype = getattr(jnp, dtype_str)
+    sizes = sizes or [2 ** p for p in range(12, 27, 2)]  # 4KB..512MB elems/4
+    results = []
+    for numel in sizes:
+        x = jnp.ones((n, numel // n if op != "all_gather" else numel), dtype)
+
+        if op == "all_reduce":
+            fn = shard_map(lambda a: jax.lax.psum(a, "x"), mesh=mesh,
+                           in_specs=P("x"), out_specs=P("x"))
+        elif op == "all_gather":
+            fn = shard_map(lambda a: jax.lax.all_gather(a, "x", tiled=True),
+                           mesh=mesh, in_specs=P("x"), out_specs=P())
+        elif op == "reduce_scatter":
+            fn = shard_map(lambda a: jax.lax.psum_scatter(a, "x", tiled=True),
+                           mesh=mesh, in_specs=P("x"), out_specs=P("x"))
+        elif op == "all_to_all":
+            fn = shard_map(lambda a: jax.lax.all_to_all(
+                a.reshape(n, -1), "x", 0, 0, tiled=False).reshape(a.shape),
+                mesh=mesh, in_specs=P("x"), out_specs=P("x"))
+        else:
+            raise ValueError(f"unknown op '{op}'")
+        jfn = jax.jit(fn)
+        jax.block_until_ready(jfn(x))  # compile + warm
+        t0 = time.perf_counter()
+        for _ in range(trials):
+            out = jfn(x)
+        jax.block_until_ready(out)
+        dt = (time.perf_counter() - t0) / trials
+        nbytes = numel * x.dtype.itemsize
+        # bus bandwidth correction factors (NCCL-tests convention)
+        factor = {"all_reduce": 2 * (n - 1) / n, "all_gather": (n - 1) / n,
+                  "reduce_scatter": (n - 1) / n, "all_to_all": (n - 1) / n}[op]
+        busbw = nbytes * factor / dt
+        results.append((numel, nbytes, dt, busbw))
+    return results
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(description="dstpu collective benchmark")
+    parser.add_argument("--op", default="all_reduce",
+                        choices=["all_reduce", "all_gather", "reduce_scatter",
+                                 "all_to_all"])
+    parser.add_argument("--trials", type=int, default=10)
+    parser.add_argument("--dtype", default="float32")
+    parser.add_argument("--maxsize", type=int, default=24,
+                        help="max message size as log2(elements)")
+    args = parser.parse_args(argv)
+    sizes = [2 ** p for p in range(12, args.maxsize + 1, 2)]
+    print(f"{'size':>10} {'bytes':>10} {'time(us)':>12} {'busbw(GB/s)':>12}")
+    for numel, nbytes, dt, busbw in run_collective_bench(
+            args.op, sizes, args.trials, args.dtype):
+        print(f"{numel:>10} {_human(nbytes):>10} {dt * 1e6:>12.1f} "
+              f"{busbw / 1e9:>12.2f}")
+    return 0
+
+
+if __name__ == "__main__":
+    main()

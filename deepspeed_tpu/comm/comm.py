@@ -118,11 +118,29 @@ def init_distributed(dist_backend: Optional[str] = None, auto_mpi_discovery: boo
         return
     coord = os.environ.get("DSTPU_COORDINATOR_ADDRESS") or os.environ.get(
         "JAX_COORDINATOR_ADDRESS")
-    if coord:
+
+    def _env_int(default: int, *names: str) -> int:
+        for n in names:
+            v = os.environ.get(n)
+            if v is not None:
+                return int(v)
+        return default
+
+    # process id/world discovery: dstpu per-node launcher env first, then the
+    # MPI/Slurm runtime's own vars (reference mpi_discovery, comm/comm.py:591)
+    nprocs = _env_int(world_size if world_size > 0 else 1,
+                      "DSTPU_NUM_PROCESSES", "OMPI_COMM_WORLD_SIZE",
+                      "PMI_SIZE", "SLURM_NPROCS")
+    pid = _env_int(rank if rank >= 0 else 0,
+                   "DSTPU_PROCESS_ID", "OMPI_COMM_WORLD_RANK", "PMI_RANK",
+                   "SLURM_PROCID")
+    # single-process launches (dstpu --num_gpus 1) need no rendezvous, and
+    # jax.distributed.initialize would fail if the backend is already up
+    if coord and nprocs > 1:
         jax.distributed.initialize(
             coordinator_address=coord,
-            num_processes=int(os.environ.get("DSTPU_NUM_PROCESSES", world_size if world_size > 0 else 1)),
-            process_id=int(os.environ.get("DSTPU_PROCESS_ID", rank if rank >= 0 else 0)),
+            num_processes=nprocs,
+            process_id=pid,
         )
     backend = dist_backend or get_accelerator().communication_backend_name()
     if verbose:

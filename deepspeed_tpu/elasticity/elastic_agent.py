@@ -1,0 +1,47 @@
+"""Elastic agent — restart-on-failure worker supervision.
+
+Reference analog: ``DSElasticAgent(LocalElasticAgent)``
+(elasticity/elastic_agent.py:28, torchelastic integration): when any worker
+dies, tear the group down and restart it (up to ``max_restarts``), letting
+the job resume from its latest checkpoint.  Paired with the batch-ladder
+(`compute_elastic_config`) and sharding-agnostic checkpoints, a restart on a
+different world size keeps the global batch valid — the TPU equivalent of
+elastic training.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable, List
+
+from deepspeed_tpu.utils.logging import logger
+
+
+class ElasticAgent:
+    def __init__(self, spawn_fn: Callable[[], List], monitor_fn: Callable,
+                 max_restarts: int = 3, restart_delay_s: float = 1.0):
+        self.spawn_fn = spawn_fn
+        self.monitor_fn = monitor_fn
+        self.max_restarts = max_restarts
+        self.restart_delay_s = restart_delay_s
+        self.restart_count = 0
+
+    def run(self) -> int:
+        """Supervise worker groups until clean exit or restart budget spent.
+        Returns the final exit code."""
+        while True:
+            procs = self.spawn_fn()
+            rc = self.monitor_fn(procs)
+            if rc == 0:
+                return 0
+            self.restart_count += 1
+            if self.restart_count > self.max_restarts:
+                logger.error(
+                    f"elastic agent: giving up after {self.max_restarts} "
+                    f"restarts (last exit code {rc})")
+                return rc
+            logger.warning(
+                f"elastic agent: worker group failed (exit {rc}); restart "
+                f"{self.restart_count}/{self.max_restarts} in "
+                f"{self.restart_delay_s}s")
+            time.sleep(self.restart_delay_s)

@@ -1,0 +1,87 @@
+"""`dstpu_report` — environment / op compatibility report.
+
+Reference analog: ``deepspeed/env_report.py`` (the `ds_report` tool): print
+framework versions, device inventory, and the op-builder compatibility
+matrix so users can see at a glance what the installation supports.
+"""
+
+from __future__ import annotations
+
+import importlib
+import sys
+
+GREEN_OK = "\033[92m[OKAY]\033[0m"
+RED_NO = "\033[91m[NO]\033[0m"
+
+
+def op_report(verbose: bool = False):
+    from deepspeed_tpu.ops import all_ops
+
+    lines = ["-" * 66,
+             "op name " + "." * 40 + " compatible",
+             "-" * 66]
+    for name, builder_cls in sorted(all_ops().items()):
+        try:
+            builder = builder_cls()
+            ok = builder.is_compatible(verbose=verbose)
+            reason = "" if ok else f"  ({builder.compatibility_reason()})"
+        except Exception as e:  # an op that cannot even probe is incompatible
+            ok, reason = False, f"  ({e})"
+        status = GREEN_OK if ok else RED_NO
+        lines.append(f"{name} {'.' * max(1, 48 - len(name))} {status}{reason}")
+    return "\n".join(lines)
+
+
+def version_report():
+    lines = ["-" * 66, "DeepSpeed-TPU general environment info:", "-" * 66]
+    import deepspeed_tpu
+
+    lines.append(f"deepspeed_tpu install path ... {deepspeed_tpu.__path__}")
+    lines.append(f"deepspeed_tpu version ........ {deepspeed_tpu.__version__}")
+    for mod in ("jax", "jaxlib", "flax", "optax", "numpy"):
+        try:
+            m = importlib.import_module(mod)
+            lines.append(f"{mod} version {'.' * max(1, 15 - len(mod))} "
+                         f"{getattr(m, '__version__', 'unknown')}")
+        except ImportError:
+            lines.append(f"{mod} ................ not installed")
+    lines.append(f"python version ....... {sys.version.split()[0]}")
+    return "\n".join(lines)
+
+
+def device_report():
+    lines = ["-" * 66, "Device / mesh info:", "-" * 66]
+    try:
+        import jax
+
+        lines.append(f"platform ............. {jax.default_backend()}")
+        lines.append(f"process count ........ {jax.process_count()}")
+        lines.append(f"device count ......... {jax.device_count()}")
+        for d in jax.devices()[:8]:
+            lines.append(f"  {d.id}: {d.device_kind} ({d.platform})")
+        if jax.device_count() > 8:
+            lines.append(f"  ... and {jax.device_count() - 8} more")
+    except Exception as e:
+        lines.append(f"jax backend unavailable: {e}")
+    return "\n".join(lines)
+
+
+def main(hide_operator_status: bool = False, hide_errors_and_warnings: bool = False):
+    if not hide_operator_status:
+        print(op_report(verbose=not hide_errors_and_warnings))
+    print(version_report())
+    print(device_report())
+
+
+def cli_main():
+    import argparse
+
+    parser = argparse.ArgumentParser(description="dstpu environment report")
+    parser.add_argument("--hide_operator_status", action="store_true")
+    parser.add_argument("--hide_errors_and_warnings", action="store_true")
+    args = parser.parse_args()
+    main(args.hide_operator_status, args.hide_errors_and_warnings)
+
+
+if __name__ == "__main__":
+    cli_main()

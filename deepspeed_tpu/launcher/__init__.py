@@ -1,0 +1,5 @@
+from deepspeed_tpu.launcher.runner import (
+    fetch_hostfile,
+    parse_inclusion_exclusion,
+    parse_resource_filter,
+)

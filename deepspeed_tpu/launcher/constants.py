@@ -1,0 +1,16 @@
+"""Launcher constants (reference deepspeed/launcher/constants.py)."""
+
+PDSH_LAUNCHER = "pdsh"
+OPENMPI_LAUNCHER = "openmpi"
+MPICH_LAUNCHER = "mpich"
+SLURM_LAUNCHER = "slurm"
+GCLOUD_LAUNCHER = "gcloud"  # TPU-VM pods: gcloud compute tpus tpu-vm ssh --worker=all
+
+DSTPU_ENVIRONMENT_NAME = ".dstpu_env"
+DSTPU_ENVIRONMENT_PATHS = [".", "~"]
+
+# rendezvous env contract consumed by comm.init_distributed
+COORDINATOR_ADDR_ENV = "DSTPU_COORDINATOR_ADDRESS"
+NUM_PROCESSES_ENV = "DSTPU_NUM_PROCESSES"
+PROCESS_ID_ENV = "DSTPU_PROCESS_ID"
+DEFAULT_COORDINATOR_PORT = 7777

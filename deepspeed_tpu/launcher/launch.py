@@ -1,0 +1,166 @@
+"""Per-node launcher — spawns the node's worker processes.
+
+Reference analog: ``deepspeed/launcher/launch.py:216 main``: decode world
+info, spawn one child per local slot with rank env vars, poll children, and
+kill the whole process tree if any rank fails (failure detection,
+launch.py:119 terminate_process_tree).  Here the env contract is the JAX
+rendezvous (DSTPU_COORDINATOR_ADDRESS / DSTPU_NUM_PROCESSES /
+DSTPU_PROCESS_ID) plus RANK/LOCAL_RANK/WORLD_SIZE for torch-style user code.
+
+``--elastic`` wraps the children in the restart-on-failure elastic agent
+(reference DSElasticAgent, elasticity/elastic_agent.py:28).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import signal
+import subprocess
+import sys
+import time
+from typing import Dict, List
+
+from deepspeed_tpu.launcher.constants import (
+    COORDINATOR_ADDR_ENV,
+    NUM_PROCESSES_ENV,
+    PROCESS_ID_ENV,
+)
+from deepspeed_tpu.launcher.runner import decode_world_info
+from deepspeed_tpu.utils.logging import logger
+
+
+def parse_args(args=None):
+    parser = argparse.ArgumentParser(description="dstpu per-node launcher")
+    parser.add_argument("--world_info", type=str, required=True,
+                        help="base64 {host: [slots]} map")
+    parser.add_argument("--node_rank", type=int, required=True)
+    parser.add_argument("--master_addr", type=str, required=True)
+    parser.add_argument("--master_port", type=int, required=True)
+    parser.add_argument("--elastic", action="store_true")
+    parser.add_argument("--max_restarts", type=int, default=3)
+    parser.add_argument("user_script", type=str)
+    parser.add_argument("user_args", nargs=argparse.REMAINDER)
+    return parser.parse_args(args=args)
+
+
+def build_rank_env(world_info: Dict[str, List[int]], node_rank: int,
+                   local_index: int, master_addr: str,
+                   master_port: int) -> Dict[str, str]:
+    """Env block for one worker process (reference launch.py rank env).
+
+    ``local_index`` is the position in the node's active slot list — ranks
+    are dense 0..world-1 even under non-contiguous ``--include`` filters;
+    the physical slot ids go to DSTPU_VISIBLE_SLOTS (the
+    CUDA_VISIBLE_DEVICES analog).
+    """
+    hosts = list(world_info.keys())
+    slots = world_info[hosts[node_rank]]
+    global_rank = sum(len(world_info[h]) for h in hosts[:node_rank]) + local_index
+    world_size = sum(len(s) for s in world_info.values())
+    return {
+        "RANK": str(global_rank),
+        "LOCAL_RANK": str(local_index),
+        "WORLD_SIZE": str(world_size),
+        "LOCAL_SIZE": str(len(slots)),
+        "NODE_RANK": str(node_rank),
+        "MASTER_ADDR": master_addr,
+        "MASTER_PORT": str(master_port),
+        "DSTPU_VISIBLE_SLOTS": ",".join(map(str, slots)),
+        COORDINATOR_ADDR_ENV: f"{master_addr}:{master_port}",
+        NUM_PROCESSES_ENV: str(world_size),
+        PROCESS_ID_ENV: str(global_rank),
+    }
+
+
+def terminate_process_tree(pid: int, timeout: float = 10.0):
+    """SIGTERM the process group, escalate to SIGKILL (reference
+    launch.py:119)."""
+    try:
+        pgid = os.getpgid(pid)
+    except ProcessLookupError:
+        return
+    try:
+        os.killpg(pgid, signal.SIGTERM)
+    except ProcessLookupError:
+        return
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        try:
+            os.killpg(pgid, 0)
+        except ProcessLookupError:
+            return
+        time.sleep(0.2)
+    try:
+        os.killpg(pgid, signal.SIGKILL)
+    except ProcessLookupError:
+        pass
+
+
+def spawn_workers(args, world_info) -> List[subprocess.Popen]:
+    hosts = list(world_info.keys())
+    local_slots = world_info[hosts[args.node_rank]]
+    procs = []
+    for local_index, slot in enumerate(local_slots):
+        env = os.environ.copy()
+        env.update(build_rank_env(world_info, args.node_rank, local_index,
+                                  args.master_addr, args.master_port))
+        cmd = [sys.executable, "-u", args.user_script] + list(args.user_args)
+        logger.info(f"launching rank {env['RANK']} (local {local_index}, "
+                    f"slot {slot}): {' '.join(cmd)}")
+        procs.append(subprocess.Popen(cmd, env=env,
+                                      start_new_session=True))
+    return procs
+
+
+def monitor(procs: List[subprocess.Popen], poll_interval: float = 1.0) -> int:
+    """Poll children; on any failure kill the remaining tree (reference
+    launch.py main loop). Returns the first nonzero exit code, else 0."""
+    alive = list(procs)
+    while alive:
+        time.sleep(poll_interval)
+        for p in list(alive):
+            rc = p.poll()
+            if rc is None:
+                continue
+            alive.remove(p)
+            if rc != 0:
+                logger.error(f"worker pid {p.pid} failed with exit code {rc}; "
+                             f"terminating remaining workers")
+                for other in alive:
+                    terminate_process_tree(other.pid)
+                return rc
+    return 0
+
+
+def main(args=None):
+    args = parse_args(args)
+    world_info = decode_world_info(args.world_info)
+    current: List[subprocess.Popen] = []
+
+    def handle(sig, frame):
+        for p in current:
+            terminate_process_tree(p.pid)
+        sys.exit(128 + sig)
+
+    signal.signal(signal.SIGINT, handle)
+    signal.signal(signal.SIGTERM, handle)
+
+    if args.elastic:
+        from deepspeed_tpu.elasticity.elastic_agent import ElasticAgent
+
+        def spawn_tracked():
+            current[:] = spawn_workers(args, world_info)
+            return current
+
+        agent = ElasticAgent(spawn_fn=spawn_tracked, monitor_fn=monitor,
+                             max_restarts=args.max_restarts)
+        rc = agent.run()
+    else:
+        current[:] = spawn_workers(args, world_info)
+        rc = monitor(current)
+    sys.exit(rc)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,263 @@
+"""`dstpu` CLI — cluster launch entry point.
+
+Reference analog: ``deepspeed/launcher/runner.py:377 main`` (the `deepspeed`
+CLI): parse a hostfile, apply ``--include/--exclude`` node/slot filters,
+pick a multinode runner (pdsh/mpi/slurm — plus a TPU-pod gcloud runner), and
+exec the per-node launcher with the world info embedded in the environment.
+
+TPU mapping: a "slot" is a worker process on a host (a TPU-VM worker drives
+all of its local chips through one JAX process, so slots-per-host defaults
+to 1); rendezvous is `jax.distributed.initialize` fed by
+DSTPU_COORDINATOR_ADDRESS / DSTPU_NUM_PROCESSES / DSTPU_PROCESS_ID instead
+of MASTER_ADDR + NCCL.
+"""
+
+from __future__ import annotations
+
+import argparse
+import base64
+import json
+import os
+import re
+import shlex
+import subprocess
+import sys
+from collections import OrderedDict
+from typing import Dict, List, Optional
+
+from deepspeed_tpu.launcher.constants import (
+    COORDINATOR_ADDR_ENV,
+    DEFAULT_COORDINATOR_PORT,
+    GCLOUD_LAUNCHER,
+    MPICH_LAUNCHER,
+    NUM_PROCESSES_ENV,
+    OPENMPI_LAUNCHER,
+    PDSH_LAUNCHER,
+    SLURM_LAUNCHER,
+)
+from deepspeed_tpu.utils.logging import logger
+
+
+def parse_args(args=None):
+    parser = argparse.ArgumentParser(
+        description="dstpu distributed launcher",
+        formatter_class=argparse.ArgumentDefaultsHelpFormatter)
+    parser.add_argument("-H", "--hostfile", type=str, default="/job/hostfile",
+                        help="Hostfile path: lines of '<host> slots=<n>'")
+    parser.add_argument("-i", "--include", type=str, default="",
+                        help="Node/slot filter, e.g. 'host1@host2:0,2'")
+    parser.add_argument("-e", "--exclude", type=str, default="",
+                        help="Node/slot exclusion filter (mutually exclusive "
+                             "with --include)")
+    parser.add_argument("--num_nodes", type=int, default=-1,
+                        help="Limit to first N nodes of the hostfile")
+    parser.add_argument("--num_gpus", "--num_chips", type=int, default=-1,
+                        dest="num_gpus", help="Worker processes per node")
+    parser.add_argument("--master_addr", type=str, default="",
+                        help="Coordinator address (default: first node)")
+    parser.add_argument("--master_port", type=int,
+                        default=DEFAULT_COORDINATOR_PORT,
+                        help="Coordinator port")
+    parser.add_argument("--launcher", type=str, default=PDSH_LAUNCHER,
+                        choices=[PDSH_LAUNCHER, OPENMPI_LAUNCHER,
+                                 MPICH_LAUNCHER, SLURM_LAUNCHER,
+                                 GCLOUD_LAUNCHER],
+                        help="Multinode launch backend")
+    parser.add_argument("--launcher_args", type=str, default="",
+                        help="Extra args for the launch backend")
+    parser.add_argument("--force_multi", action="store_true",
+                        help="Treat as multi-node even for one host")
+    parser.add_argument("--autotuning", type=str, default="",
+                        choices=["", "tune", "run"],
+                        help="Run the autotuner before/instead of training")
+    parser.add_argument("--elastic_training", action="store_true",
+                        help="Supervise workers with restart-on-failure "
+                             "(elastic agent)")
+    parser.add_argument("--max_restarts", type=int, default=3,
+                        help="Elastic: max worker restarts before giving up")
+    parser.add_argument("user_script", type=str, help="User training script")
+    parser.add_argument("user_args", nargs=argparse.REMAINDER,
+                        help="Arguments for the user script")
+    return parser.parse_args(args=args)
+
+
+def fetch_hostfile(hostfile_path: str) -> Optional["OrderedDict[str, int]"]:
+    """Parse '<hostname> slots=<n>' lines (reference fetch_hostfile:189).
+    Returns None when the file does not exist (single-node mode)."""
+    if not os.path.isfile(hostfile_path):
+        return None
+    resource_pool: "OrderedDict[str, int]" = OrderedDict()
+    with open(hostfile_path) as f:
+        for line in f:
+            line = line.strip()
+            if not line or line.startswith("#"):
+                continue
+            try:
+                hostname, slots = line.split()
+                _, slot_count = slots.split("=")
+                slot_count = int(slot_count)
+            except ValueError:
+                raise ValueError(f"Hostfile contains a bad entry: '{line}'")
+            if hostname in resource_pool:
+                raise ValueError(f"Hostfile contains multiple entries for "
+                                 f"{hostname}")
+            resource_pool[hostname] = slot_count
+    if not resource_pool:
+        raise ValueError(f"Hostfile '{hostfile_path}' is empty")
+    return resource_pool
+
+
+def _parse_hosts_string(spec: str) -> "OrderedDict[str, Optional[List[int]]]":
+    """'h1@h2:0,2@h3:1-3' → {h1: None, h2: [0,2], h3: [1,2,3]}."""
+    out: "OrderedDict[str, Optional[List[int]]]" = OrderedDict()
+    for part in spec.split("@"):
+        part = part.strip()
+        if not part:
+            continue
+        if ":" in part:
+            host, slots = part.split(":")
+            slot_list: List[int] = []
+            for piece in slots.split(","):
+                if "-" in piece:
+                    lo, hi = piece.split("-")
+                    slot_list.extend(range(int(lo), int(hi) + 1))
+                else:
+                    slot_list.append(int(piece))
+            out[host] = sorted(set(slot_list))
+        else:
+            out[part] = None
+    return out
+
+
+def parse_resource_filter(resource_pool: Dict[str, int], include_str: str = "",
+                          exclude_str: str = "") -> "OrderedDict[str, List[int]]":
+    """Apply --include/--exclude (reference parse_resource_filter:244).
+
+    Returns {host: [slot ids]} of the active set.
+    """
+    if include_str and exclude_str:
+        raise ValueError("--include and --exclude are mutually exclusive")
+    full: "OrderedDict[str, List[int]]" = OrderedDict(
+        (h, list(range(n))) for h, n in resource_pool.items())
+    if not include_str and not exclude_str:
+        return full
+    if include_str:
+        parsed = _parse_hosts_string(include_str)
+        active: "OrderedDict[str, List[int]]" = OrderedDict()
+        for host, slots in parsed.items():
+            if host not in full:
+                raise ValueError(f"--include host '{host}' not in hostfile")
+            want = slots if slots is not None else full[host]
+            bad = [s for s in want if s not in full[host]]
+            if bad:
+                raise ValueError(f"--include slots {bad} not available on "
+                                 f"{host}")
+            active[host] = want
+        return active
+    parsed = _parse_hosts_string(exclude_str)
+    active = OrderedDict((h, list(s)) for h, s in full.items())
+    for host, slots in parsed.items():
+        if host not in active:
+            raise ValueError(f"--exclude host '{host}' not in hostfile")
+        if slots is None:
+            del active[host]
+        else:
+            remaining = [s for s in active[host] if s not in slots]
+            if remaining:
+                active[host] = remaining
+            else:
+                del active[host]
+    return active
+
+
+def parse_inclusion_exclusion(resource_pool, inclusion, exclusion):
+    """Reference-name alias."""
+    return parse_resource_filter(resource_pool, include_str=inclusion or "",
+                                 exclude_str=exclusion or "")
+
+
+def encode_world_info(active_resources: Dict[str, List[int]]) -> str:
+    """base64 world info handed to every node (reference runner.py world_info)."""
+    return base64.urlsafe_b64encode(
+        json.dumps(active_resources).encode()).decode()
+
+
+def decode_world_info(encoded: str) -> Dict[str, List[int]]:
+    return json.loads(base64.urlsafe_b64decode(encoded.encode()).decode())
+
+
+def build_launch_command(args, active_resources: Dict[str, List[int]],
+                         node_rank: int, host: str) -> List[str]:
+    """Per-node `python -m deepspeed_tpu.launcher.launch ...` command."""
+    world_info = encode_world_info(active_resources)
+    master = args.master_addr or next(iter(active_resources))
+    cmd = [sys.executable, "-u", "-m", "deepspeed_tpu.launcher.launch",
+           f"--world_info={world_info}",
+           f"--node_rank={node_rank}",
+           f"--master_addr={master}",
+           f"--master_port={args.master_port}"]
+    if args.elastic_training:
+        cmd += ["--elastic", f"--max_restarts={args.max_restarts}"]
+    cmd += [args.user_script] + list(args.user_args)
+    return cmd
+
+
+def main(args=None):
+    args = parse_args(args)
+    resource_pool = fetch_hostfile(args.hostfile)
+
+    if resource_pool is None:  # single node
+        n = args.num_gpus if args.num_gpus > 0 else 1
+        resource_pool = OrderedDict({"localhost": n})
+
+    if args.num_nodes > 0:
+        resource_pool = OrderedDict(
+            list(resource_pool.items())[:args.num_nodes])
+    if args.num_gpus > 0:
+        resource_pool = OrderedDict(
+            (h, args.num_gpus) for h in resource_pool)
+
+    active = parse_resource_filter(resource_pool, args.include, args.exclude)
+
+    if args.autotuning:
+        from deepspeed_tpu.autotuning.cli import run_autotuning
+
+        best_path = run_autotuning(args, active)
+        if best_path is None:
+            return 1
+        if args.autotuning == "tune":
+            return 0
+        # --autotuning=run: launch the winning config on the FULL resource
+        # pool through the normal path below
+        os.environ["DSTPU_AUTOTUNING_CONFIG"] = best_path
+
+    multi_node = args.force_multi or len(active) > 1
+    if not multi_node:
+        host = next(iter(active))
+        cmd = build_launch_command(args, active, node_rank=0, host=host)
+        logger.info(f"dstpu launch (single node): {' '.join(map(shlex.quote, cmd))}")
+        result = subprocess.Popen(cmd, env=os.environ.copy())
+        result.wait()
+        if result.returncode != 0:
+            sys.exit(result.returncode)
+        return 0
+
+    from deepspeed_tpu.launcher.multinode_runner import build_runner
+
+    runner = build_runner(args, world_info_base64=encode_world_info(active),
+                          resource_pool=active)
+    if not runner.backend_exists():
+        raise RuntimeError(f"launcher backend '{args.launcher}' is not "
+                           f"installed on this system")
+    env = os.environ.copy()
+    cmd = runner.get_cmd(env, active)
+    logger.info(f"dstpu launch ({args.launcher}): {' '.join(map(shlex.quote, cmd))}")
+    result = subprocess.Popen(cmd, env=env)
+    result.wait()
+    if result.returncode != 0:
+        sys.exit(result.returncode)
+    return 0
+
+
+if __name__ == "__main__":
+    main()

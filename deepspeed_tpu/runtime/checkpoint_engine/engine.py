@@ -118,11 +118,17 @@ def load_engine_checkpoint(engine, load_dir: str, tag: Optional[str] = None,
     host_opt = getattr(engine, "_host_opt", None)
     if load_optimizer_states and not load_module_only and host_opt is not None \
             and "host_opt" in loaded:
-        template = host_opt.state_dict()["state"]
+        template = host_opt.state_template()
         hstate, _ = _unflatten_into(template, loaded["host_opt"], strict=False)
         host_opt.load_state_dict({
             "step": int(loaded.get("__meta__", {}).get("host_opt_step", 0)),
             "state": hstate})
+        opt_state = engine.state.opt_state
+    elif host_opt is not None:
+        # host masters NOT restored (module-only load, or checkpoint saved
+        # without offload): re-seed them from the just-loaded params, else the
+        # next step rebuilds device params from stale random-init masters
+        host_opt.init(params)
         opt_state = engine.state.opt_state
     elif load_optimizer_states and not load_module_only and "opt_state" in loaded \
             and engine.opt_shardings is not None and engine.opt_shardings != {}:

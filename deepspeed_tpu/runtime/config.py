@@ -133,11 +133,37 @@ def _read_config_dict(config: Union[str, dict]) -> dict:
     raise DeepSpeedConfigError(f"unsupported config type {type(config)}")
 
 
+def _deep_merge(base: dict, overrides: dict) -> None:
+    for k, v in overrides.items():
+        if isinstance(v, dict) and isinstance(base.get(k), dict):
+            _deep_merge(base[k], v)
+        else:
+            base[k] = v
+
+
+def _apply_autotuning_overrides(param_dict: dict) -> None:
+    """Autotuning experiment contract: a child launched by the CLI autotuner
+    (autotuning/cli.py) gets config overrides via DSTPU_AUTOTUNING_CONFIG
+    (reference: the autotuner rewrites the ds_config per experiment,
+    autotuning/autotuner.py)."""
+    path = os.environ.get("DSTPU_AUTOTUNING_CONFIG")
+    if not path:
+        return
+    with open(path) as f:
+        overrides = json.load(f)
+    _deep_merge(param_dict, overrides)
+    # micro-batch overrides re-solve the batch triple: drop a stale total so
+    # train_batch = micro * gas * dp is recomputed
+    if "train_micro_batch_size_per_gpu" in overrides:
+        param_dict.pop("train_batch_size", None)
+
+
 class DeepSpeedConfig:
     """Parsed, validated config tree (reference DeepSpeedConfig, config.py:674)."""
 
     def __init__(self, config: Union[str, dict], mpu=None, world_size: Optional[int] = None):
         self._param_dict = _read_config_dict(config)
+        _apply_autotuning_overrides(self._param_dict)
         d = self._param_dict
 
         # ---------------- parallel degrees (needed for batch arithmetic) ------

@@ -25,6 +25,7 @@ API parity (reference names in parens):
 from __future__ import annotations
 
 import dataclasses
+import os
 from typing import Any, Callable, Dict, Iterator, NamedTuple, Optional, Tuple, Union
 
 import jax
@@ -484,6 +485,19 @@ class DeepSpeedEngine:
 
     def _after_step(self, metrics):
         cfg = self.config
+        # autotuning experiment: report throughput after warmup then exit
+        # (reference exits inside engine.forward:1687-1691 once profiled)
+        result_path = os.environ.get("DSTPU_AUTOTUNING_RESULT")
+        if result_path and self.global_steps >= 5:
+            import json as _json
+
+            samples_per_sec = self.tput_timer.avg_samples_per_sec() or 0.0
+            with open(result_path, "w") as f:
+                _json.dump({"metric": samples_per_sec,
+                            "unit": "samples/sec"}, f)
+            log_dist(f"autotuning: wrote metric {samples_per_sec:.2f} "
+                     f"samples/sec, exiting", ranks=[0])
+            raise SystemExit(0)
         if self.fp16_enabled:
             # host round-trip only when someone asks; keep async by default
             pass

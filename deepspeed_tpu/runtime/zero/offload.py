@@ -67,7 +67,10 @@ class HostOffloadOptimizer:
 
     # ------------------------------------------------------------------ init
     def init(self, params_device) -> None:
-        """Pull fp32 masters to host; zero moments; optionally spill to NVMe."""
+        """Pull fp32 masters to host; zero moments; optionally spill to NVMe.
+        (Re-)initialising resets the Adam step so bias correction restarts
+        with the fresh moments."""
+        self.step_count = 0
         flat = _flatten_with_paths(params_device)
         host = jax.device_get(flat)
         for i, (name, arr) in enumerate(host.items()):
@@ -189,6 +192,21 @@ class HostOffloadOptimizer:
         return master.astype(np_dtype)
 
     # ----------------------------------------------------------- state (ckpt)
+    def state_template(self) -> Dict[str, Any]:
+        """Shapes/dtypes of the state tree WITHOUT reading swapped data
+        (checkpoint-load unflatten template; np.empty does no IO)."""
+        names = ["master"] + list(self._zero_moments(np.empty(0, np.float32)))
+        out: Dict[str, Any] = {}
+        for i, name in enumerate(self._names):
+            if self._swapper is not None:
+                shape, dtype = self._swapper.swapper.meta(
+                    self._swapper._key(i, "master"))
+                out[name] = {k: np.empty(shape, dtype) for k in names}
+            else:
+                out[name] = {"master": self.master[name],
+                             **self.moments[name]}
+        return out
+
     def state_dict(self) -> Dict[str, Any]:
         if self._swapper is not None:
             state = {}

@@ -1,0 +1,99 @@
+"""CLI autotuning driver (reference Autotuner.tune flow): experiment space,
+config override merge, end-to-end sweep over a real (tiny) training script,
+best-config selection."""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from deepspeed_tpu.autotuning.cli import (
+    build_experiment_space,
+    run_autotuning,
+    run_experiment,
+)
+
+
+class TestExperimentSpace:
+    def test_grid(self):
+        space = build_experiment_space(micro_batches=(1, 2), zero_stages=(0, 3))
+        assert len(space) == 4
+        assert {"zero_optimization": {"stage": 0},
+                "train_micro_batch_size_per_gpu": 1} in space
+
+
+class TestConfigOverrideMerge:
+    def test_env_merge(self, tmp_path, monkeypatch):
+        from deepspeed_tpu.runtime.config import DeepSpeedConfig
+
+        ov = tmp_path / "ov.json"
+        ov.write_text(json.dumps({
+            "zero_optimization": {"stage": 3},
+            "train_micro_batch_size_per_gpu": 2}))
+        monkeypatch.setenv("DSTPU_AUTOTUNING_CONFIG", str(ov))
+        cfg = DeepSpeedConfig({"train_batch_size": 8,
+                               "zero_optimization": {"stage": 1,
+                                                     "reduce_bucket_size": 7}})
+        assert cfg.zero_optimization_stage == 3
+        assert cfg.zero_config.reduce_bucket_size == 7  # merge, not replace
+        assert cfg.train_micro_batch_size_per_gpu == 2
+
+
+SCRIPT = """
+import os, sys, json
+sys.path.insert(0, "/root/repo")
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+os.environ["DSTPU_ACCELERATOR"] = "cpu"
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+import deepspeed_tpu
+from tests.unit.simple_model import SimpleModel
+
+model = SimpleModel(hidden_dim=16)
+config = {"train_batch_size": 8, "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+          "steps_per_print": 0}
+engine, *_ = deepspeed_tpu.initialize(model=model, config=config)
+rng = np.random.RandomState(0)
+for _ in range(10):
+    x = rng.randn(1, 8, 16).astype(np.float32)
+    y = rng.randn(1, 8, 1).astype(np.float32)
+    engine.train_batch_from_stacked({"x": x, "y": y})
+"""
+
+
+class _Args:
+    user_script = None
+    user_args = []
+    autotuning = "tune"
+    master_addr = ""
+    master_port = 7777
+    elastic_training = False
+    max_restarts = 3
+
+
+class TestEndToEndSweep:
+    def test_sweep_selects_best(self, tmp_path):
+        script = tmp_path / "train.py"
+        script.write_text(SCRIPT)
+        args = _Args()
+        args.user_script = str(script)
+        results = str(tmp_path / "results")
+        experiments = [{"zero_optimization": {"stage": 0}},
+                       {"zero_optimization": {"stage": 2}}]
+        best_path = run_autotuning(args, {"localhost": [0]},
+                                   experiments=experiments, results_dir=results)
+        assert best_path is not None and os.path.exists(best_path)
+        best = json.loads((tmp_path / "results" / "best_config.json").read_text())
+        assert best["metric"] > 0
+        summary = json.loads((tmp_path / "results" / "summary.json").read_text())
+        assert len(summary) == 2
+
+    def test_failed_experiment_pruned(self, tmp_path):
+        script = tmp_path / "boom.py"
+        script.write_text("import sys; sys.exit(9)\n")
+        metric = run_experiment([sys.executable, str(script)], {},
+                                str(tmp_path / "exp"))
+        assert metric is None

@@ -1,0 +1,248 @@
+"""Launcher layer tests (reference tests/unit/launcher/test_ds_arguments.py,
+test_run.py shapes): hostfile parsing, include/exclude filters, world-info
+encoding, per-rank env construction, multinode runner commands, elastic
+agent restart logic, env report."""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+from deepspeed_tpu.launcher.runner import (
+    build_launch_command,
+    decode_world_info,
+    encode_world_info,
+    fetch_hostfile,
+    parse_args,
+    parse_resource_filter,
+)
+
+
+@pytest.fixture
+def hostfile(tmp_path):
+    p = tmp_path / "hostfile"
+    p.write_text(
+        "# comment line\n"
+        "worker-0 slots=4\n"
+        "worker-1 slots=4\n"
+        "worker-2 slots=2\n"
+        "\n")
+    return str(p)
+
+
+class TestHostfile:
+    def test_parse(self, hostfile):
+        pool = fetch_hostfile(hostfile)
+        assert pool == {"worker-0": 4, "worker-1": 4, "worker-2": 2}
+        assert list(pool) == ["worker-0", "worker-1", "worker-2"]
+
+    def test_missing_returns_none(self, tmp_path):
+        assert fetch_hostfile(str(tmp_path / "nope")) is None
+
+    def test_bad_entry_raises(self, tmp_path):
+        p = tmp_path / "hf"
+        p.write_text("worker-0 4\n")
+        with pytest.raises(ValueError, match="bad entry"):
+            fetch_hostfile(str(p))
+
+    def test_duplicate_raises(self, tmp_path):
+        p = tmp_path / "hf"
+        p.write_text("w slots=2\nw slots=4\n")
+        with pytest.raises(ValueError, match="multiple entries"):
+            fetch_hostfile(str(p))
+
+
+class TestResourceFilter:
+    POOL = {"worker-0": 4, "worker-1": 4, "worker-2": 2}
+
+    def test_no_filter(self):
+        active = parse_resource_filter(self.POOL)
+        assert active == {"worker-0": [0, 1, 2, 3], "worker-1": [0, 1, 2, 3],
+                          "worker-2": [0, 1]}
+
+    def test_include_hosts(self):
+        active = parse_resource_filter(self.POOL, include_str="worker-1")
+        assert active == {"worker-1": [0, 1, 2, 3]}
+
+    def test_include_slots_and_ranges(self):
+        active = parse_resource_filter(self.POOL,
+                                       include_str="worker-0:0,2@worker-1:1-3")
+        assert active == {"worker-0": [0, 2], "worker-1": [1, 2, 3]}
+
+    def test_exclude_host(self):
+        active = parse_resource_filter(self.POOL, exclude_str="worker-2")
+        assert "worker-2" not in active and len(active) == 2
+
+    def test_exclude_slots(self):
+        active = parse_resource_filter(self.POOL, exclude_str="worker-0:0,1")
+        assert active["worker-0"] == [2, 3]
+
+    def test_include_and_exclude_raises(self):
+        with pytest.raises(ValueError, match="mutually exclusive"):
+            parse_resource_filter(self.POOL, include_str="worker-0",
+                                  exclude_str="worker-1")
+
+    def test_unknown_host_raises(self):
+        with pytest.raises(ValueError, match="not in hostfile"):
+            parse_resource_filter(self.POOL, include_str="worker-9")
+
+
+class TestWorldInfo:
+    def test_round_trip(self):
+        active = {"a": [0, 1], "b": [0]}
+        assert decode_world_info(encode_world_info(active)) == active
+
+    def test_launch_command(self):
+        args = parse_args(["--master_port", "9999", "train.py", "--lr", "0.1"])
+        cmd = build_launch_command(args, {"h0": [0], "h1": [0]}, 1, "h1")
+        joined = " ".join(cmd)
+        assert "--node_rank=1" in joined
+        assert "--master_addr=h0" in joined
+        assert "--master_port=9999" in joined
+        assert cmd[-3:] == ["train.py", "--lr", "0.1"]
+
+
+class TestRankEnv:
+    def test_global_ranks(self):
+        from deepspeed_tpu.launcher.launch import build_rank_env
+
+        world = {"h0": [0, 1], "h1": [0, 1]}
+        env = build_rank_env(world, node_rank=1, local_index=1,
+                             master_addr="h0", master_port=7777)
+        assert env["RANK"] == "3"
+        assert env["WORLD_SIZE"] == "4"
+        assert env["LOCAL_RANK"] == "1"
+        assert env["DSTPU_COORDINATOR_ADDRESS"] == "h0:7777"
+        assert env["DSTPU_PROCESS_ID"] == "3"
+        assert env["DSTPU_NUM_PROCESSES"] == "4"
+
+    def test_dense_ranks_under_slot_filter(self):
+        """Non-contiguous --include slots must still give dense 0..N-1 ranks
+        (slot ids go to DSTPU_VISIBLE_SLOTS)."""
+        from deepspeed_tpu.launcher.launch import build_rank_env
+
+        world = {"h0": [0, 2], "h1": [1]}
+        envs = [build_rank_env(world, 0, 0, "h0", 1),
+                build_rank_env(world, 0, 1, "h0", 1),
+                build_rank_env(world, 1, 0, "h0", 1)]
+        assert [e["RANK"] for e in envs] == ["0", "1", "2"]
+        assert envs[0]["DSTPU_VISIBLE_SLOTS"] == "0,2"
+        assert envs[2]["DSTPU_VISIBLE_SLOTS"] == "1"
+
+
+class TestMultinodeRunners:
+    def _args(self, launcher):
+        return parse_args(["--launcher", launcher, "--master_addr", "h0",
+                           "train.py"])
+
+    def test_openmpi_cmd(self):
+        from deepspeed_tpu.launcher.multinode_runner import build_runner
+
+        args = self._args("openmpi")
+        r = build_runner(args, "winfo", {"h0": [0, 1], "h1": [0, 1]})
+        cmd = r.get_cmd({}, {"h0": [0, 1], "h1": [0, 1]})
+        assert cmd[:3] == ["mpirun", "-n", "4"]
+        assert "h0:2,h1:2" in cmd
+
+    def test_slurm_cmd(self):
+        from deepspeed_tpu.launcher.multinode_runner import build_runner
+
+        args = self._args("slurm")
+        r = build_runner(args, "winfo", {"h0": [0], "h1": [0]})
+        cmd = r.get_cmd({}, {"h0": [0], "h1": [0]})
+        assert cmd[:3] == ["srun", "-n", "2"]
+
+    def test_gcloud_cmd(self):
+        from deepspeed_tpu.launcher.multinode_runner import build_runner
+
+        args = self._args("gcloud")
+        r = build_runner(args, "winfo", {"my-pod": [0]})
+        cmd = r.get_cmd({}, {"my-pod": [0]})
+        assert cmd[:6] == ["gcloud", "compute", "tpus", "tpu-vm", "ssh",
+                           "my-pod"]
+        assert "--worker=all" in cmd
+
+    def test_unknown_launcher_raises(self):
+        from deepspeed_tpu.launcher.multinode_runner import build_runner
+
+        args = self._args("slurm")
+        args.launcher = "bogus"
+        with pytest.raises(ValueError, match="unknown launcher"):
+            build_runner(args, "w", {})
+
+
+class TestSingleNodeLaunch:
+    def test_end_to_end_subprocess(self, tmp_path):
+        """dstpu runner → per-node launcher → user script, single node with
+        2 workers; checks rank env and failure-free exit."""
+        script = tmp_path / "train.py"
+        script.write_text(
+            "import os, sys\n"
+            "out = os.environ['OUT_DIR']\n"
+            "rank = os.environ['RANK']\n"
+            "with open(os.path.join(out, f'rank{rank}.txt'), 'w') as f:\n"
+            "    f.write(os.environ['WORLD_SIZE'])\n")
+        env = dict(os.environ, OUT_DIR=str(tmp_path),
+                   PYTHONPATH="/root/repo")
+        rc = subprocess.run(
+            [sys.executable, "-m", "deepspeed_tpu.launcher.runner",
+             "--num_gpus", "2", str(script)],
+            env=env, capture_output=True, text=True, timeout=120)
+        assert rc.returncode == 0, rc.stderr
+        assert (tmp_path / "rank0.txt").read_text() == "2"
+        assert (tmp_path / "rank1.txt").read_text() == "2"
+
+    def test_failure_detection(self, tmp_path):
+        """A failing rank must fail the whole launch (reference launch.py
+        failure polling)."""
+        script = tmp_path / "boom.py"
+        script.write_text(
+            "import os, sys, time\n"
+            "if os.environ['RANK'] == '1':\n"
+            "    sys.exit(3)\n"
+            "time.sleep(30)\n")
+        env = dict(os.environ, PYTHONPATH="/root/repo")
+        rc = subprocess.run(
+            [sys.executable, "-m", "deepspeed_tpu.launcher.runner",
+             "--num_gpus", "2", str(script)],
+            env=env, capture_output=True, text=True, timeout=60)
+        assert rc.returncode == 3
+
+
+class TestElasticAgent:
+    def test_restarts_then_succeeds(self):
+        from deepspeed_tpu.elasticity.elastic_agent import ElasticAgent
+
+        attempts = []
+
+        def spawn():
+            attempts.append(1)
+            return ["fake"]
+
+        def monitor(procs):
+            return 1 if len(attempts) < 3 else 0
+
+        agent = ElasticAgent(spawn, monitor, max_restarts=5,
+                             restart_delay_s=0.0)
+        assert agent.run() == 0
+        assert len(attempts) == 3
+
+    def test_gives_up_after_budget(self):
+        from deepspeed_tpu.elasticity.elastic_agent import ElasticAgent
+
+        agent = ElasticAgent(lambda: ["p"], lambda procs: 7, max_restarts=2,
+                             restart_delay_s=0.0)
+        assert agent.run() == 7
+        assert agent.restart_count == 3
+
+
+class TestEnvReport:
+    def test_report_runs(self, capsys):
+        from deepspeed_tpu.env_report import main
+
+        main()
+        out = capsys.readouterr().out
+        assert "async_io" in out
+        assert "deepspeed_tpu version" in out
+        assert "device count" in out
