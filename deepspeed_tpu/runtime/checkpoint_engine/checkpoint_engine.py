@@ -23,7 +23,11 @@ class CheckpointEngine(abc.ABC):
         """Notify start of a checkpoint under ``tag`` (reference create())."""
 
     @abc.abstractmethod
-    def save(self, state_dict: Dict[str, Any], path: str):
+    def save(self, state_dict: Dict[str, Any], path: str, on_success=None):
+        """Persist ``state_dict``. ``on_success`` (if given) runs exactly
+        once after the state is durably written — sidecar finalization like
+        the 'latest' pointer belongs there so a failed async write can never
+        publish a broken checkpoint."""
         ...
 
     @abc.abstractmethod
@@ -104,7 +108,7 @@ class NativeCheckpointEngine(CheckpointEngine):
     (the reference needs a whole conversion subsystem, deepspeed/checkpoint/,
     to get this property; see SURVEY §5.4)."""
 
-    def save(self, state_dict: Dict[str, Any], path: str):
+    def save(self, state_dict: Dict[str, Any], path: str, on_success=None):
         import jax
         import ml_dtypes
 
@@ -128,6 +132,8 @@ class NativeCheckpointEngine(CheckpointEngine):
         if jax.process_index() == 0:  # gather above is collective; write once
             np.savez(path, __meta__=json.dumps(meta), **out)
         log_dist(f"[native-ckpt] saved {len(arrays)} arrays to {path}", ranks=[0])
+        if on_success is not None:
+            on_success()
 
     def load(self, path: str, map_location=None) -> Dict[str, Any]:
         import ml_dtypes
@@ -152,6 +158,71 @@ class NativeCheckpointEngine(CheckpointEngine):
         return out
 
 
+class AsyncCheckpointEngine(CheckpointEngine):
+    """Background-thread persistence — the Nebula analog
+    (reference NebulaCheckpointEngine, runtime/checkpoint_engine/
+    nebula_checkpoint_engine.py: save returns immediately, an external
+    service persists, ``commit(tag)`` finalizes).
+
+    ``save`` snapshots the state to host memory synchronously — deep copies,
+    so training may mutate params/host-optimizer state immediately after —
+    and hands the file write (plus the caller's ``on_success`` finalizer,
+    e.g. the 'latest' pointer) to a worker thread.  A new ``save`` first
+    joins the previous write (double-buffering: write N overlaps training
+    toward N+1), which is also where a prior write's error surfaces.
+    ``commit`` is non-blocking; ``wait`` joins everything explicitly."""
+
+    def __init__(self, config_params=None, inner: Optional[CheckpointEngine] = None):
+        super().__init__(config_params)
+        self.inner = inner or NativeCheckpointEngine(config_params)
+        self._pending: list = []
+        self._errors: list = []
+
+    def save(self, state_dict: Dict[str, Any], path: str, on_success=None):
+        import threading
+
+        self.wait()  # join the previous write; surfaces its errors
+        # synchronous device→host snapshot with DEEP COPIES: numpy leaves
+        # (host-offload masters/moments) are mutated in place by the next
+        # optimizer step, and device_get can alias buffers on the CPU backend
+        snapshot: Dict[str, Any] = {}
+        for section, tree in state_dict.items():
+            if section == "__meta__":
+                snapshot[section] = dict(tree)
+            else:
+                snapshot[section] = {k: np.array(v, copy=True)
+                                     for k, v in _flatten_state(tree).items()}
+
+        def write():
+            try:
+                # pre-flattened sections pass through _flatten_state unchanged
+                self.inner.save(snapshot, path, on_success=on_success)
+            except Exception as e:  # surfaced at the next save()/wait()/load()
+                self._errors.append(e)
+
+        t = threading.Thread(target=write, daemon=True)
+        t.start()
+        self._pending.append(t)
+
+    def load(self, path: str, map_location=None) -> Dict[str, Any]:
+        self.wait()
+        return self.inner.load(path, map_location)
+
+    def commit(self, tag: str) -> bool:
+        # non-blocking: durability is finalized by the worker (on_success);
+        # errors surface on the next save()/wait()/load()
+        return True
+
+    def wait(self):
+        for t in self._pending:
+            t.join()
+        self._pending.clear()
+        if self._errors:
+            err = self._errors[0]
+            self._errors.clear()
+            raise RuntimeError(f"async checkpoint write failed: {err}") from err
+
+
 class OrbaxCheckpointEngine(CheckpointEngine):
     """Orbax-backed engine for multi-host distributed saving (the Nebula
     analog: reference NebulaCheckpointEngine delegates persistence to an
@@ -166,12 +237,14 @@ class OrbaxCheckpointEngine(CheckpointEngine):
         self._ocp = ocp
         self._ckptr = ocp.StandardCheckpointer()
 
-    def save(self, state_dict: Dict[str, Any], path: str):
+    def save(self, state_dict: Dict[str, Any], path: str, on_success=None):
         state_dict = dict(state_dict)  # don't mutate the caller's dict
         meta = state_dict.pop("__meta__", {})
         self._ckptr.save(os.path.abspath(path) + ".orbax", state_dict, force=True)
         with open(path + ".meta.json", "w") as f:
             json.dump(meta, f)
+        if on_success is not None:
+            on_success()
 
     def load(self, path: str, map_location=None) -> Dict[str, Any]:
         out = self._ckptr.restore(os.path.abspath(path) + ".orbax")

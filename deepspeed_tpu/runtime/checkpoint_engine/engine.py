@@ -57,6 +57,8 @@ def save_engine_checkpoint(engine, save_dir: str, tag: Optional[str] = None,
     _validate_tag(engine, tag)
     ckpt_engine = checkpoint_engine or NativeCheckpointEngine()
     ckpt_engine.create(tag)
+    os.makedirs(os.path.join(save_dir, tag), exist_ok=True)  # before any
+    # sync sidecar writes: an async engine creates it only in its worker
     path = os.path.join(save_dir, tag, "state.npz")
     state = engine.state
     state_dict = {
@@ -71,7 +73,6 @@ def save_engine_checkpoint(engine, save_dir: str, tag: Optional[str] = None,
         hsd = host_opt.state_dict()
         state_dict["host_opt"] = hsd["state"]
         state_dict["__meta__"]["host_opt_step"] = hsd["step"]
-    ckpt_engine.save(state_dict, path)
 
     cs = {
         "global_steps": engine.global_steps,
@@ -83,12 +84,18 @@ def save_engine_checkpoint(engine, save_dir: str, tag: Optional[str] = None,
         "client_state": client_state or {},
         "mesh_shape": list(engine.topology.mesh_shape),
     }
-    if jax.process_index() == 0:
-        with open(os.path.join(save_dir, tag, "client_state.json"), "w") as f:
-            json.dump(cs, f, indent=2)
-        if save_latest:
-            with open(os.path.join(save_dir, "latest"), "w") as f:
-                f.write(tag)
+
+    def finalize():
+        """Runs only after the state is durably written — an async engine
+        must never publish 'latest' for a failed write."""
+        if jax.process_index() == 0:
+            with open(os.path.join(save_dir, tag, "client_state.json"), "w") as f:
+                json.dump(cs, f, indent=2)
+            if save_latest:
+                with open(os.path.join(save_dir, "latest"), "w") as f:
+                    f.write(tag)
+
+    ckpt_engine.save(state_dict, path, on_success=finalize)
     ckpt_engine.commit(tag)
     log_dist(f"saved checkpoint {tag} to {save_dir}", ranks=[0])
     return True

@@ -106,6 +106,8 @@ class CheckpointConfig(DeepSpeedConfigModel):
     load_universal: bool = False
     use_node_local_storage: bool = False
     parallel_write_pipeline: bool = False
+    # background-thread persistence (reference Nebula async service analog)
+    async_save: bool = False
 
 
 class DataloaderConfig(DeepSpeedConfigModel):

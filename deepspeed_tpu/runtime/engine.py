@@ -655,11 +655,25 @@ class DeepSpeedEngine:
                                 collate_fn=collate_fn, **kw)
 
     # ----------------------------------------------------------- checkpoints
+    def _checkpoint_engine(self):
+        """Engine-lifetime checkpoint backend; async when configured
+        (reference Nebula engine selection)."""
+        if getattr(self, "_ckpt_engine", None) is None:
+            if self.config.checkpoint_config.async_save:
+                from deepspeed_tpu.runtime.checkpoint_engine.checkpoint_engine import (
+                    AsyncCheckpointEngine)
+
+                self._ckpt_engine = AsyncCheckpointEngine()
+            else:
+                self._ckpt_engine = None  # default NativeCheckpointEngine
+        return self._ckpt_engine
+
     def save_checkpoint(self, save_dir, tag=None, client_state=None, save_latest=True):
         from deepspeed_tpu.runtime.checkpoint_engine.engine import save_engine_checkpoint
 
         return save_engine_checkpoint(self, save_dir, tag=tag, client_state=client_state,
-                                      save_latest=save_latest)
+                                      save_latest=save_latest,
+                                      checkpoint_engine=self._checkpoint_engine())
 
     def load_checkpoint(self, load_dir, tag=None, load_optimizer_states=True,
                         load_lr_scheduler_states=True, load_module_only=False):
@@ -668,7 +682,8 @@ class DeepSpeedEngine:
         return load_engine_checkpoint(self, load_dir, tag=tag,
                                       load_optimizer_states=load_optimizer_states,
                                       load_lr_scheduler_states=load_lr_scheduler_states,
-                                      load_module_only=load_module_only)
+                                      load_module_only=load_module_only,
+                                      checkpoint_engine=self._checkpoint_engine())
 
     def save_16bit_model(self, save_dir, save_filename="model_weights.npz"):
         from deepspeed_tpu.runtime.checkpoint_engine.engine import save_16bit_model

@@ -1,0 +1,103 @@
+"""Async checkpoint engine (Nebula analog): background writes, commit
+semantics, error surfacing, and end-to-end engine integration."""
+
+import json
+import os
+import time
+
+import numpy as np
+import pytest
+
+from deepspeed_tpu.runtime.checkpoint_engine.checkpoint_engine import (
+    AsyncCheckpointEngine,
+    NativeCheckpointEngine,
+)
+
+
+class TestAsyncEngine:
+    def test_save_load_round_trip(self, tmp_path):
+        eng = AsyncCheckpointEngine()
+        state = {"params": {"w": np.arange(10, dtype=np.float32)},
+                 "__meta__": {"global_step": 7}}
+        path = str(tmp_path / "c" / "state.npz")
+        eng.save(state, path)
+        eng.commit("tag")  # joins the write
+        loaded = eng.load(path)
+        np.testing.assert_array_equal(loaded["params"]["w"], state["params"]["w"])
+        assert loaded["__meta__"]["global_step"] == 7
+
+    def test_snapshot_isolated_from_mutation(self, tmp_path):
+        """Mutating state right after save() must not corrupt the write —
+        the snapshot is taken synchronously (Nebula semantics)."""
+        eng = AsyncCheckpointEngine()
+        w = np.zeros(1000, np.float32)
+        path = str(tmp_path / "c" / "state.npz")
+        eng.save({"params": {"w": w}}, path)
+        w += 999.0  # training continues immediately
+        eng.wait()
+        loaded = eng.load(path)
+        np.testing.assert_array_equal(loaded["params"]["w"], np.zeros(1000))
+
+    def test_write_error_surfaces_at_wait(self, tmp_path, monkeypatch):
+        eng = AsyncCheckpointEngine()
+
+        def boom(state, path, on_success=None):
+            raise IOError("disk full")
+
+        monkeypatch.setattr(eng.inner, "save", boom)
+        eng.save({"params": {"w": np.ones(3)}}, str(tmp_path / "x.npz"))
+        with pytest.raises(RuntimeError, match="disk full"):
+            eng.wait()
+
+    def test_failed_write_does_not_publish_latest(self, tmp_path, monkeypatch):
+        """The 'latest' pointer must only move after a durable write."""
+        import deepspeed_tpu
+        from tests.unit.simple_model import SimpleModel
+
+        engine, *_ = deepspeed_tpu.initialize(
+            model=SimpleModel(hidden_dim=8),
+            config={"train_batch_size": 8,
+                    "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+                    "checkpoint": {"async_save": True},
+                    "steps_per_print": 0})
+        ck = engine._checkpoint_engine()
+
+        def boom(state, path, on_success=None):
+            raise IOError("disk full")
+
+        monkeypatch.setattr(ck.inner, "save", boom)
+        engine.save_checkpoint(str(tmp_path / "ck"))
+        with pytest.raises(RuntimeError, match="disk full"):
+            ck.wait()
+        assert not os.path.exists(tmp_path / "ck" / "latest")
+
+    def test_engine_integration(self, tmp_path):
+        import jax
+
+        import deepspeed_tpu
+        from tests.unit.simple_model import SimpleModel
+
+        engine, *_ = deepspeed_tpu.initialize(
+            model=SimpleModel(hidden_dim=8),
+            config={"train_batch_size": 8,
+                    "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+                    "checkpoint": {"async_save": True},
+                    "steps_per_print": 0})
+        rng = np.random.RandomState(0)
+        engine.train_batch_from_stacked(
+            {"x": rng.randn(1, 8, 8).astype(np.float32),
+             "y": rng.randn(1, 8, 1).astype(np.float32)})
+        assert isinstance(engine._checkpoint_engine(), AsyncCheckpointEngine)
+        engine.save_checkpoint(str(tmp_path / "ck"))
+        engine._checkpoint_engine().wait()
+        engine2, *_ = deepspeed_tpu.initialize(
+            model=SimpleModel(hidden_dim=8),
+            config={"train_batch_size": 8,
+                    "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+                    "steps_per_print": 0})
+        path, _ = engine2.load_checkpoint(str(tmp_path / "ck"))
+        assert path is not None
+        a = jax.tree_util.tree_leaves(engine.state.params)
+        b = jax.tree_util.tree_leaves(engine2.state.params)
+        for x, y in zip(a, b):
+            np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
