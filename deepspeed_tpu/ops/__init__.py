@@ -124,6 +124,19 @@ class AsyncIOBuilder(_NativeBuilderProxy):
 
 
 @register_op_builder
+class OnebitBuilder(_registry_mod.OpBuilder):
+    """1-bit compressed collectives + error-compensated optimizers
+    (reference runtime/comm/nccl.py compressed_allreduce + fp16/onebit/)."""
+
+    NAME = "onebit"
+
+    def load(self):
+        from deepspeed_tpu.ops import onebit
+
+        return onebit
+
+
+@register_op_builder
 class CPUAdamNativeBuilder(_NativeBuilderProxy):
     """Native vectorized host Adam/Adagrad kernels (reference csrc/adam/
     cpu_adam.cpp); used by the ZeRO-Offload host optimizer step."""

@@ -262,6 +262,14 @@ class SGD:
                          momentum_buf=treedef.unflatten([o[1] for o in out])))
 
 
+def _onebit(name):
+    def make(**kw):
+        from deepspeed_tpu.ops import onebit
+
+        return getattr(onebit, name)(**kw)
+    return make
+
+
 OPTIMIZER_REGISTRY: Dict[str, Any] = {
     "adam": FusedAdam,
     "adamw": lambda **kw: FusedAdam(adam_w_mode=True, **kw),
@@ -272,6 +280,10 @@ OPTIMIZER_REGISTRY: Dict[str, Any] = {
     "fusedlamb": FusedLamb,
     "adagrad": DeepSpeedCPUAdagrad,
     "sgd": SGD,
+    # 1-bit error-compensated optimizers (reference runtime/fp16/onebit/)
+    "onebitadam": _onebit("OnebitAdam"),
+    "onebitlamb": _onebit("OnebitLamb"),
+    "zerooneadam": _onebit("ZeroOneAdam"),
 }
 
 

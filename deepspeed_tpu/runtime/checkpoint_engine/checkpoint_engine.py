@@ -200,7 +200,9 @@ class AsyncCheckpointEngine(CheckpointEngine):
             except Exception as e:  # surfaced at the next save()/wait()/load()
                 self._errors.append(e)
 
-        t = threading.Thread(target=write, daemon=True)
+        # non-daemon: the interpreter joins outstanding writes at exit, so a
+        # save issued as the script's last act is never silently truncated
+        t = threading.Thread(target=write, daemon=False)
         t.start()
         self._pending.append(t)
 

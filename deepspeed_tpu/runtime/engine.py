@@ -123,6 +123,17 @@ class DeepSpeedEngine:
             optimizer = build_optimizer(config.optimizer_name, config.optimizer_params)
         if optimizer is None:
             optimizer = build_optimizer("adam", {"lr": 1e-3})
+        from deepspeed_tpu.ops.onebit import _OnebitBase
+
+        if isinstance(optimizer, _OnebitBase) and optimizer.with_compression:
+            # the engine's GSPMD step communicates grads exactly (XLA-
+            # scheduled), so compression would never engage — run the exact
+            # math and skip the error-state memory; the true 1-bit path is
+            # the shard_map loop with local grads (ops/onebit.py docstring)
+            optimizer.with_compression = False
+            log_dist("1-bit optimizer under the GSPMD engine uses exact "
+                     "communication (no compression, no error-state memory); "
+                     "use the shard_map path for compressed comm", ranks=[0])
         self.optimizer = optimizer
 
         # ---- host (ZeRO-Offload/Infinity) optimizer: fp32 master + moments in
