@@ -124,6 +124,19 @@ class AsyncIOBuilder(_NativeBuilderProxy):
 
 
 @register_op_builder
+class SparseAttnBuilder(_registry_mod.PallasOpBuilder):
+    """Block-sparse attention (reference ops/sparse_attention Triton kernels
+    → LUT-driven Pallas kernel + sparsity config family)."""
+
+    NAME = "sparse_attn"
+
+    def load(self):
+        from deepspeed_tpu.ops import sparse_attention
+
+        return sparse_attention
+
+
+@register_op_builder
 class OnebitBuilder(_registry_mod.OpBuilder):
     """1-bit compressed collectives + error-compensated optimizers
     (reference runtime/comm/nccl.py compressed_allreduce + fp16/onebit/)."""

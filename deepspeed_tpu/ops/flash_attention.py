@@ -68,7 +68,9 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_k: int,
     m, l, acc = jax.lax.fori_loop(0, nk_eff, body, (m0, l0, acc0))
     l_safe = jnp.maximum(l, 1e-20)
     o_ref[...] = (acc / l_safe[:, None]).astype(o_ref.dtype)
-    lse_ref[...] = (m + jnp.log(l_safe)).astype(jnp.float32)
+    # trailing unit dim: rank-2 (bq, 1) tiles satisfy the TPU block-shape
+    # constraint (1-D tiles fail Mosaic lowering)
+    lse_ref[...] = (m + jnp.log(l_safe)).astype(jnp.float32)[:, None]
 
 
 def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *,
@@ -77,8 +79,8 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *,
     qi = pl.program_id(1)
     q = q_ref[...].astype(jnp.float32) * scale
     do = do_ref[...].astype(jnp.float32)
-    lse = lse_ref[...]
-    delta = delta_ref[...]
+    lse = lse_ref[...][:, 0]
+    delta = delta_ref[...][:, 0]
     bq, dh = q.shape
     q_pos = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, (bq, block_k), 0)
     nk = seq_len // block_k
@@ -118,8 +120,8 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dk, dv = carry
         q = q_ref[pl.ds(qi * block_q, block_q), :].astype(jnp.float32) * scale
         do = do_ref[pl.ds(qi * block_q, block_q), :].astype(jnp.float32)
-        lse = lse_ref[pl.ds(qi * block_q, block_q)]
-        delta = delta_ref[pl.ds(qi * block_q, block_q)]
+        lse = lse_ref[pl.ds(qi * block_q, block_q), 0]
+        delta = delta_ref[pl.ds(qi * block_q, block_q), 0]
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())))  # [BQ, BK]
         if causal:
             q_pos = qi * block_q + jax.lax.broadcasted_iota(
@@ -195,11 +197,11 @@ def _flash_fwd(q, k, v, causal, scale, block_q, block_k, interpret):
         ],
         out_specs=[
             pl.BlockSpec((None, bq, dh), lambda bh, qi: (bh, qi, 0)),
-            pl.BlockSpec((None, bq), lambda bh, qi: (bh, qi)),
+            pl.BlockSpec((None, bq, 1), lambda bh, qi: (bh, qi, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((b * h, t, dh), q.dtype),
-            jax.ShapeDtypeStruct((b * h, t), jnp.float32),
+            jax.ShapeDtypeStruct((b * h, t, 1), jnp.float32),
         ],
         interpret=interp,
     )(qf, kf, vf)
@@ -219,7 +221,8 @@ def _flash_bwd_vjp(causal, scale, block_q, block_k, interpret, res, g):
     bk = _pick_block(t, block_k)
     interp = _interpret_default() if interpret is None else interpret
     dof = _reshape_bh(g)
-    delta = jnp.sum(dof.astype(jnp.float32) * outf.astype(jnp.float32), axis=-1)
+    delta = jnp.sum(dof.astype(jnp.float32) * outf.astype(jnp.float32),
+                    axis=-1, keepdims=True)                 # [bh, t, 1]
 
     dq_kernel = functools.partial(_bwd_dq_kernel, block_k=bk, causal=causal,
                                   scale=sc, seq_len=t, block_q=bq)
@@ -231,8 +234,8 @@ def _flash_bwd_vjp(causal, scale, block_q, block_k, interpret, res, g):
             pl.BlockSpec((None, t, dh), lambda b_, qi: (b_, 0, 0)),
             pl.BlockSpec((None, t, dh), lambda b_, qi: (b_, 0, 0)),
             pl.BlockSpec((None, bq, dh), lambda b_, qi: (b_, qi, 0)),
-            pl.BlockSpec((None, bq), lambda b_, qi: (b_, qi)),
-            pl.BlockSpec((None, bq), lambda b_, qi: (b_, qi)),
+            pl.BlockSpec((None, bq, 1), lambda b_, qi: (b_, qi, 0)),
+            pl.BlockSpec((None, bq, 1), lambda b_, qi: (b_, qi, 0)),
         ],
         out_specs=pl.BlockSpec((None, bq, dh), lambda b_, qi: (b_, qi, 0)),
         out_shape=jax.ShapeDtypeStruct((bh, t, dh), qf.dtype),
@@ -249,8 +252,8 @@ def _flash_bwd_vjp(causal, scale, block_q, block_k, interpret, res, g):
             pl.BlockSpec((None, bk, dh), lambda b_, kj: (b_, kj, 0)),
             pl.BlockSpec((None, bk, dh), lambda b_, kj: (b_, kj, 0)),
             pl.BlockSpec((None, t, dh), lambda b_, kj: (b_, 0, 0)),
-            pl.BlockSpec((None, t), lambda b_, kj: (b_, 0)),
-            pl.BlockSpec((None, t), lambda b_, kj: (b_, 0)),
+            pl.BlockSpec((None, t, 1), lambda b_, kj: (b_, 0, 0)),
+            pl.BlockSpec((None, t, 1), lambda b_, kj: (b_, 0, 0)),
         ],
         out_specs=[
             pl.BlockSpec((None, bk, dh), lambda b_, kj: (b_, kj, 0)),

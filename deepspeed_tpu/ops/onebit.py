@@ -35,6 +35,7 @@ from typing import Any, NamedTuple, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 
 def _ensure_varying(x: jax.Array, axis_name: str) -> jax.Array:
@@ -320,9 +321,12 @@ class ZeroOneAdam(_OnebitBase):
                 (jnp.mod(count, self.var_update_scaler) == 0)) | (count == 1)
 
     def _sync_on(self, count):
-        k = jnp.minimum(
-            2 ** (count // jnp.maximum(self.local_step_scaler, 1)),
-            self.local_step_clipper)
+        # clip the EXPONENT before the power: int32 2**31 wraps negative and
+        # would silently disable momentum sync for the rest of training
+        max_exp = int(np.log2(max(self.local_step_clipper, 1)))
+        exp = jnp.minimum(count // jnp.maximum(self.local_step_scaler, 1),
+                          max_exp)
+        k = jnp.minimum(2 ** exp, self.local_step_clipper)
         return (count <= self.var_freeze_step) | (jnp.mod(count, k) == 0)
 
     def _var_from_momentum(self) -> bool:

@@ -1,0 +1,17 @@
+from deepspeed_tpu.ops.sparse_attention.sparsity_config import (
+    BigBirdSparsityConfig,
+    BSLongformerSparsityConfig,
+    DenseSparsityConfig,
+    FixedSparsityConfig,
+    LocalSlidingWindowSparsityConfig,
+    SparsityConfig,
+    VariableSparsityConfig,
+)
+from deepspeed_tpu.ops.sparse_attention.block_sparse import (
+    block_sparse_attention,
+    block_sparse_attention_reference,
+    layout_to_dense_mask,
+)
+from deepspeed_tpu.ops.sparse_attention.sparse_self_attention import (
+    SparseSelfAttention,
+)

@@ -1,0 +1,391 @@
+"""Block-sparse attention — Pallas TPU kernel + jnp reference.
+
+Reference analog: the Triton block-sparse matmul/softmax kernels
+(``deepspeed/ops/sparse_attention/matmul.py:17``, ``softmax.py``) behind
+``SparseSelfAttention`` (sparse_self_attention.py:12).  The reference builds
+per-layout look-up tables for its Triton kernels; here the same idea drives
+a Pallas flash-style kernel using SCALAR PREFETCH: the static LUT of active
+key blocks lives in SMEM and feeds the K/V BlockSpec index maps, so the
+pipeline stages exactly one [block × Dh] tile of K and V per grid step —
+VMEM is O(block·Dh) regardless of sequence length, and compute/HBM traffic
+scale with the number of active blocks (O(w·n) for window layouts) instead
+of O(n²).
+
+The grid is (batch·heads, query_blocks, lut_width); the online-softmax
+running max/sum/accumulator live in VMEM scratch carried across the last
+grid dimension (TPU grids execute sequentially, revisiting the same output
+block).  Backward reuses the forward LUT for dq and the transposed LUT for
+dk/dv.  Layouts are static numpy from ``sparsity_config.py`` — LUTs bake at
+trace time, so sparsity never introduces dynamic shapes.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_NEG_INF = -1e30
+
+
+# ------------------------------------------------------------------ layouts
+def layout_to_dense_mask(layout: np.ndarray, block: int) -> np.ndarray:
+    """[H, nb, nb] block layout → [H, T, T] boolean element mask."""
+    return np.repeat(np.repeat(layout, block, axis=1), block, axis=2)
+
+
+def _build_luts(layout: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
+    """layout [H, nq, nk] → (lut_q [H, nq, A], lut_k [H, nk, B]) of active
+    block indices padded with -1 (A/B = max row/col active count)."""
+    h, nq, nk = layout.shape
+    a = max(1, int(layout.sum(axis=2).max()))
+    b = max(1, int(layout.sum(axis=1).max()))
+    lut_q = np.full((h, nq, a), -1, np.int32)
+    lut_k = np.full((h, nk, b), -1, np.int32)
+    for hi in range(h):
+        for q in range(nq):
+            idx = np.nonzero(layout[hi, q])[0]
+            lut_q[hi, q, :len(idx)] = idx
+        for k in range(nk):
+            idx = np.nonzero(layout[hi, :, k])[0]
+            lut_k[hi, k, :len(idx)] = idx
+    return lut_q, lut_k
+
+
+def _normalize_layout(layout) -> np.ndarray:
+    """Dtype-normalize before hashing: raw-byte keys on an int/float layout
+    would silently misparse into a garbage LUT."""
+    return np.ascontiguousarray(np.asarray(layout) != 0)
+
+
+def _layout_key(layout: np.ndarray) -> Tuple[bytes, Tuple[int, int, int]]:
+    return layout.tobytes(), layout.shape
+
+
+@functools.lru_cache(maxsize=64)
+def _luts_cached(key: bytes, shape: Tuple[int, int, int]):
+    layout = np.frombuffer(key, dtype=bool).reshape(shape)
+    return _build_luts(layout)
+
+
+# ------------------------------------------------------------------- kernels
+def _masked_p(s, lse_or_mnew):
+    """exp(s - ref) with fully-masked entries forced to 0 (an all-masked row
+    would otherwise read exp(-inf + inf) = 1 and leak block-0 values)."""
+    p = jnp.exp(s - lse_or_mnew)
+    return jnp.where(s > _NEG_INF * 0.5, p, 0.0)
+
+
+def _fwd_kernel(lut_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
+                m_scr, l_scr, acc_scr, *, block: int, causal: bool,
+                scale: float, lut_width: int, num_heads: int):
+    bh = pl.program_id(0)
+    qi = pl.program_id(1)
+    j = pl.program_id(2)
+    h = jax.lax.rem(bh, num_heads)
+
+    @pl.when(j == 0)
+    def _init():
+        m_scr[...] = jnp.full_like(m_scr, _NEG_INF)
+        l_scr[...] = jnp.zeros_like(l_scr)
+        acc_scr[...] = jnp.zeros_like(acc_scr)
+
+    kj = lut_ref[h, qi, j]
+    valid = kj >= 0
+    q = q_ref[...].astype(jnp.float32) * scale            # [BLK, Dh]
+    blk, dh = q.shape
+    k = k_ref[...].astype(jnp.float32)                    # [BLK, Dh]
+    v = v_ref[...].astype(jnp.float32)
+    s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())))  # [BLK, BLK]
+    if causal:
+        q_pos = qi * block + jax.lax.broadcasted_iota(jnp.int32, (blk, blk), 0)
+        k_pos = jnp.maximum(kj, 0) * block + jax.lax.broadcasted_iota(
+            jnp.int32, (blk, blk), 1)
+        s = jnp.where(k_pos <= q_pos, s, _NEG_INF)
+    s = jnp.where(valid, s, _NEG_INF)
+
+    m_prev, l_prev, acc_prev = m_scr[...], l_scr[...], acc_scr[...]
+    m_new = jnp.maximum(m_prev, s.max(axis=-1))
+    p = _masked_p(s, m_new[:, None])
+    corr = jnp.exp(m_prev - m_new)
+    l_new = l_prev * corr + p.sum(axis=-1)
+    acc_new = acc_prev * corr[:, None] + jax.lax.dot(p, v)
+    m_scr[...], l_scr[...], acc_scr[...] = m_new, l_new, acc_new
+
+    @pl.when(j == lut_width - 1)
+    def _finalize():
+        l_safe = jnp.maximum(l_new, 1e-20)
+        o_ref[...] = jnp.where(l_new[:, None] > 0,
+                               acc_new / l_safe[:, None], 0.0).astype(o_ref.dtype)
+        # lse carries a trailing unit dim: rank-2 (block, 1) tiles satisfy
+        # the TPU block-shape constraint where 1-D tiles do not
+        lse_ref[...] = (m_new + jnp.log(l_safe)).astype(jnp.float32)[:, None]
+
+
+def _bwd_dq_kernel(lut_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                   dq_ref, dq_scr, *, block: int, causal: bool, scale: float,
+                   lut_width: int, num_heads: int):
+    bh = pl.program_id(0)
+    qi = pl.program_id(1)
+    j = pl.program_id(2)
+    h = jax.lax.rem(bh, num_heads)
+
+    @pl.when(j == 0)
+    def _init():
+        dq_scr[...] = jnp.zeros_like(dq_scr)
+
+    kj = lut_ref[h, qi, j]
+    valid = kj >= 0
+    q = q_ref[...].astype(jnp.float32) * scale
+    do = do_ref[...].astype(jnp.float32)
+    blk, dh = q.shape
+    lse = lse_ref[...][:, 0]
+    delta = delta_ref[...][:, 0]
+    k = k_ref[...].astype(jnp.float32)
+    v = v_ref[...].astype(jnp.float32)
+    s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())))
+    if causal:
+        q_pos = qi * block + jax.lax.broadcasted_iota(jnp.int32, (blk, blk), 0)
+        k_pos = jnp.maximum(kj, 0) * block + jax.lax.broadcasted_iota(
+            jnp.int32, (blk, blk), 1)
+        s = jnp.where(k_pos <= q_pos, s, _NEG_INF)
+    s = jnp.where(valid, s, _NEG_INF)
+    p = _masked_p(s, lse[:, None])
+    dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())))
+    ds = p * (dp - delta[:, None])
+    dq_scr[...] = dq_scr[...] + jax.lax.dot(ds, k)
+
+    @pl.when(j == lut_width - 1)
+    def _finalize():
+        dq_ref[...] = (dq_scr[...] * scale).astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(lut_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                    dk_ref, dv_ref, dk_scr, dv_scr, *, block: int, causal: bool,
+                    scale: float, lut_width: int, num_heads: int):
+    bh = pl.program_id(0)
+    kj = pl.program_id(1)
+    j = pl.program_id(2)
+    h = jax.lax.rem(bh, num_heads)
+
+    @pl.when(j == 0)
+    def _init():
+        dk_scr[...] = jnp.zeros_like(dk_scr)
+        dv_scr[...] = jnp.zeros_like(dv_scr)
+
+    qi = lut_ref[h, kj, j]
+    valid = qi >= 0
+    k = k_ref[...].astype(jnp.float32)
+    v = v_ref[...].astype(jnp.float32)
+    blk, dh = k.shape
+    q = q_ref[...].astype(jnp.float32) * scale
+    do = do_ref[...].astype(jnp.float32)
+    lse = lse_ref[...][:, 0]
+    delta = delta_ref[...][:, 0]
+    s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())))  # [BQ, BK]
+    if causal:
+        q_pos = jnp.maximum(qi, 0) * block + jax.lax.broadcasted_iota(
+            jnp.int32, (blk, blk), 0)
+        k_pos = kj * block + jax.lax.broadcasted_iota(jnp.int32, (blk, blk), 1)
+        s = jnp.where(k_pos <= q_pos, s, _NEG_INF)
+    s = jnp.where(valid, s, _NEG_INF)
+    p = _masked_p(s, lse[:, None])
+    dv_scr[...] = dv_scr[...] + jax.lax.dot_general(
+        p, do, (((0,), (0,)), ((), ())))
+    dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())))
+    ds = p * (dp - delta[:, None])
+    dk_scr[...] = dk_scr[...] + jax.lax.dot_general(
+        ds, q, (((0,), (0,)), ((), ())))
+
+    @pl.when(j == lut_width - 1)
+    def _finalize():
+        dk_ref[...] = dk_scr[...].astype(dk_ref.dtype)
+        dv_ref[...] = dv_scr[...].astype(dv_ref.dtype)
+
+
+# ----------------------------------------------------------------- host side
+def _reshape_bh(x):
+    b, t, h, dh = x.shape
+    return x.transpose(0, 2, 1, 3).reshape(b * h, t, dh)
+
+
+def _unshape_bh(x, b, h):
+    bh, t, dh = x.shape
+    return x.reshape(b, h, t, dh).transpose(0, 2, 1, 3)
+
+
+def _interpret_default() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+def _lut_block_index(lut, num_heads):
+    """K/V index map: stage the ACTIVE key block named by the LUT (clamped
+    for padding slots, whose contribution the kernel masks out)."""
+
+    def index(bh, qi, j, lut_ref):
+        return bh, jnp.maximum(lut_ref[jax.lax.rem(bh, num_heads), qi, j], 0), 0
+
+    return index
+
+
+def _sparse_attention_fwd(q, k, v, layout, block, causal, scale, interpret):
+    b, t, h, dh = q.shape
+    nb = t // block
+    layout = _normalize_layout(layout)
+    assert layout.shape == (h, nb, nb), \
+        f"layout {layout.shape} != ({h}, {nb}, {nb})"
+    sc = scale if scale is not None else dh ** -0.5
+    interp = _interpret_default() if interpret is None else interpret
+    lut_q, _ = _luts_cached(*_layout_key(layout))
+    a = lut_q.shape[-1]
+    qf, kf, vf = _reshape_bh(q), _reshape_bh(k), _reshape_bh(v)
+    kernel = functools.partial(_fwd_kernel, block=block, causal=causal,
+                               scale=sc, lut_width=a, num_heads=h)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(b * h, nb, a),
+        in_specs=[
+            pl.BlockSpec((None, block, dh), lambda bh, qi, j, lut: (bh, qi, 0)),
+            pl.BlockSpec((None, block, dh), _lut_block_index(lut_q, h)),
+            pl.BlockSpec((None, block, dh), _lut_block_index(lut_q, h)),
+        ],
+        out_specs=[
+            pl.BlockSpec((None, block, dh), lambda bh, qi, j, lut: (bh, qi, 0)),
+            pl.BlockSpec((None, block, 1), lambda bh, qi, j, lut: (bh, qi, 0)),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block,), jnp.float32),
+            pltpu.VMEM((block,), jnp.float32),
+            pltpu.VMEM((block, dh), jnp.float32),
+        ],
+    )
+    out, lse = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=[
+            jax.ShapeDtypeStruct((b * h, t, dh), q.dtype),
+            jax.ShapeDtypeStruct((b * h, t, 1), jnp.float32),
+        ],
+        interpret=interp,
+    )(jnp.asarray(lut_q), qf, kf, vf)
+    return _unshape_bh(out, b, h), (qf, kf, vf, out, lse, (b, h))
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def block_sparse_attention(q, k, v, layout, block: int = 16,
+                           causal: bool = False,
+                           scale: Optional[float] = None,
+                           interpret: Optional[bool] = None):
+    """q/k/v: [B, T, H, Dh]; ``layout``: STATIC numpy [H, T//block, T//block]
+    bool (hash-keyed for the LUT cache — pass the array from a
+    SparsityConfig, not a traced value)."""
+    out, _ = _sparse_attention_fwd(q, k, v, layout, block, causal, scale,
+                                   interpret)
+    return out
+
+
+def _bsa_fwd_vjp(q, k, v, layout, block, causal, scale, interpret):
+    return _sparse_attention_fwd(q, k, v, layout, block, causal, scale,
+                                 interpret)
+
+
+def _bsa_bwd_vjp(layout, block, causal, scale, interpret, res, g):
+    qf, kf, vf, outf, lse, (b, h) = res
+    bh, t, dh = qf.shape
+    nb = t // block
+    layout = _normalize_layout(layout)
+    sc = scale if scale is not None else dh ** -0.5
+    interp = _interpret_default() if interpret is None else interpret
+    lut_q, lut_k = _luts_cached(*_layout_key(layout))
+    a, bb = lut_q.shape[-1], lut_k.shape[-1]
+    dof = _reshape_bh(g)
+    delta = jnp.sum(dof.astype(jnp.float32) * outf.astype(jnp.float32),
+                    axis=-1, keepdims=True)                 # [bh, t, 1]
+
+    qi_block = lambda bh_, qi, j, lut: (bh_, qi, 0)
+    dq_grid = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(bh, nb, a),
+        in_specs=[
+            pl.BlockSpec((None, block, dh), qi_block),
+            pl.BlockSpec((None, block, dh), _lut_block_index(lut_q, h)),
+            pl.BlockSpec((None, block, dh), _lut_block_index(lut_q, h)),
+            pl.BlockSpec((None, block, dh), qi_block),
+            pl.BlockSpec((None, block, 1), qi_block),
+            pl.BlockSpec((None, block, 1), qi_block),
+        ],
+        out_specs=pl.BlockSpec((None, block, dh), qi_block),
+        scratch_shapes=[pltpu.VMEM((block, dh), jnp.float32)],
+    )
+    dq = pl.pallas_call(
+        functools.partial(_bwd_dq_kernel, block=block, causal=causal,
+                          scale=sc, lut_width=a, num_heads=h),
+        grid_spec=dq_grid,
+        out_shape=jax.ShapeDtypeStruct((bh, t, dh), qf.dtype),
+        interpret=interp,
+    )(jnp.asarray(lut_q), qf, kf, vf, dof, lse, delta)
+
+    kv_block = lambda bh_, kj, j, lut: (bh_, kj, 0)
+    lut_block = _lut_block_index(lut_k, h)
+    dkv_grid = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(bh, nb, bb),
+        in_specs=[
+            pl.BlockSpec((None, block, dh), lut_block),   # q (active block)
+            pl.BlockSpec((None, block, dh), kv_block),    # k (my block)
+            pl.BlockSpec((None, block, dh), kv_block),    # v
+            pl.BlockSpec((None, block, dh), lut_block),   # do
+            pl.BlockSpec((None, block, 1), lut_block),    # lse
+            pl.BlockSpec((None, block, 1), lut_block),    # delta
+        ],
+        out_specs=[
+            pl.BlockSpec((None, block, dh), kv_block),
+            pl.BlockSpec((None, block, dh), kv_block),
+        ],
+        scratch_shapes=[pltpu.VMEM((block, dh), jnp.float32),
+                        pltpu.VMEM((block, dh), jnp.float32)],
+    )
+    dk, dv = pl.pallas_call(
+        functools.partial(_bwd_dkv_kernel, block=block, causal=causal,
+                          scale=sc, lut_width=bb, num_heads=h),
+        grid_spec=dkv_grid,
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, t, dh), kf.dtype),
+            jax.ShapeDtypeStruct((bh, t, dh), vf.dtype),
+        ],
+        interpret=interp,
+    )(jnp.asarray(lut_k), qf, kf, vf, dof, lse, delta)
+
+    return (_unshape_bh(dq, b, h), _unshape_bh(dk, b, h), _unshape_bh(dv, b, h))
+
+
+block_sparse_attention.defvjp(_bsa_fwd_vjp, _bsa_bwd_vjp)
+
+
+# --------------------------------------------------------------- jnp oracle
+def block_sparse_attention_reference(q, k, v, layout, block: int = 16,
+                                     causal: bool = False,
+                                     scale: Optional[float] = None):
+    """Dense masked-softmax oracle (numerics ground truth for tests)."""
+    b, t, h, dh = q.shape
+    sc = scale if scale is not None else dh ** -0.5
+    mask = jnp.asarray(layout_to_dense_mask(_normalize_layout(layout), block))
+    if causal:
+        mask = mask & np.tril(np.ones((t, t), bool))[None]
+    qf = q.transpose(0, 2, 1, 3).astype(jnp.float32) * sc     # [B,H,T,Dh]
+    kf = k.transpose(0, 2, 1, 3).astype(jnp.float32)
+    vf = v.transpose(0, 2, 1, 3).astype(jnp.float32)
+    s = jnp.einsum("bhqd,bhkd->bhqk", qf, kf)
+    s = jnp.where(mask[None], s, _NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    o = jnp.einsum("bhqk,bhkd->bhqd", p, vf)
+    # rows the layout masks entirely produce zeros (kernel semantics), not a
+    # uniform average
+    any_active = mask.any(axis=-1)                            # [H, T]
+    o = jnp.where(any_active[None, :, :, None], o, 0.0)
+    return o.transpose(0, 2, 1, 3).astype(q.dtype)

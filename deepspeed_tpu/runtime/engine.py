@@ -129,8 +129,10 @@ class DeepSpeedEngine:
             # the engine's GSPMD step communicates grads exactly (XLA-
             # scheduled), so compression would never engage — run the exact
             # math and skip the error-state memory; the true 1-bit path is
-            # the shard_map loop with local grads (ops/onebit.py docstring)
-            optimizer.with_compression = False
+            # the shard_map loop with local grads (ops/onebit.py docstring).
+            # replace, don't mutate: the caller may use the same instance on
+            # the compressed path
+            optimizer = dataclasses.replace(optimizer, with_compression=False)
             log_dist("1-bit optimizer under the GSPMD engine uses exact "
                      "communication (no compression, no error-state memory); "
                      "use the shard_map path for compressed comm", ranks=[0])

@@ -1,0 +1,158 @@
+"""Block-sparse attention: sparsity-config layouts, kernel vs dense-masked
+oracle (fwd + grads), SparseSelfAttention module (reference
+tests/unit/ops/sparse_attention/ shape)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepspeed_tpu.ops.sparse_attention import (
+    BigBirdSparsityConfig,
+    BSLongformerSparsityConfig,
+    DenseSparsityConfig,
+    FixedSparsityConfig,
+    LocalSlidingWindowSparsityConfig,
+    SparseSelfAttention,
+    VariableSparsityConfig,
+    block_sparse_attention,
+    block_sparse_attention_reference,
+)
+
+H, BLK, T = 2, 8, 64  # 8 blocks
+
+
+def _qkv(b=2, t=T, h=H, dh=16, seed=0):
+    rng = np.random.RandomState(seed)
+    mk = lambda: jnp.asarray(rng.randn(b, t, h, dh) * 0.3, jnp.float32)
+    return mk(), mk(), mk()
+
+
+class TestLayouts:
+    def test_dense(self):
+        lo = DenseSparsityConfig(num_heads=H, block=BLK).make_layout(T)
+        assert lo.shape == (H, T // BLK, T // BLK) and lo.all()
+
+    def test_fixed_is_sparse_and_local(self):
+        lo = FixedSparsityConfig(num_heads=H, block=BLK, num_local_blocks=2,
+                                 num_global_blocks=1).make_layout(T)
+        assert 0 < lo.sum() < lo.size
+        for q in range(T // BLK):
+            assert lo[0, q, (q // 2) * 2]  # own window start active
+
+    def test_fixed_unidirectional_is_lower_triangular(self):
+        lo = FixedSparsityConfig(num_heads=H, block=BLK, num_local_blocks=2,
+                                 attention="unidirectional").make_layout(T)
+        assert not np.triu(lo[0], k=1).any()
+        assert lo[0].diagonal().all()  # diag blocks always on
+
+    def test_bigbird_window_and_global(self):
+        lo = BigBirdSparsityConfig(num_heads=H, block=BLK, num_random_blocks=1,
+                                   num_sliding_window_blocks=3,
+                                   num_global_blocks=1).make_layout(T)
+        nb = T // BLK
+        for q in range(nb):
+            assert lo[0, q, q]          # window diagonal
+            assert lo[0, q, 0]          # global col
+        assert lo[0, 0].all()           # global row
+
+    def test_longformer_window_and_globals(self):
+        lo = BSLongformerSparsityConfig(
+            num_heads=H, block=BLK, num_sliding_window_blocks=3,
+            global_block_indices=[2]).make_layout(T)
+        assert lo[0, :, 2].all() and lo[0, 2, :].all()
+
+    def test_sliding_window_causal(self):
+        lo = LocalSlidingWindowSparsityConfig(
+            num_heads=H, block=BLK, num_sliding_window_blocks=2).make_layout(T)
+        assert not np.triu(lo[0], k=1).any()
+        assert lo[0, 5, 4] and lo[0, 5, 5] and not lo[0, 5, 3]
+
+    def test_bad_seq_len_raises(self):
+        with pytest.raises(ValueError, match="divisible"):
+            FixedSparsityConfig(num_heads=H, block=BLK).make_layout(T + 3)
+
+    def test_same_layout_propagated_across_heads(self):
+        lo = BigBirdSparsityConfig(num_heads=4, block=BLK, seed=3,
+                                   different_layout_per_head=False
+                                   ).make_layout(T)
+        assert (lo[0] == lo[1]).all() and (lo[0] == lo[3]).all()
+
+
+CONFIGS = [
+    ("dense", DenseSparsityConfig(num_heads=H, block=BLK), False),
+    ("fixed", FixedSparsityConfig(num_heads=H, block=BLK, num_local_blocks=2,
+                                  num_global_blocks=1), False),
+    ("fixed_causal", FixedSparsityConfig(num_heads=H, block=BLK,
+                                         num_local_blocks=2,
+                                         attention="unidirectional"), True),
+    ("bigbird", BigBirdSparsityConfig(num_heads=H, block=BLK,
+                                      num_random_blocks=1,
+                                      num_sliding_window_blocks=3), False),
+    ("longformer", BSLongformerSparsityConfig(
+        num_heads=H, block=BLK, num_sliding_window_blocks=3), False),
+    ("sliding", LocalSlidingWindowSparsityConfig(
+        num_heads=H, block=BLK, num_sliding_window_blocks=3), True),
+    ("variable", VariableSparsityConfig(
+        num_heads=H, block=BLK, local_window_blocks=[1, 2],
+        global_block_indices=[0]), False),
+]
+
+
+class TestKernelVsOracle:
+    @pytest.mark.parametrize("name,cfg,causal",
+                             CONFIGS, ids=[c[0] for c in CONFIGS])
+    def test_forward_matches(self, name, cfg, causal):
+        q, k, v = _qkv()
+        layout = cfg.make_layout(T)
+        out = block_sparse_attention(q, k, v, layout, block=BLK, causal=causal)
+        ref = block_sparse_attention_reference(q, k, v, layout, block=BLK,
+                                               causal=causal)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=2e-4, atol=2e-5)
+
+    @pytest.mark.parametrize("name,cfg,causal", CONFIGS[:4],
+                             ids=[c[0] for c in CONFIGS[:4]])
+    def test_grads_match(self, name, cfg, causal):
+        q, k, v = _qkv(b=1, t=T, dh=8, seed=1)
+        layout = cfg.make_layout(T)
+
+        def loss_k(q, k, v):
+            return jnp.sum(block_sparse_attention(q, k, v, layout, BLK,
+                                                  causal) ** 2)
+
+        def loss_r(q, k, v):
+            return jnp.sum(block_sparse_attention_reference(
+                q, k, v, layout, BLK, causal) ** 2)
+
+        gk = jax.grad(loss_k, argnums=(0, 1, 2))(q, k, v)
+        gr = jax.grad(loss_r, argnums=(0, 1, 2))(q, k, v)
+        for a, b in zip(gk, gr):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=5e-4, atol=5e-5)
+
+
+class TestSparseSelfAttention:
+    def test_module_applies_config(self):
+        q, k, v = _qkv()
+        mod = SparseSelfAttention(FixedSparsityConfig(
+            num_heads=H, block=BLK, num_local_blocks=2,
+            attention="unidirectional"))
+        out = mod(q, k, v)
+        ref = block_sparse_attention_reference(
+            q, k, v, mod.get_layout(T), block=BLK, causal=True)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=2e-4, atol=2e-5)
+
+    def test_layout_cached_per_seq_len(self):
+        mod = SparseSelfAttention(BigBirdSparsityConfig(num_heads=H, block=BLK))
+        l1 = mod.get_layout(T)
+        assert mod.get_layout(T) is l1
+
+    def test_pad_to_block_size(self):
+        ids = jnp.ones((2, 30), jnp.int32)
+        pad, padded, _ = SparseSelfAttention.pad_to_block_size(16, ids, 0)
+        assert pad == 2 and padded.shape == (2, 32)
+        out = SparseSelfAttention.unpad_sequence_output(
+            pad, jnp.ones((2, 32, 4)))
+        assert out.shape == (2, 30, 4)
