@@ -59,6 +59,13 @@ def initialize(args=None, model=None, optimizer=None, model_parameters=None,
         engine = PipelineEngine(model, ds_config, optimizer=optimizer,
                                 lr_scheduler=lr_scheduler, training_data=training_data,
                                 collate_fn=collate_fn, topology=topology)
+    elif ds_config.hybrid_engine.enabled:
+        from deepspeed_tpu.runtime.hybrid_engine import DeepSpeedHybridEngine
+
+        engine = DeepSpeedHybridEngine(model, ds_config, optimizer=optimizer,
+                                       lr_scheduler=lr_scheduler,
+                                       training_data=training_data,
+                                       collate_fn=collate_fn, topology=topology)
     else:
         engine = DeepSpeedEngine(model, ds_config, optimizer=optimizer,
                                  lr_scheduler=lr_scheduler, training_data=training_data,

@@ -1,0 +1,102 @@
+"""AutoTP — automatic tensor-parallel sharding inference.
+
+Reference analog: ``AutoTP.tp_parser`` (module_inject/auto_tp.py:84): for an
+arbitrary HF model, discover which linear layers must be row-parallel (their
+output feeds the residual stream, so TP requires an all-reduce there) vs
+column-parallel, without a hand-written policy.  The reference returns a
+"gem list" of modules to slice + allreduce; here the output is a
+PartitionSpec pytree over the params — XLA inserts the psum when the row-
+sharded matmul's output is required replicated, which is exactly the
+all-reduce AutoTP hand-places.
+
+Heuristic (same as the reference's name-based parser): a 2-D weight whose
+name marks it as an output projection (attention out / MLP down) is
+row-parallel ([model, None] over its [in, out] dims); every other 2-D
+weight is column-parallel ([None, model]); biases follow their weight
+(col-parallel bias is sharded, row-parallel bias is replicated — it is
+added after the reduce); 1-D norms/embedding tables replicate.
+Stacked-layer leading dims (our scanned blocks) are passed through as None.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+from jax.sharding import PartitionSpec as P
+
+# output-projection name fragments (reference auto_tp.py load-balanced names:
+# o_proj/out_proj/down_proj/dense_4h_to_h/attention.dense/c_proj + this
+# framework's own layouts)
+ROW_PARALLEL_PATTERNS = (
+    "attn_out_w", "mlp_out_w", "wo", "w_down",
+    "o_proj", "out_proj", "down_proj", "dense_4h_to_h", "c_proj",
+    "attention_dense", "attention.dense",
+)
+# embedding-style tables: replicate (vocab sharding is a separate choice)
+EMBED_PATTERNS = ("wte", "wpe", "embed", "lm_head", "word_embeddings")
+
+
+def classify(name: str, ndim: int) -> str:
+    """'row' | 'col' | 'replicate' for one param (reference tp_parser's
+    per-module decision)."""
+    lname = name.lower()
+    if ndim < 2 or any(p in lname for p in EMBED_PATTERNS):
+        return "replicate"
+    if any(p in lname for p in ROW_PARALLEL_PATTERNS):
+        return "row"
+    return "col"
+
+
+def _bias_kind(name: str) -> Optional[str]:
+    """A 1-D bias follows its weight's class: col-parallel bias is sharded,
+    row-parallel bias replicated (added post-reduce)."""
+    # keystr paths look like "['blocks']['qkv_b']" — strip punctuation tails
+    lname = name.lower().rstrip("]'\"")
+    if not re.search(r"(_b|bias)$", lname):
+        return None
+    wname = re.sub(r"_b$", "_w", lname)
+    wname = re.sub(r"bias$", "weight", wname)
+    if any(p in wname for p in ROW_PARALLEL_PATTERNS):
+        return "replicate"
+    if any(p in wname for p in EMBED_PATTERNS) or "ln" in lname or \
+            "norm" in lname:
+        return "replicate"
+    return "col-bias"
+
+
+def tp_parser(params) -> Dict[str, str]:
+    """Param path → 'row' | 'col' | 'col-bias' | 'replicate'."""
+    flat, _ = jax.tree_util.tree_flatten_with_path(params)
+    out = {}
+    for path, leaf in flat:
+        name = jax.tree_util.keystr(path)
+        bias = _bias_kind(name)
+        if bias is not None:
+            out[name] = bias
+        else:
+            out[name] = classify(name, getattr(leaf, "ndim", 0))
+    return out
+
+
+def tp_shard_specs(params, model_axis: str = "model"):
+    """PartitionSpec pytree implementing the parsed plan: the TP sharding a
+    hand-written policy would produce, inferred (reference AutoTP outcome)."""
+    kinds = tp_parser(params)
+    flat, treedef = jax.tree_util.tree_flatten_with_path(params)
+    specs = []
+    for path, leaf in flat:
+        name = jax.tree_util.keystr(path)
+        kind = kinds[name]
+        nd = getattr(leaf, "ndim", 0)
+        lead = (None,) * (nd - 2)  # stacked-layer dims stay unsharded
+        if kind == "row" and nd >= 2:
+            specs.append(P(*lead, model_axis, None))
+        elif kind == "col" and nd >= 2:
+            specs.append(P(*lead, None, model_axis))
+        elif kind == "col-bias" and nd >= 1:
+            specs.append(P(*((None,) * (nd - 1)), model_axis))
+        else:
+            specs.append(P())
+    return jax.tree_util.tree_unflatten(treedef, specs)

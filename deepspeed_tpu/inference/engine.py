@@ -152,7 +152,10 @@ class InferenceEngine:
                 f"raise it in the inference config")
         # position-table guard: past max_seq_len the wpe/RoPE gathers clamp and
         # silently produce garbage — fail loudly instead
-        model_max = getattr(getattr(self.module, "config", None), "max_seq_len", None)
+        mcfg = getattr(self.module, "config", None)
+        model_max = getattr(mcfg, "max_seq_len", None)
+        if not getattr(mcfg, "has_position_table", True):
+            model_max = None  # pure-ALiBi models extrapolate freely
         if model_max is not None and total > model_max:
             raise RuntimeError(
                 f"generate: input+new tokens {total} exceeds the model's "

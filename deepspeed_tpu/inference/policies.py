@@ -51,16 +51,47 @@ def _np(t) -> np.ndarray:
     return t.detach().cpu().float().numpy()
 
 
-def _interleave_rope_columns(w: np.ndarray, num_heads: int) -> np.ndarray:
-    """Permute projection output columns from HF half-split RoPE layout to
-    interleaved: per head, column order [0, dh/2, 1, dh/2+1, ...]."""
+def _interleave_rope_columns(w: np.ndarray, num_heads: int,
+                             rotary_dim: int = 0) -> np.ndarray:
+    """Permute projection output columns from HF half-split (rotate_half)
+    RoPE layout to interleaved: per head, [0, rd/2, 1, rd/2+1, ...].
+    ``rotary_dim`` limits the permutation to each head's rotary slice
+    (GPT-NeoX partial rotary); 0 = whole head."""
     d_in, d_out = w.shape
     dh = d_out // num_heads
-    perm = np.empty(dh, dtype=np.int64)
-    perm[0::2] = np.arange(dh // 2)
-    perm[1::2] = np.arange(dh // 2) + dh // 2
+    rd = rotary_dim or dh
+    perm = np.arange(dh)
+    perm[0:rd:2] = np.arange(rd // 2)
+    perm[1:rd:2] = np.arange(rd // 2) + rd // 2
     w = w.reshape(d_in, num_heads, dh)[:, :, perm]
     return w.reshape(d_in, d_out)
+
+
+def _dense_blocks(sd, num_layers, fmt_map, post_map=None):
+    """Stack per-layer tensors into the scanned-blocks layout."""
+    import jax.numpy as jnp
+
+    post_map = post_map or {}
+    out = {}
+    for name, fmt in fmt_map.items():
+        post = post_map.get(name, lambda x: x)
+        out[name] = jnp.asarray(np.stack(
+            [post(_np(sd[fmt.format(i=i)])) for i in range(num_layers)]))
+    return out
+
+
+def _fuse_headwise_qkv(w: np.ndarray, num_heads: int) -> np.ndarray:
+    """HF BLOOM/GPT-NeoX fused qkv rows are laid out [h, 3, dh]; convert to
+    [d_in, 3d_out] with output columns ordered q(all heads), k, v."""
+    three_d, d_in = w.shape
+    dh = three_d // (3 * num_heads)
+    w = w.reshape(num_heads, 3, dh, d_in).transpose(1, 0, 2, 3)
+    return w.reshape(three_d, d_in).T
+
+
+def _fuse_headwise_qkv_bias(b: np.ndarray, num_heads: int) -> np.ndarray:
+    dh = b.shape[0] // (3 * num_heads)
+    return b.reshape(num_heads, 3, dh).transpose(1, 0, 2).reshape(-1)
 
 
 @register_policy("GPT2LMHeadModel")
@@ -161,4 +192,240 @@ def llama_policy(hf_model, dtype):
         "lm_head": jnp.asarray(
             _np(sd.get("lm_head.weight", sd["model.embed_tokens.weight"])).T),
     }
+    return model, params
+
+
+def _lin(x: np.ndarray) -> np.ndarray:
+    """HF Linear [out, in] → [in, out]."""
+    return x.T
+
+
+@register_policy("OPTForCausalLM")
+def opt_policy(hf_model, dtype):
+    """HF OPTForCausalLM → DecoderModel.opt (reference
+    module_inject/containers/opt.py HFOPTLayerPolicy)."""
+    import jax.numpy as jnp
+
+    from deepspeed_tpu.models.transformer import DecoderConfig, DecoderModel
+
+    hc = hf_model.config
+    # opt-350m style variants project embeddings (word_embed_proj_dim !=
+    # hidden) and/or use post-LN — reject with a clear message rather than
+    # mis-mapping weights
+    if getattr(hc, "word_embed_proj_dim", hc.hidden_size) != hc.hidden_size:
+        raise ValueError(
+            "opt_policy: word_embed_proj_dim != hidden_size (project_in/out "
+            "variants like opt-350m) is not supported")
+    if not getattr(hc, "do_layer_norm_before", True):
+        raise ValueError("opt_policy: post-LN OPT variants "
+                         "(do_layer_norm_before=False) are not supported")
+    cfg = DecoderConfig.opt(
+        vocab_size=hc.vocab_size, max_seq_len=hc.max_position_embeddings,
+        num_layers=hc.num_hidden_layers, hidden_size=hc.hidden_size,
+        num_heads=hc.num_attention_heads, mlp_dim=hc.ffn_dim)
+    model = DecoderModel(cfg, compute_dtype=dtype)
+    sd = hf_model.state_dict()
+    p = "model.decoder."
+    L = cfg.num_layers
+
+    def qkv(i):
+        return np.concatenate(
+            [_lin(_np(sd[f"{p}layers.{i}.self_attn.{x}_proj.weight"]))
+             for x in ("q", "k", "v")], axis=1)
+
+    def qkv_b(i):
+        return np.concatenate(
+            [_np(sd[f"{p}layers.{i}.self_attn.{x}_proj.bias"])
+             for x in ("q", "k", "v")])
+
+    blocks = _dense_blocks(sd, L, {
+        "ln1_scale": p + "layers.{i}.self_attn_layer_norm.weight",
+        "ln1_bias": p + "layers.{i}.self_attn_layer_norm.bias",
+        "attn_out_w": p + "layers.{i}.self_attn.out_proj.weight",
+        "attn_out_b": p + "layers.{i}.self_attn.out_proj.bias",
+        "ln2_scale": p + "layers.{i}.final_layer_norm.weight",
+        "ln2_bias": p + "layers.{i}.final_layer_norm.bias",
+        "mlp_fc_w": p + "layers.{i}.fc1.weight",
+        "mlp_fc_b": p + "layers.{i}.fc1.bias",
+        "mlp_out_w": p + "layers.{i}.fc2.weight",
+        "mlp_out_b": p + "layers.{i}.fc2.bias",
+    }, post_map={"attn_out_w": _lin, "mlp_fc_w": _lin, "mlp_out_w": _lin})
+    blocks["qkv_w"] = jnp.asarray(np.stack([qkv(i) for i in range(L)]))
+    blocks["qkv_b"] = jnp.asarray(np.stack([qkv_b(i) for i in range(L)]))
+    params = {
+        "wte": jnp.asarray(_np(sd[p + "embed_tokens.weight"])),
+        "wpe": jnp.asarray(_np(sd[p + "embed_positions.weight"])),
+        "blocks": blocks,
+        "ln_f_scale": jnp.asarray(_np(sd[p + "final_layer_norm.weight"])),
+        "ln_f_bias": jnp.asarray(_np(sd[p + "final_layer_norm.bias"])),
+    }
+    return model, params
+
+
+@register_policy("BloomForCausalLM")
+def bloom_policy(hf_model, dtype):
+    """HF BloomForCausalLM → DecoderModel.bloom (reference
+    module_inject/containers/bloom.py BLOOMLayerPolicy): ALiBi attention,
+    embedding LayerNorm, head-interleaved fused qkv de-interleaved on load."""
+    import jax.numpy as jnp
+
+    from deepspeed_tpu.models.transformer import DecoderConfig, DecoderModel
+
+    hc = hf_model.config
+    cfg = DecoderConfig.bloom(
+        vocab_size=hc.vocab_size,
+        max_seq_len=getattr(hc, "seq_length", 2048),
+        num_layers=hc.n_layer, hidden_size=hc.hidden_size,
+        num_heads=hc.n_head, mlp_dim=4 * hc.hidden_size,
+        eps=hc.layer_norm_epsilon)
+    model = DecoderModel(cfg, compute_dtype=dtype)
+    sd = hf_model.state_dict()
+    p = "transformer."
+    L, H = cfg.num_layers, cfg.num_heads
+
+    blocks = _dense_blocks(sd, L, {
+        "ln1_scale": p + "h.{i}.input_layernorm.weight",
+        "ln1_bias": p + "h.{i}.input_layernorm.bias",
+        "attn_out_w": p + "h.{i}.self_attention.dense.weight",
+        "attn_out_b": p + "h.{i}.self_attention.dense.bias",
+        "ln2_scale": p + "h.{i}.post_attention_layernorm.weight",
+        "ln2_bias": p + "h.{i}.post_attention_layernorm.bias",
+        "mlp_fc_w": p + "h.{i}.mlp.dense_h_to_4h.weight",
+        "mlp_fc_b": p + "h.{i}.mlp.dense_h_to_4h.bias",
+        "mlp_out_w": p + "h.{i}.mlp.dense_4h_to_h.weight",
+        "mlp_out_b": p + "h.{i}.mlp.dense_4h_to_h.bias",
+    }, post_map={"attn_out_w": _lin, "mlp_fc_w": _lin, "mlp_out_w": _lin})
+    blocks["qkv_w"] = jnp.asarray(np.stack(
+        [_fuse_headwise_qkv(
+            _np(sd[f"{p}h.{i}.self_attention.query_key_value.weight"]), H)
+         for i in range(L)]))
+    blocks["qkv_b"] = jnp.asarray(np.stack(
+        [_fuse_headwise_qkv_bias(
+            _np(sd[f"{p}h.{i}.self_attention.query_key_value.bias"]), H)
+         for i in range(L)]))
+    params = {
+        "wte": jnp.asarray(_np(sd[p + "word_embeddings.weight"])),
+        "emb_ln_scale": jnp.asarray(
+            _np(sd[p + "word_embeddings_layernorm.weight"])),
+        "emb_ln_bias": jnp.asarray(
+            _np(sd[p + "word_embeddings_layernorm.bias"])),
+        "blocks": blocks,
+        "ln_f_scale": jnp.asarray(_np(sd[p + "ln_f.weight"])),
+        "ln_f_bias": jnp.asarray(_np(sd[p + "ln_f.bias"])),
+    }
+    return model, params
+
+
+@register_policy("GPTNeoXForCausalLM")
+def gpt_neox_policy(hf_model, dtype):
+    """HF GPTNeoXForCausalLM → DecoderModel.gpt_neox (reference
+    module_inject/containers/gptneox.py): parallel residual, partial rotary
+    (rotate_half checkpoint → interleaved columns on load)."""
+    import jax.numpy as jnp
+
+    from deepspeed_tpu.models.transformer import DecoderConfig, DecoderModel
+
+    hc = hf_model.config
+    head_dim = hc.hidden_size // hc.num_attention_heads
+    rotary_dim = int(head_dim * hc.rotary_pct)
+    cfg = DecoderConfig.gpt_neox(
+        vocab_size=hc.vocab_size, max_seq_len=hc.max_position_embeddings,
+        num_layers=hc.num_hidden_layers, hidden_size=hc.hidden_size,
+        num_heads=hc.num_attention_heads, mlp_dim=hc.intermediate_size,
+        rotary_dim=rotary_dim, eps=hc.layer_norm_eps,
+        parallel_residual=getattr(hc, "use_parallel_residual", True),
+        rope_theta=float(getattr(hc, "rotary_emb_base", 10000.0)))
+    model = DecoderModel(cfg, compute_dtype=dtype)
+    sd = hf_model.state_dict()
+    p = "gpt_neox."
+    L, H = cfg.num_layers, cfg.num_heads
+
+    def qkv(i):
+        w = _fuse_headwise_qkv(
+            _np(sd[f"{p}layers.{i}.attention.query_key_value.weight"]), H)
+        d = cfg.hidden_size
+        # q and k columns carry rotary → de-rotate_half their rotary slice
+        q = _interleave_rope_columns(w[:, :d], H, rotary_dim)
+        k = _interleave_rope_columns(w[:, d:2 * d], H, rotary_dim)
+        return np.concatenate([q, k, w[:, 2 * d:]], axis=1)
+
+    def qkv_b(i):
+        b = _fuse_headwise_qkv_bias(
+            _np(sd[f"{p}layers.{i}.attention.query_key_value.bias"]), H)
+        d = cfg.hidden_size
+        q = _interleave_rope_columns(b[None, :d], H, rotary_dim)[0]
+        k = _interleave_rope_columns(b[None, d:2 * d], H, rotary_dim)[0]
+        return np.concatenate([q, k, b[2 * d:]])
+
+    blocks = _dense_blocks(sd, L, {
+        "ln1_scale": p + "layers.{i}.input_layernorm.weight",
+        "ln1_bias": p + "layers.{i}.input_layernorm.bias",
+        "ln2_scale": p + "layers.{i}.post_attention_layernorm.weight",
+        "ln2_bias": p + "layers.{i}.post_attention_layernorm.bias",
+        "attn_out_w": p + "layers.{i}.attention.dense.weight",
+        "attn_out_b": p + "layers.{i}.attention.dense.bias",
+        "mlp_fc_w": p + "layers.{i}.mlp.dense_h_to_4h.weight",
+        "mlp_fc_b": p + "layers.{i}.mlp.dense_h_to_4h.bias",
+        "mlp_out_w": p + "layers.{i}.mlp.dense_4h_to_h.weight",
+        "mlp_out_b": p + "layers.{i}.mlp.dense_4h_to_h.bias",
+    }, post_map={"attn_out_w": _lin, "mlp_fc_w": _lin, "mlp_out_w": _lin})
+    blocks["qkv_w"] = jnp.asarray(np.stack([qkv(i) for i in range(L)]))
+    blocks["qkv_b"] = jnp.asarray(np.stack([qkv_b(i) for i in range(L)]))
+    params = {
+        "wte": jnp.asarray(_np(sd[p + "embed_in.weight"])),
+        "blocks": blocks,
+        "ln_f_scale": jnp.asarray(_np(sd[p + "final_layer_norm.weight"])),
+        "ln_f_bias": jnp.asarray(_np(sd[p + "final_layer_norm.bias"])),
+        "lm_head": jnp.asarray(_lin(_np(sd["embed_out.weight"]))),
+    }
+    return model, params
+
+
+@register_policy("GPTJForCausalLM")
+def gptj_policy(hf_model, dtype):
+    """HF GPTJForCausalLM → DecoderModel.gptj (reference
+    module_inject/containers/gptj.py): parallel residual with single LN,
+    partial interleaved rotary (native convention — no permutation)."""
+    import jax.numpy as jnp
+
+    from deepspeed_tpu.models.transformer import DecoderConfig, DecoderModel
+
+    hc = hf_model.config
+    cfg = DecoderConfig.gptj(
+        vocab_size=hc.vocab_size, max_seq_len=hc.n_positions,
+        num_layers=hc.n_layer, hidden_size=hc.n_embd,
+        num_heads=hc.n_head, mlp_dim=4 * hc.n_embd,
+        rotary_dim=hc.rotary_dim, eps=hc.layer_norm_epsilon)
+    model = DecoderModel(cfg, compute_dtype=dtype)
+    sd = hf_model.state_dict()
+    p = "transformer."
+    L = cfg.num_layers
+    d = cfg.hidden_size
+
+    def qkv(i):
+        return np.concatenate(
+            [_lin(_np(sd[f"{p}h.{i}.attn.{x}_proj.weight"]))
+             for x in ("q", "k", "v")], axis=1)
+
+    blocks = _dense_blocks(sd, L, {
+        "ln1_scale": p + "h.{i}.ln_1.weight",
+        "ln1_bias": p + "h.{i}.ln_1.bias",
+        "attn_out_w": p + "h.{i}.attn.out_proj.weight",
+        "mlp_fc_w": p + "h.{i}.mlp.fc_in.weight",
+        "mlp_fc_b": p + "h.{i}.mlp.fc_in.bias",
+        "mlp_out_w": p + "h.{i}.mlp.fc_out.weight",
+        "mlp_out_b": p + "h.{i}.mlp.fc_out.bias",
+    }, post_map={"attn_out_w": _lin, "mlp_fc_w": _lin, "mlp_out_w": _lin})
+    blocks["qkv_w"] = jnp.asarray(np.stack([qkv(i) for i in range(L)]))
+    blocks["qkv_b"] = jnp.zeros((L, 3 * d))        # GPT-J attn has no biases
+    blocks["attn_out_b"] = jnp.zeros((L, d))
+    params = {
+        "wte": jnp.asarray(_np(sd[p + "wte.weight"])),
+        "blocks": blocks,
+        "ln_f_scale": jnp.asarray(_np(sd[p + "ln_f.weight"])),
+        "ln_f_bias": jnp.asarray(_np(sd[p + "ln_f.bias"])),
+        "lm_head": jnp.asarray(_lin(_np(sd["lm_head.weight"]))),
+    }
+    if "lm_head.bias" in sd:
+        params["lm_head_bias"] = jnp.asarray(_np(sd["lm_head.bias"]))
     return model, params

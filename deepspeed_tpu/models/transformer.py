@@ -1,0 +1,352 @@
+"""Generalized causal decoder family — OPT / BLOOM / GPT-NeoX / GPT-J.
+
+Reference analog: the per-architecture inference containers
+(``deepspeed/module_inject/containers/{opt,bloom,gptneox,gptj}.py``) and
+``model_implementations/``.  The reference keeps one fused CUDA transformer
+and injects per-arch weight layouts into it; here the same economy comes
+from ONE scanned decoder block parameterized by the architectural axes these
+families actually differ on:
+
+  * position encoding: learned table (OPT, with its +2 offset), ALiBi
+    (BLOOM), rotary (GPT-NeoX partial / GPT-J partial-interleaved), or none
+  * residual topology: sequential (GPT-2/OPT/BLOOM) vs parallel
+    attention+MLP (GPT-NeoX dual-LN, GPT-J single-LN)
+  * activation: gelu / relu
+  * embedding LayerNorm (BLOOM)
+
+Rotary always uses the interleaved convention of ``ops/rotary.py``; policies
+that load rotate-half checkpoints (NeoX) permute projection columns at load
+time (see inference/policies.py), so the compute path stays single-form.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deepspeed_tpu.models.base import cross_entropy_loss, gelu, layer_norm
+from deepspeed_tpu.ops.attention import attention_with_kv_cache, multihead_attention
+from deepspeed_tpu.ops.rotary import apply_rotary_pos_emb, rope_frequencies
+
+
+def alibi_slopes(num_heads: int) -> np.ndarray:
+    """Standard ALiBi slope schedule (power-of-two geometric; BLOOM paper)."""
+
+    def pow2_slopes(n):
+        start = 2.0 ** (-(2.0 ** -(np.log2(n) - 3)))
+        return start * (start ** np.arange(n))
+
+    if np.log2(num_heads).is_integer():
+        return pow2_slopes(num_heads)
+    closest = 2 ** int(np.floor(np.log2(num_heads)))
+    extra = pow2_slopes(2 * closest)[0::2][:num_heads - closest]
+    return np.concatenate([pow2_slopes(closest), extra])
+
+
+@dataclasses.dataclass
+class DecoderConfig:
+    vocab_size: int = 50272
+    max_seq_len: int = 2048
+    num_layers: int = 12
+    hidden_size: int = 768
+    num_heads: int = 12
+    mlp_dim: int = 3072
+    eps: float = 1e-5
+    # positional scheme
+    pos_emb: str = "learned"          # "learned" | "none"
+    pos_offset: int = 0               # OPT stores positions at index+2
+    alibi: bool = False               # BLOOM
+    rotary_dim: int = 0               # 0 = no rotary; NeoX/GPT-J partial
+    rope_theta: float = 10000.0       # NeoX rotary_emb_base
+    # block topology
+    parallel_residual: bool = False   # NeoX / GPT-J
+    dual_ln: bool = True              # NeoX two LNs; GPT-J single
+    activation: str = "gelu"          # "gelu" | "relu"
+    embedding_ln: bool = False        # BLOOM word_embeddings_layernorm
+    tie_embeddings: bool = False
+
+    @property
+    def head_dim(self) -> int:
+        return self.hidden_size // self.num_heads
+
+    @property
+    def has_position_table(self) -> bool:
+        """False only for pure-ALiBi models (BLOOM): they extrapolate to any
+        length.  Learned tables AND rotary cos/sin tables are sized to
+        max_seq_len, so those keep the inference engine's guard."""
+        return self.pos_emb == "learned" or self.rotary_dim > 0
+
+    # ---- family presets (HF config names in parens)
+    @classmethod
+    def opt(cls, **kw):
+        kw.setdefault("activation", "relu")
+        kw.setdefault("pos_offset", 2)
+        kw.setdefault("tie_embeddings", True)
+        return cls(**kw)
+
+    @classmethod
+    def bloom(cls, **kw):
+        kw.setdefault("pos_emb", "none")
+        kw.setdefault("alibi", True)
+        kw.setdefault("embedding_ln", True)
+        kw.setdefault("tie_embeddings", True)
+        return cls(**kw)
+
+    @classmethod
+    def gpt_neox(cls, **kw):
+        kw.setdefault("pos_emb", "none")
+        kw.setdefault("parallel_residual", True)
+        kw.setdefault("dual_ln", True)
+        return cls(**kw)
+
+    @classmethod
+    def gptj(cls, **kw):
+        kw.setdefault("pos_emb", "none")
+        kw.setdefault("parallel_residual", True)
+        kw.setdefault("dual_ln", False)
+        return cls(**kw)
+
+
+class DecoderModel:
+    """Causal-LM ModelSpec. batch = {"input_ids": [B,T], "labels": [B,T]}."""
+
+    def __init__(self, config: DecoderConfig, compute_dtype=jnp.bfloat16,
+                 remat: bool = False, remat_policy: Optional[str] = None):
+        self.config = config
+        self.compute_dtype = compute_dtype
+        self.remat = remat
+        self.remat_policy = remat_policy
+        c = config
+        assert c.activation in ("gelu", "relu"), c.activation
+        assert c.pos_emb in ("learned", "none"), c.pos_emb
+        if c.alibi:
+            self._alibi = jnp.asarray(alibi_slopes(c.num_heads), jnp.float32)
+        if c.rotary_dim > 0:
+            self._rope_cos, self._rope_sin = rope_frequencies(
+                c.rotary_dim, c.max_seq_len, theta=c.rope_theta)
+
+    def _act(self, x):
+        return gelu(x) if self.config.activation == "gelu" else jax.nn.relu(x)
+
+    # ------------------------------------------------------------------- init
+    def init(self, rng):
+        c = self.config
+        k = jax.random.split(rng, 8)
+        d, l, m, v = c.hidden_size, c.num_layers, c.mlp_dim, c.vocab_size
+        init = jax.nn.initializers.normal(0.02)
+        blocks = {
+            "ln1_scale": jnp.ones((l, d)), "ln1_bias": jnp.zeros((l, d)),
+            "qkv_w": init(k[2], (l, d, 3 * d), jnp.float32),
+            "qkv_b": jnp.zeros((l, 3 * d)),
+            "attn_out_w": init(k[3], (l, d, d), jnp.float32) / (2 * l) ** 0.5,
+            "attn_out_b": jnp.zeros((l, d)),
+            "mlp_fc_w": init(k[4], (l, d, m), jnp.float32),
+            "mlp_fc_b": jnp.zeros((l, m)),
+            "mlp_out_w": init(k[5], (l, m, d), jnp.float32) / (2 * l) ** 0.5,
+            "mlp_out_b": jnp.zeros((l, d)),
+        }
+        if c.dual_ln or not c.parallel_residual:
+            blocks["ln2_scale"] = jnp.ones((l, d))
+            blocks["ln2_bias"] = jnp.zeros((l, d))
+        params = {
+            "wte": init(k[0], (v, d), jnp.float32),
+            "blocks": blocks,
+            "ln_f_scale": jnp.ones((d,)), "ln_f_bias": jnp.zeros((d,)),
+        }
+        if c.pos_emb == "learned":
+            params["wpe"] = init(k[1], (c.max_seq_len + c.pos_offset, d),
+                                 jnp.float32)
+        if c.embedding_ln:
+            params["emb_ln_scale"] = jnp.ones((d,))
+            params["emb_ln_bias"] = jnp.zeros((d,))
+        if not c.tie_embeddings:
+            params["lm_head"] = init(k[6], (d, v), jnp.float32)
+        return params
+
+    def logical_axes(self):
+        c = self.config
+        blocks = {
+            "ln1_scale": ("layer", "hidden"), "ln1_bias": ("layer", "hidden"),
+            "qkv_w": ("layer", "hidden", "heads"),
+            "qkv_b": ("layer", "heads"),
+            "attn_out_w": ("layer", "heads", "hidden"),
+            "attn_out_b": ("layer", "hidden"),
+            "mlp_fc_w": ("layer", "hidden", "mlp"),
+            "mlp_fc_b": ("layer", "mlp"),
+            "mlp_out_w": ("layer", "mlp", "hidden"),
+            "mlp_out_b": ("layer", "hidden"),
+        }
+        if c.dual_ln or not c.parallel_residual:
+            blocks["ln2_scale"] = ("layer", "hidden")
+            blocks["ln2_bias"] = ("layer", "hidden")
+        axes = {"wte": ("vocab_in", "hidden"), "blocks": blocks,
+                "ln_f_scale": ("hidden",), "ln_f_bias": ("hidden",)}
+        if c.pos_emb == "learned":
+            axes["wpe"] = ("seq", "hidden")
+        if c.embedding_ln:
+            axes["emb_ln_scale"] = ("hidden",)
+            axes["emb_ln_bias"] = ("hidden",)
+        if not c.tie_embeddings:
+            axes["lm_head"] = ("hidden", "vocab")
+        return axes
+
+    # ------------------------------------------------------------------ block
+    def _attn_bias(self, t, s):
+        if not self.config.alibi:
+            return None
+        # slopes * key position; shift-invariant per softmax row
+        return (self._alibi[:, None, None] *
+                jnp.arange(s, dtype=jnp.float32)[None, None, :]) * \
+            jnp.ones((1, t, 1), jnp.float32)
+
+    def _qkv(self, x, blk, pos_offset):
+        c = self.config
+        b, t, d = x.shape
+        h, dh = c.num_heads, c.head_dim
+        qkv = jnp.einsum("btd,de->bte", x, blk["qkv_w"].astype(x.dtype)) + \
+            blk["qkv_b"].astype(x.dtype)
+        q, k_, v_ = jnp.split(qkv, 3, axis=-1)
+        q = q.reshape(b, t, h, dh)
+        k_ = k_.reshape(b, t, h, dh)
+        v_ = v_.reshape(b, t, h, dh)
+        if c.rotary_dim > 0:
+            rq, pq = q[..., :c.rotary_dim], q[..., c.rotary_dim:]
+            rk, pk = k_[..., :c.rotary_dim], k_[..., c.rotary_dim:]
+            rq = apply_rotary_pos_emb(rq, self._rope_cos, self._rope_sin,
+                                      position_offset=pos_offset)
+            rk = apply_rotary_pos_emb(rk, self._rope_cos, self._rope_sin,
+                                      position_offset=pos_offset)
+            q = jnp.concatenate([rq, pq], axis=-1)
+            k_ = jnp.concatenate([rk, pk], axis=-1)
+        return q, k_, v_
+
+    def _block_impl(self, x, blk, cache):
+        c = self.config
+        b, t, d = x.shape
+        idx = cache[2] if cache is not None else 0
+
+        y1 = layer_norm(x, blk["ln1_scale"], blk["ln1_bias"], c.eps)
+        q, k_, v_ = self._qkv(y1, blk, idx)
+        if cache is None:
+            attn = multihead_attention(q, k_, v_, causal=True,
+                                       bias=self._attn_bias(t, t))
+            kc = vc = None
+        else:
+            kc, vc, _ = cache
+            dec_bias = None
+            if c.alibi:
+                dec_bias = self._alibi[:, None] * jnp.arange(
+                    kc.shape[1], dtype=jnp.float32)[None, :]
+            attn, kc, vc = attention_with_kv_cache(q, k_, v_, kc, vc, idx,
+                                                   bias=dec_bias)
+        attn = attn.reshape(b, t, d)
+        attn_out = jnp.einsum("btd,de->bte", attn,
+                              blk["attn_out_w"].astype(x.dtype)) + \
+            blk["attn_out_b"].astype(x.dtype)
+
+        if c.parallel_residual:
+            y2 = layer_norm(x, blk["ln2_scale"], blk["ln2_bias"], c.eps) \
+                if c.dual_ln else y1
+            mid = self._act(jnp.einsum("btd,dm->btm", y2,
+                                       blk["mlp_fc_w"].astype(x.dtype)) +
+                            blk["mlp_fc_b"].astype(x.dtype))
+            mlp_out = jnp.einsum("btm,md->btd", mid,
+                                 blk["mlp_out_w"].astype(x.dtype)) + \
+                blk["mlp_out_b"].astype(x.dtype)
+            x = x + attn_out + mlp_out
+        else:
+            x = x + attn_out
+            y2 = layer_norm(x, blk["ln2_scale"], blk["ln2_bias"], c.eps)
+            mid = self._act(jnp.einsum("btd,dm->btm", y2,
+                                       blk["mlp_fc_w"].astype(x.dtype)) +
+                            blk["mlp_fc_b"].astype(x.dtype))
+            x = x + jnp.einsum("btm,md->btd", mid,
+                               blk["mlp_out_w"].astype(x.dtype)) + \
+                blk["mlp_out_b"].astype(x.dtype)
+        return x, kc, vc
+
+    # ---------------------------------------------------------------- forward
+    def _embed(self, params, input_ids, idx):
+        c = self.config
+        b, t = input_ids.shape
+        x = params["wte"].astype(self.compute_dtype)[input_ids]
+        if c.pos_emb == "learned":
+            pos = idx + jnp.arange(t) + c.pos_offset
+            x = x + params["wpe"].astype(self.compute_dtype)[pos][None]
+        if c.embedding_ln:
+            x = layer_norm(x, params["emb_ln_scale"], params["emb_ln_bias"],
+                           c.eps)
+        return x
+
+    def forward_hidden(self, params, input_ids, *, rngs=None, train=False):
+        c = self.config
+        x = self._embed(params, input_ids, jnp.zeros((), jnp.int32))
+
+        def block_fn(x, blk):
+            return self._block_impl(x, blk, None)[0]
+
+        if self.remat:
+            from deepspeed_tpu.runtime.activation_checkpointing import (
+                checkpoint_policy)
+
+            block_fn = jax.checkpoint(block_fn,
+                                      policy=checkpoint_policy(self.remat_policy))
+
+        def scan_body(x, blk):
+            return block_fn(x, blk), None
+
+        x, _ = jax.lax.scan(scan_body, x, params["blocks"])
+        return layer_norm(x, params["ln_f_scale"], params["ln_f_bias"], c.eps)
+
+    def logits(self, params, hidden):
+        if self.config.tie_embeddings:
+            out = jnp.einsum("btd,vd->btv", hidden,
+                             params["wte"].astype(hidden.dtype))
+        else:
+            out = jnp.einsum("btd,dv->btv", hidden,
+                             params["lm_head"].astype(hidden.dtype))
+        if "lm_head_bias" in params:   # GPT-J ships a biased lm head
+            out = out + params["lm_head_bias"].astype(out.dtype)
+        return out
+
+    def apply(self, params, batch, *, rngs=None, train=False):
+        hidden = self.forward_hidden(params, batch["input_ids"], rngs=rngs,
+                                     train=train)
+        logits = self.logits(params, hidden)
+        loss, n = cross_entropy_loss(logits, batch["labels"])
+        return loss, {"loss": loss, "ntokens": n}
+
+    # --------------------------------------------------------- inference path
+    def init_cache(self, batch_size: int, max_len: int, dtype=None):
+        c = self.config
+        dtype = dtype or self.compute_dtype
+        shape = (c.num_layers, batch_size, max_len, c.num_heads, c.head_dim)
+        return {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype),
+                "index": jnp.zeros((), jnp.int32)}
+
+    def forward_with_cache(self, params, input_ids, cache):
+        c = self.config
+        idx = cache["index"]
+        x = self._embed(params, input_ids, idx)
+
+        def scan_body(x, layer_in):
+            blk, kc, vc = layer_in
+            x, kc, vc = self._block_impl(x, blk, (kc, vc, idx))
+            return x, (kc, vc)
+
+        x, (k_new, v_new) = jax.lax.scan(
+            scan_body, x, (params["blocks"], cache["k"], cache["v"]))
+        hidden = layer_norm(x, params["ln_f_scale"], params["ln_f_bias"], c.eps)
+        return self.logits(params, hidden), {"k": k_new, "v": v_new,
+                                             "index": idx + input_ids.shape[1]}
+
+    def flops_per_token(self) -> float:
+        c = self.config
+        n_params = (c.vocab_size * c.hidden_size +
+                    c.num_layers * (4 * c.hidden_size ** 2 +
+                                    2 * c.hidden_size * c.mlp_dim))
+        return 6.0 * n_params + 12 * c.num_layers * c.hidden_size * c.max_seq_len

@@ -60,6 +60,8 @@ def attention_with_kv_cache(
     cache_index: jax.Array,  # scalar int — tokens already in cache
     *,
     scale: Optional[float] = None,
+    bias: Optional[jax.Array] = None,  # [H, S_max] additive (alibi: softmax
+    # shift-invariance makes slopes*key_pos correct for every query position)
 ):
     """Decode-time attention against a static-shape KV cache.
 
@@ -79,6 +81,9 @@ def attention_with_kv_cache(
     rep = hq // hkv
     qg = q.reshape(b, t, hkv, rep, dh)
     logits = jnp.einsum("btkrd,bskd->bkrts", qg, k_cache).astype(jnp.float32) * scale
+    if bias is not None:
+        logits = logits + bias.astype(jnp.float32).reshape(
+            1, hkv, rep, 1, s_max)
     # positions <= cache_index + offset are valid (causal within the new block)
     pos = jnp.arange(s_max)[None, :]  # [1, S]
     q_pos = cache_index + jnp.arange(t)[:, None]  # [T, 1]

@@ -101,6 +101,18 @@ class ExpertParallelConfig(DeepSpeedConfigModel):
     ep_size: int = 1
 
 
+class HybridEngineConfig(DeepSpeedConfigModel):
+    """RLHF hybrid engine (reference deepspeed/runtime/config.py
+    hybrid_engine section → DeepSpeedHybridEngine)."""
+
+    enabled: bool = False
+    max_out_tokens: int = 512
+    inference_tp_size: int = 1
+    release_inference_cache: bool = False
+    pin_parameters: bool = True
+    tp_gather_partition_size: int = 8
+
+
 class CheckpointConfig(DeepSpeedConfigModel):
     tag_validation: str = "Warn"
     load_universal: bool = False
@@ -233,6 +245,7 @@ class DeepSpeedConfig:
         self.comms_logger_config = CommsLoggerConfig(**d.get("comms_logger", {}))
         self.checkpoint_config = CheckpointConfig(**d.get(C.CHECKPOINT, {}))
         self.aio_config = AIOConfig(**d.get("aio", {}))
+        self.hybrid_engine = HybridEngineConfig(**d.get("hybrid_engine", {}))
         self.dataloader_drop_last = d.get(C.DATALOADER_DROP_LAST, C.DATALOADER_DROP_LAST_DEFAULT)
 
         # ---------------- misc ------------------------------------------------

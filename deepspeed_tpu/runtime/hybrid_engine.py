@@ -1,0 +1,155 @@
+"""Hybrid engine — RLHF training + generation on shared weights.
+
+Reference analog: ``DeepSpeedHybridEngine`` (runtime/hybrid_engine.py:32):
+one engine that trains (actor update) and generates (experience collection)
+with the SAME parameters — the reference flips modules between ZeRO-3
+training mode and kernel-injected inference containers, (un)fusing LoRA
+adapters in place and managing a shared KV workspace (generate:168,
+_zero3_forward:333).
+
+TPU-native shape: there is nothing to flip.  Training state and the decode
+loop live on the same mesh; ``generate()`` casts the current fp32 masters to
+the compute dtype, functionally fuses any LoRA adapters (no in-place
+surgery — unfuse is a no-op because the originals are never mutated), and
+feeds them to the jitted prefill+decode program reused from the inference
+engine.  Weight updates between calls change only the param *values*, so
+the compiled generate function is reused without retracing.
+
+LoRA convention: a param subtree {"w"|"kernel"|"weight": W [in,out],
+"lora_a": A [in,r], "lora_b": B [r,out], optional "lora_alpha": scalar}
+fuses to W + (alpha/r)·(A @ B).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deepspeed_tpu.runtime.engine import DeepSpeedEngine
+from deepspeed_tpu.utils.logging import log_dist
+
+_WEIGHT_KEYS = ("w", "kernel", "weight")
+
+
+def _is_lora_node(node) -> bool:
+    return isinstance(node, dict) and "lora_a" in node and "lora_b" in node \
+        and any(k in node for k in _WEIGHT_KEYS)
+
+
+def fuse_lora(params):
+    """W + (alpha/r)·A@B for every LoRA node (reference fuse_lora_weight);
+    pure — the input tree is untouched."""
+
+    def walk(node):
+        if _is_lora_node(node):
+            out = dict(node)
+            wkey = next(k for k in _WEIGHT_KEYS if k in node)
+            a, b = node["lora_a"], node["lora_b"]
+            r = a.shape[-1]
+            alpha = node.get("lora_alpha", jnp.asarray(float(r)))
+            delta = (alpha / r) * (a.astype(jnp.float32) @ b.astype(jnp.float32))
+            out[wkey] = (node[wkey].astype(jnp.float32) + delta).astype(
+                node[wkey].dtype)
+            return out
+        if isinstance(node, dict):
+            return {k: walk(v) for k, v in node.items()}
+        return node
+
+    return walk(params)
+
+
+def unfuse_lora(params, original_params):
+    """API parity with the reference's unfuse step: functional fusion never
+    mutated the originals, so unfuse just returns them."""
+    return original_params
+
+
+class DeepSpeedHybridEngine(DeepSpeedEngine):
+    def __init__(self, model, config, **kw):
+        super().__init__(model, config, **kw)
+        self._he_cfg = self.config.hybrid_engine
+        self._inference_engine = None
+        self._has_lora = self._detect_lora()
+        # generation bookkeeping (reference latency counters,
+        # hybrid_engine.py _t0/_total_latency)
+        self.generate_calls = 0
+        self.generate_latency_s = 0.0
+        self.generated_tokens = 0
+        if self._has_lora:
+            log_dist("hybrid engine: LoRA adapters detected — fused "
+                     "functionally per generate() call", ranks=[0])
+
+    def _detect_lora(self) -> bool:
+        def walk(node) -> bool:
+            if _is_lora_node(node):
+                return True
+            if isinstance(node, dict):
+                return any(walk(v) for v in node.values())
+            return False
+
+        return walk(self.state.params) if isinstance(self.state.params, dict) \
+            else False
+
+    # ---------------------------------------------------------------- engine
+    def _inference(self):
+        if self._inference_engine is None:
+            from deepspeed_tpu.inference.config import DeepSpeedInferenceConfig
+            from deepspeed_tpu.inference.engine import InferenceEngine
+
+            dtype = {"float16": "fp16", "bfloat16": "bf16"}.get(
+                self.compute_dtype.__name__, "fp32")
+            cfg = DeepSpeedInferenceConfig(
+                dtype=dtype,
+                max_out_tokens=self._he_cfg.max_out_tokens,
+                tensor_parallel={"tp_size": self._he_cfg.inference_tp_size},
+            )
+            self._inference_engine = InferenceEngine(
+                self.module, cfg, params=self._eval_params(),
+                topology=self.topology)
+        return self._inference_engine
+
+    def _eval_params(self):
+        """Current weights for generation: compute dtype + LoRA fused."""
+        params = self.state.params
+        if getattr(self, "_host_opt", None) is None:
+            params = jax.tree_util.tree_map(
+                lambda p: p.astype(self.compute_dtype)
+                if p.dtype == jnp.float32 else p, params)
+        if self._has_lora:
+            params = fuse_lora(params)
+        return params
+
+    # -------------------------------------------------------------- generate
+    def generate(self, input_ids, **kwargs):
+        """Experience-collection generation on the live training weights
+        (reference generate:168)."""
+        t0 = time.perf_counter()
+        inf = self._inference()
+        inf.params = self._eval_params()  # refresh weights; compiled fn reused
+        out = inf.generate(input_ids, **kwargs)
+        self.generate_calls += 1
+        self.generate_latency_s += time.perf_counter() - t0
+        self.generated_tokens += out.shape[0] * (
+            out.shape[1] - np.asarray(input_ids).shape[1])
+        if self._he_cfg.release_inference_cache:
+            # drop compiled decode programs + their cache buffers (reference
+            # release_inference_cache / retake_inference_cache)
+            inf._compiled.clear()
+        return out
+
+    def eval(self):
+        return self
+
+    def train(self, mode: bool = True):
+        return self
+
+    def generate_stats(self) -> Dict[str, Any]:
+        return {"calls": self.generate_calls,
+                "latency_s": self.generate_latency_s,
+                "tokens": self.generated_tokens,
+                "tokens_per_sec": self.generated_tokens /
+                self.generate_latency_s if self.generate_latency_s else 0.0}

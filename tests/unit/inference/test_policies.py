@@ -1,0 +1,171 @@
+"""HF weight-mapping policy parity: tiny real HF models (torch CPU) vs the
+converted JAX models — logits must match.  Mirrors the reference's
+inference tests (tests/unit/inference/test_inference.py) which compare
+injected models against the HF baseline.  Also covers the AutoTP parser.
+"""
+
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+transformers = pytest.importorskip("transformers")
+
+from deepspeed_tpu.inference.policies import convert_hf_model  # noqa: E402
+
+
+def _logits_match(hf_model, ids, atol=2e-2):
+    import jax
+    import jax.numpy as jnp
+
+    hf_model.eval()
+    with torch.no_grad():
+        ref = hf_model(torch.tensor(ids)).logits.float().numpy()
+    model, params = convert_hf_model(hf_model, compute_dtype=jnp.float32)
+    ours = np.asarray(jax.jit(
+        lambda p, i: model.logits(p, model.forward_hidden(p, i)))(
+        params, jnp.asarray(ids)))
+    np.testing.assert_allclose(ours, ref, atol=atol, rtol=1e-3)
+    return model, params
+
+
+IDS = np.arange(1, 17, dtype=np.int32).reshape(1, 16) % 100
+
+
+class TestPolicyParity:
+    def test_gpt2(self):
+        cfg = transformers.GPT2Config(
+            vocab_size=128, n_positions=64, n_embd=32, n_layer=2, n_head=2)
+        _logits_match(transformers.GPT2LMHeadModel(cfg), IDS)
+
+    def test_opt(self):
+        cfg = transformers.OPTConfig(
+            vocab_size=128, hidden_size=32, num_hidden_layers=2,
+            num_attention_heads=2, ffn_dim=64, max_position_embeddings=64,
+            do_layer_norm_before=True)
+        _logits_match(transformers.OPTForCausalLM(cfg), IDS)
+
+    def test_bloom(self):
+        cfg = transformers.BloomConfig(
+            vocab_size=128, hidden_size=32, n_layer=2, n_head=2)
+        _logits_match(transformers.BloomForCausalLM(cfg), IDS)
+
+    def test_gpt_neox(self):
+        cfg = transformers.GPTNeoXConfig(
+            vocab_size=128, hidden_size=32, num_hidden_layers=2,
+            num_attention_heads=2, intermediate_size=64,
+            max_position_embeddings=64, rotary_pct=0.25,
+            use_parallel_residual=True)
+        _logits_match(transformers.GPTNeoXForCausalLM(cfg), IDS)
+
+    def test_gptj(self):
+        cfg = transformers.GPTJConfig(
+            vocab_size=128, n_positions=64, n_embd=32, n_layer=2, n_head=2,
+            rotary_dim=8)
+        _logits_match(transformers.GPTJForCausalLM(cfg), IDS)
+
+    def test_llama(self):
+        cfg = transformers.LlamaConfig(
+            vocab_size=128, hidden_size=32, num_hidden_layers=2,
+            num_attention_heads=4, num_key_value_heads=2,
+            intermediate_size=64, max_position_embeddings=64)
+        _logits_match(transformers.LlamaForCausalLM(cfg), IDS)
+
+    def test_unknown_arch_raises(self):
+        class Mystery:
+            pass
+
+        with pytest.raises(ValueError, match="no inference policy"):
+            convert_hf_model(Mystery())
+
+
+class TestDecodeParity:
+    def test_cached_decode_matches_full_forward(self):
+        """KV-cache decode must reproduce full-context logits (OPT; covers
+        pos_offset + relu path)."""
+        import jax
+        import jax.numpy as jnp
+
+        cfg = transformers.OPTConfig(
+            vocab_size=128, hidden_size=32, num_hidden_layers=2,
+            num_attention_heads=2, ffn_dim=64, max_position_embeddings=64)
+        model, params = convert_hf_model(
+            transformers.OPTForCausalLM(cfg), compute_dtype=jnp.float32)
+        ids = IDS
+        full = model.logits(params, model.forward_hidden(params, jnp.asarray(ids)))
+        cache = model.init_cache(1, 32, dtype=jnp.float32)
+        lg, cache = model.forward_with_cache(params, jnp.asarray(ids[:, :8]), cache)
+        for t in range(8, 16):
+            lg, cache = model.forward_with_cache(
+                params, jnp.asarray(ids[:, t:t + 1]), cache)
+            np.testing.assert_allclose(np.asarray(lg[0, -1]),
+                                       np.asarray(full[0, t]), atol=2e-3,
+                                       rtol=1e-3)
+
+    def test_alibi_decode_matches_full_forward(self):
+        """BLOOM (alibi) cached decode parity."""
+        import jax.numpy as jnp
+
+        cfg = transformers.BloomConfig(
+            vocab_size=128, hidden_size=32, n_layer=2, n_head=2)
+        model, params = convert_hf_model(
+            transformers.BloomForCausalLM(cfg), compute_dtype=jnp.float32)
+        ids = IDS
+        full = model.logits(params, model.forward_hidden(params, jnp.asarray(ids)))
+        cache = model.init_cache(1, 32, dtype=jnp.float32)
+        lg, cache = model.forward_with_cache(params, jnp.asarray(ids[:, :8]), cache)
+        for t in range(8, 16):
+            lg, cache = model.forward_with_cache(
+                params, jnp.asarray(ids[:, t:t + 1]), cache)
+            np.testing.assert_allclose(np.asarray(lg[0, -1]),
+                                       np.asarray(full[0, t]), atol=2e-3,
+                                       rtol=1e-3)
+
+
+class TestAutoTP:
+    def test_classification(self):
+        from deepspeed_tpu.inference.auto_tp import tp_parser
+
+        params = {
+            "blocks": {
+                "qkv_w": np.zeros((2, 8, 24)), "qkv_b": np.zeros((2, 24)),
+                "attn_out_w": np.zeros((2, 8, 8)), "attn_out_b": np.zeros((2, 8)),
+                "mlp_fc_w": np.zeros((2, 8, 32)), "mlp_fc_b": np.zeros((2, 32)),
+                "mlp_out_w": np.zeros((2, 32, 8)), "mlp_out_b": np.zeros((2, 8)),
+                "ln1_scale": np.zeros((2, 8)), "ln1_bias": np.zeros((2, 8)),
+            },
+            "wte": np.zeros((128, 8)),
+        }
+        kinds = tp_parser(params)
+        get = lambda frag: next(v for k, v in kinds.items() if frag in k)
+        assert get("qkv_w") == "col"
+        assert get("attn_out_w") == "row"
+        assert get("mlp_out_w") == "row"
+        assert get("mlp_fc_w") == "col"
+        assert get("qkv_b") == "col-bias"
+        assert get("attn_out_b") == "replicate"   # added post-reduce
+        assert get("ln1_bias") == "replicate"
+        assert get("wte") == "replicate"
+
+    def test_specs_shapes(self):
+        from jax.sharding import PartitionSpec as P
+
+        from deepspeed_tpu.inference.auto_tp import tp_shard_specs
+
+        params = {"attn_out_w": np.zeros((4, 8, 8)),
+                  "qkv_w": np.zeros((4, 8, 24)),
+                  "qkv_b": np.zeros((4, 24)),
+                  "norm": np.zeros((8,))}
+        specs = tp_shard_specs(params)
+        assert specs["attn_out_w"] == P(None, "model", None)
+        assert specs["qkv_w"] == P(None, None, "model")
+        assert specs["qkv_b"] == P(None, "model")
+        assert specs["norm"] == P()
+
+    def test_hf_style_names(self):
+        from deepspeed_tpu.inference.auto_tp import classify
+
+        assert classify("model.layers.0.self_attn.o_proj.weight", 2) == "row"
+        assert classify("model.layers.0.mlp.down_proj.weight", 2) == "row"
+        assert classify("model.layers.0.self_attn.q_proj.weight", 2) == "col"
+        assert classify("transformer.h.0.mlp.dense_4h_to_h.weight", 2) == "row"
+        assert classify("model.embed_tokens.weight", 2) == "replicate"
