@@ -33,9 +33,18 @@ def _ste_round_bwd(_, g):
 _ste_round.defvjp(_ste_round_fwd, _ste_round_bwd)
 
 
+def _round_op(v, rounding: str, rng):
+    if rounding == "stochastic":
+        assert rng is not None, "stochastic rounding needs an rng"
+        return jnp.floor(v + jax.random.uniform(rng, v.shape))
+    return _ste_round(v)
+
+
 def fake_quantize(x: jax.Array, bits: int = 8, *, symmetric: bool = True,
-                  per_channel_axis: Optional[int] = None) -> jax.Array:
-    """Quantize→dequantize with STE (reference fake_quantizer.cu sym/asym)."""
+                  per_channel_axis: Optional[int] = None,
+                  rounding: str = "nearest", rng=None) -> jax.Array:
+    """Quantize→dequantize with STE (reference fake_quantizer.cu sym/asym;
+    ``rounding="stochastic"`` matches the reference's stochastic mode)."""
     if per_channel_axis is not None:
         axes = tuple(i for i in range(x.ndim) if i != per_channel_axis)
     else:
@@ -45,14 +54,31 @@ def fake_quantize(x: jax.Array, bits: int = 8, *, symmetric: bool = True,
         qmax = 2.0 ** (bits - 1) - 1
         scale = jnp.max(jnp.abs(x32), axis=axes, keepdims=True) / qmax
         scale = jnp.maximum(scale, 1e-10)
-        q = jnp.clip(_ste_round(x32 / scale), -qmax - 1, qmax)
+        q = jnp.clip(_round_op(x32 / scale, rounding, rng), -qmax - 1, qmax)
         return (q * scale).astype(x.dtype)
     qmax = 2.0 ** bits - 1
     lo = jnp.min(x32, axis=axes, keepdims=True)
     hi = jnp.max(x32, axis=axes, keepdims=True)
     scale = jnp.maximum(hi - lo, 1e-10) / qmax
-    q = jnp.clip(_ste_round((x32 - lo) / scale), 0, qmax)
+    q = jnp.clip(_round_op((x32 - lo) / scale, rounding, rng), 0, qmax)
     return (q * scale + lo).astype(x.dtype)
+
+
+def fake_quantize_grouped(x: jax.Array, bits: int = 8, groups: int = 1, *,
+                          symmetric: bool = True, rounding: str = "nearest",
+                          rng=None) -> jax.Array:
+    """Group-wise fake quantization: the flattened tensor is split into
+    ``groups`` equal ranges, each with its own scale (reference q_groups
+    semantics in quantization_utils.h)."""
+    if groups <= 1:
+        return fake_quantize(x, bits, symmetric=symmetric, rounding=rounding,
+                             rng=rng)
+    n = x.size
+    assert n % groups == 0, f"numel {n} not divisible by q_groups {groups}"
+    flat = x.reshape(groups, n // groups)
+    out = fake_quantize(flat, bits, symmetric=symmetric, per_channel_axis=0,
+                        rounding=rounding, rng=rng)
+    return out.reshape(x.shape)
 
 
 def quantize_int8(x: jax.Array, *, per_channel_axis: Optional[int] = None

@@ -173,7 +173,8 @@ class GPT2Model:
     def _block(self, x, blk, rng, train: bool):
         return self._block_impl(x, blk, rng, train, None)[0]
 
-    def forward_hidden(self, params, input_ids, *, rngs=None, train: bool = False):
+    def forward_hidden(self, params, input_ids, *, rngs=None, train: bool = False,
+                       pld_theta=None):
         c = self.config
         b, t = input_ids.shape
         x = params["wte"].astype(self.compute_dtype)[input_ids]
@@ -186,17 +187,35 @@ class GPT2Model:
             block_fn = jax.checkpoint(block_fn, policy=checkpoint_policy(self.remat_policy),
                                       static_argnums=(3,))
 
-        def scan_body(carry, layer_params):
+        use_pld = pld_theta is not None and train
+        layer_idx = jnp.arange(c.num_layers)
+
+        def scan_body(carry, layer_in):
             x, rng = carry
+            layer_params, i = layer_in
             if rng is not None:
                 rng, sub = jax.random.split(rng)
             else:
                 sub = None
-            x = block_fn(x, layer_params, sub, train)
+            x_new = block_fn(x, layer_params, sub, train)
+            if use_pld:
+                # stochastic depth (progressive layer drop): keep prob anneals
+                # linearly in depth from 1 to theta; expectation-preserving
+                # residual scaling keeps activations calibrated
+                assert rng is not None, "pld needs a dropout rng"
+                rng, pld_rng = jax.random.split(rng)
+                frac = i / max(c.num_layers - 1, 1)
+                p_keep = 1.0 - frac * (1.0 - pld_theta)
+                keep = jax.random.bernoulli(pld_rng, p_keep)
+                gate = jnp.where(keep, 1.0 / p_keep, 0.0).astype(x.dtype)
+                x = x + gate * (x_new - x)
+            else:
+                x = x_new
             return (x, rng), None
 
         rng = rngs.get("dropout") if isinstance(rngs, dict) else rngs
-        (x, _), _ = jax.lax.scan(scan_body, (x, rng), params["blocks"])
+        (x, _), _ = jax.lax.scan(scan_body, (x, rng),
+                                 (params["blocks"], layer_idx))
         return layer_norm(x, params["ln_f_scale"], params["ln_f_bias"], c.eps)
 
     def logits(self, params, hidden):
@@ -205,8 +224,10 @@ class GPT2Model:
             return jnp.einsum("btd,vd->btv", hidden, w)
         return jnp.einsum("btd,dv->btv", hidden, params["lm_head"].astype(hidden.dtype))
 
-    def apply(self, params, batch, *, rngs=None, train: bool = False):
-        hidden = self.forward_hidden(params, batch["input_ids"], rngs=rngs, train=train)
+    def apply(self, params, batch, *, rngs=None, train: bool = False,
+              pld_theta=None):
+        hidden = self.forward_hidden(params, batch["input_ids"], rngs=rngs,
+                                     train=train, pld_theta=pld_theta)
         logits = self.logits(params, hidden)
         loss, n = cross_entropy_loss(logits, batch["labels"])
         return loss, {"loss": loss, "ntokens": n}

@@ -101,6 +101,14 @@ class ExpertParallelConfig(DeepSpeedConfigModel):
     ep_size: int = 1
 
 
+class PLDConfig(DeepSpeedConfigModel):
+    """Progressive layer drop (reference progressive_layer_drop section)."""
+
+    enabled: bool = False
+    theta: float = 0.5
+    gamma: float = 0.001
+
+
 class HybridEngineConfig(DeepSpeedConfigModel):
     """RLHF hybrid engine (reference deepspeed/runtime/config.py
     hybrid_engine section → DeepSpeedHybridEngine)."""
@@ -246,6 +254,7 @@ class DeepSpeedConfig:
         self.checkpoint_config = CheckpointConfig(**d.get(C.CHECKPOINT, {}))
         self.aio_config = AIOConfig(**d.get("aio", {}))
         self.hybrid_engine = HybridEngineConfig(**d.get("hybrid_engine", {}))
+        self.pld_config = PLDConfig(**d.get("progressive_layer_drop", {}))
         self.dataloader_drop_last = d.get(C.DATALOADER_DROP_LAST, C.DATALOADER_DROP_LAST_DEFAULT)
 
         # ---------------- misc ------------------------------------------------

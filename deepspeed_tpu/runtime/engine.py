@@ -167,6 +167,24 @@ class DeepSpeedEngine:
         self.state = self._init_state()
         self._dropout_rng = jax.random.fold_in(self._init_rng, 0x5eed)
 
+        # ---- progressive layer drop (reference engine.py pld wiring)
+        self.progressive_layer_drop = None
+        self._use_pld = False
+        if config.pld_config.enabled:
+            from deepspeed_tpu.runtime.progressive_layer_drop import (
+                ProgressiveLayerDrop)
+
+            self.progressive_layer_drop = ProgressiveLayerDrop(
+                theta=config.pld_config.theta, gamma=config.pld_config.gamma)
+            import inspect
+
+            self._use_pld = "pld_theta" in inspect.signature(
+                model.apply).parameters
+            if not self._use_pld:
+                log_dist("progressive_layer_drop: model.apply does not "
+                         "accept pld_theta — schedule tracked but layers "
+                         "are NOT dropped", ranks=[0])
+
         # ---- counters (reference engine attrs)
         self.micro_steps = 0
         self.global_steps = 0
@@ -294,13 +312,16 @@ class DeepSpeedEngine:
 
         return jax.tree_util.tree_map(cast, params, specs)
 
-    def _micro_loss_and_grads(self, params, batch, scale, rng):
+    def _micro_loss_and_grads(self, params, batch, scale, rng, pld_theta=None):
         """Single microbatch loss+grads in compute dtype; grads carry the
         stage-dependent sharding constraint (→ reduce-scatter from stage 2)."""
+        kwargs = {"pld_theta": pld_theta} if pld_theta is not None else {}
 
         def loss_fn(master_params):
             cparams = self._cast_for_compute(master_params)
-            loss, metrics = self.module.apply(cparams, batch, rngs={"dropout": rng}, train=True)
+            loss, metrics = self.module.apply(cparams, batch,
+                                              rngs={"dropout": rng},
+                                              train=True, **kwargs)
             return loss * scale, metrics
 
         (scaled_loss, metrics), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
@@ -334,7 +355,7 @@ class DeepSpeedEngine:
         return new_state, overflow, norm
 
     # ---------------------------------------------------- shared step pieces
-    def _scan_micro_grads(self, state: TrainState, batch, rng):
+    def _scan_micro_grads(self, state: TrainState, batch, rng, pld_theta=None):
         """Grad-accumulation scan over the gas microbatches (shared by the
         fused device step and the host-offload grad step)."""
         scale = state.scaler.cur_scale
@@ -344,7 +365,7 @@ class DeepSpeedEngine:
             mb, i = mb_and_i
             sub = jax.random.fold_in(rng, i)
             _, grads, metrics = self._micro_loss_and_grads(
-                state.params, mb, scale, sub)
+                state.params, mb, scale, sub, pld_theta)
             grads_acc = jax.tree_util.tree_map(jnp.add, grads_acc, grads)
             return (grads_acc, loss_acc + metrics["loss"]), None
 
@@ -411,8 +432,9 @@ class DeepSpeedEngine:
     def _build_train_step(self):
         gas = self.gas
 
-        def train_step(state: TrainState, batch, lr, rng):
-            grads, loss_sum = self._scan_micro_grads(state, batch, rng)
+        def train_step(state: TrainState, batch, lr, rng, pld_theta=None):
+            grads, loss_sum = self._scan_micro_grads(state, batch, rng,
+                                                     pld_theta)
             grads = jax.tree_util.tree_map(lambda g: g / gas, grads)
             new_state, overflow, norm = self._apply_grads(state, grads, lr)
             metrics = {"loss": loss_sum / gas, "overflow": overflow, "grad_norm": norm,
@@ -463,7 +485,14 @@ class DeepSpeedEngine:
         lr = jnp.asarray(self.get_lr()[0], jnp.float32)
         rng = jax.random.fold_in(self._dropout_rng, self.global_steps)
         batch = jax.device_put(batch, self._gas_batch_shardings(batch))
-        self.state, metrics = self._compiled_train_step(self.state, batch, lr, rng)
+        if self._use_pld:
+            theta = jnp.asarray(self.progressive_layer_drop.get_theta(),
+                                jnp.float32)
+            self.state, metrics = self._compiled_train_step(
+                self.state, batch, lr, rng, theta)
+        else:
+            self.state, metrics = self._compiled_train_step(
+                self.state, batch, lr, rng)
         self._global_grad_norm = metrics["grad_norm"]
         self.micro_steps += self.gas
         self.global_steps += 1
@@ -497,6 +526,11 @@ class DeepSpeedEngine:
         return metrics["loss"]
 
     def _after_step(self, metrics):
+        if self.progressive_layer_drop is not None:
+            self.progressive_layer_drop.update_state(self.global_steps)
+        self._after_step_impl(metrics)
+
+    def _after_step_impl(self, metrics):
         cfg = self.config
         # autotuning experiment: report throughput after warmup then exit
         # (reference exits inside engine.forward:1687-1691 once profiled)
@@ -591,6 +625,8 @@ class DeepSpeedEngine:
             self.global_steps += 1
             if self.lr_scheduler is not None:
                 self.lr_scheduler.step()
+            if self.progressive_layer_drop is not None:
+                self.progressive_layer_drop.update_state(self.global_steps)
             self.micro_steps += 1
             self.timers(STEP_GLOBAL_TIMER).stop()
             return
@@ -613,6 +649,8 @@ class DeepSpeedEngine:
             self.global_steps += 1
             if self.lr_scheduler is not None:
                 self.lr_scheduler.step()
+            if self.progressive_layer_drop is not None:
+                self.progressive_layer_drop.update_state(self.global_steps)
         self.micro_steps += 1
         self.timers(STEP_GLOBAL_TIMER).stop()
 

@@ -1,0 +1,60 @@
+"""Progressive Layer Drop (reference
+``deepspeed/runtime/progressive_layer_drop.py``): anneal a global keep
+probability theta(t) from 1 toward ``theta`` with exponential schedule, and
+distribute per-layer keep probabilities so deeper layers drop more —
+stochastic depth that accelerates pretraining.
+
+Usage: the engine updates the schedule each step
+(``update_state(global_step)``); models consume ``layer_keep_probs`` to
+gate each scanned block: x_{l+1} = x_l + keep_l/E[keep_l] * block(x_l)
+during training (identity at eval).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Dict
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+class ProgressiveLayerDrop:
+    def __init__(self, theta: float = 0.5, gamma: float = 0.001):
+        self.theta = theta
+        self.gamma = gamma
+        self.current_theta = 1.0
+
+    def get_state(self) -> Dict:
+        return {"progressive_layer_drop": True, "pld_theta": self.get_theta()}
+
+    def get_theta(self) -> float:
+        return self.current_theta
+
+    def update_state(self, global_step: int) -> float:
+        """theta(t) = (1 - theta_bar) * exp(-gamma t) + theta_bar
+        (reference update_state)."""
+
+        def _prob(x, gamma, p):
+            return (1.0 - p) * math.exp(-gamma * x) + p
+
+        self.current_theta = _prob(global_step, self.gamma, self.theta)
+        return self.current_theta
+
+
+def layer_keep_probs(num_layers: int, theta: float) -> np.ndarray:
+    """Per-layer keep probability: linear from 1 (first layer) to theta
+    (last), the PLD paper's depth schedule."""
+    if num_layers == 1:
+        return np.array([theta])
+    frac = np.arange(num_layers) / (num_layers - 1)
+    return 1.0 - frac * (1.0 - theta)
+
+
+def sample_layer_mask(rng, num_layers: int, theta: float):
+    """Bernoulli keep mask [L] plus the inverse-prob scale used when a layer
+    IS kept (expectation-preserving residual scaling)."""
+    probs = jnp.asarray(layer_keep_probs(num_layers, theta), jnp.float32)
+    keep = jax.random.bernoulli(rng, probs)
+    return keep, probs

@@ -1,0 +1,97 @@
+"""MoQ — Mixture of Quantization (quantize-during-training).
+
+Reference analog: ``deepspeed/runtime/quantize.py:180 Quantizer``: anneal
+weight precision from ``quantize_bits.start`` down to ``quantize_bits.target``
+over ``quantize_period`` steps (period doubling per transition), optionally
+modulated by per-layer Hessian eigenvalues (high-curvature layers keep
+precision longer).  The quantization itself is the STE fake-quant from
+``compression/quantize.py`` — XLA fuses it into the surrounding matmuls.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.compression.quantize import fake_quantize_grouped
+
+
+class Quantizer:
+    def __init__(self, q_start_bits: int = 16, q_target_bits: int = 8,
+                 q_period: int = 100, q_type: str = "symmetric",
+                 q_rounding: str = "nearest", q_groups: int = 1,
+                 use_quantizer_kernel: bool = False,
+                 eigenvalue_enabled: bool = False,
+                 layer_eigenvalues: Optional[Dict[int, float]] = None):
+        self.q_start_bits = q_start_bits
+        self.q_target_bits = q_target_bits
+        self.q_period = max(q_period, 1)
+        self.symmetric = q_type == "symmetric"
+        self.q_rounding = q_rounding
+        if q_rounding not in ("nearest", "stochastic"):
+            raise ValueError(f"unknown q_rounding '{q_rounding}'")
+        self.q_groups = q_groups
+        self.eigenvalue_enabled = eigenvalue_enabled
+        self.layer_eigenvalues = layer_eigenvalues or {}
+        self.qsteps = 0
+        self._rng = jax.random.PRNGKey(0)
+
+    # ------------------------------------------------------------- schedule
+    def current_bits(self, layer_id: int = 0) -> int:
+        """Bit width at the current step: halve start→target, one transition
+        per (possibly eigenvalue-scaled) period (reference compute_quantization
+        period doubling)."""
+        period = self.q_period
+        if self.eigenvalue_enabled and self.layer_eigenvalues:
+            # high-curvature layers keep precision longer
+            mx = max(self.layer_eigenvalues.values()) or 1.0
+            scale = 1.0 + self.layer_eigenvalues.get(layer_id, mx) / mx
+            period = int(period * scale)
+        bits = self.q_start_bits
+        step, k = self.qsteps, 0
+        while bits > self.q_target_bits and step >= period * (2 ** k):
+            step -= period * (2 ** k)
+            bits = max(bits // 2, self.q_target_bits)
+            k += 1
+        return bits
+
+    def update_step(self, step: Optional[int] = None) -> None:
+        self.qsteps = step if step is not None else self.qsteps + 1
+
+    # ----------------------------------------------------------- quantize op
+    def quantize(self, params, layer_axis_key: str = "blocks"):
+        """Fake-quantize weight tensors at the scheduled precision
+        (reference quantize() walking the param groups). 16 bits = off."""
+        if self.q_rounding == "stochastic":
+            self._rng, rng = jax.random.split(self._rng)
+        else:
+            rng = None
+
+        def q_leaf(x, layer_id=0):
+            bits = self.current_bits(layer_id)
+            if bits >= 16 or x.ndim < 2:
+                return x
+            return fake_quantize_grouped(x, bits=bits, groups=self.q_groups,
+                                         symmetric=self.symmetric,
+                                         rounding=self.q_rounding, rng=rng)
+
+        if isinstance(params, dict) and layer_axis_key in params and \
+                self.eigenvalue_enabled and self.layer_eigenvalues:
+            out = dict(params)
+            blocks = params[layer_axis_key]
+            num_layers = jax.tree_util.tree_leaves(blocks)[0].shape[0]
+
+            def per_layer(x):
+                if x.ndim < 3:
+                    return x
+                return jnp.stack([q_leaf(x[i], i) for i in range(num_layers)])
+
+            out[layer_axis_key] = jax.tree_util.tree_map(per_layer, blocks)
+            for k, v in out.items():
+                if k != layer_axis_key:
+                    out[k] = jax.tree_util.tree_map(q_leaf, v) \
+                        if isinstance(v, dict) else q_leaf(v)
+            return out
+        return jax.tree_util.tree_map(q_leaf, params)

@@ -1,0 +1,73 @@
+"""Flat-buffer ↔ per-parameter fragment mapping.
+
+Reference analog: ``deepspeed/utils/tensor_fragment.py`` — maps each
+parameter to its (offset, numel) slice of the flat fp32 optimizer partition
+so universal checkpointing can reassemble full tensors from dp shards.
+This framework's native checkpoints are already per-parameter global arrays
+(no flat buffers), so these helpers exist to IMPORT reference-style ZeRO
+checkpoints (zero_pp_rank_*_optim_states.pt flat partitions) and to export
+flat layouts other tools expect.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+
+@dataclass
+class Fragment:
+    name: str
+    offset: int     # element offset into the flat buffer
+    numel: int
+    shape: Tuple[int, ...]
+
+
+def fragment_map(shapes: Dict[str, Tuple[int, ...]],
+                 order: Optional[Sequence[str]] = None) -> List[Fragment]:
+    """Flat layout of the given param shapes in ``order`` — defaulting to
+    the dict's insertion order, which is how callers express the source's
+    registration order (reference flat partitions are laid out in parameter
+    registration order, NOT name order — a mismatched order reassembles
+    silently-wrong tensors)."""
+    names = list(order) if order is not None else list(shapes)
+    assert set(names) == set(shapes), \
+        f"order names {sorted(set(names) ^ set(shapes))} mismatch shapes"
+    out, off = [], 0
+    for name in names:
+        n = int(np.prod(shapes[name])) if shapes[name] else 1
+        out.append(Fragment(name, off, n, tuple(shapes[name])))
+        off += n
+    return out
+
+
+def flatten_params(params: Dict[str, np.ndarray]) -> np.ndarray:
+    frags = fragment_map({k: v.shape for k, v in params.items()})
+    flat = np.empty(sum(f.numel for f in frags), np.float32)
+    for f in frags:
+        flat[f.offset:f.offset + f.numel] = \
+            np.asarray(params[f.name], np.float32).reshape(-1)
+    return flat
+
+
+def unflatten_params(flat: np.ndarray,
+                     shapes: Dict[str, Tuple[int, ...]]) -> Dict[str, np.ndarray]:
+    frags = fragment_map(shapes)
+    total = sum(f.numel for f in frags)
+    assert flat.size >= total, \
+        f"flat buffer has {flat.size} elements, layout needs {total}"
+    return {f.name: flat[f.offset:f.offset + f.numel].reshape(f.shape)
+            for f in frags}
+
+
+def gather_dp_partitions(partitions: Sequence[np.ndarray],
+                         shapes: Dict[str, Tuple[int, ...]]
+                         ) -> Dict[str, np.ndarray]:
+    """Reassemble per-param tensors from dp-sharded flat partitions
+    (reference get_full_hp_param over zero shards: partitions are equal
+    slices of the concatenated flat buffer, possibly padded at the end)."""
+    flat = np.concatenate([np.asarray(p, np.float32).reshape(-1)
+                           for p in partitions])
+    return unflatten_params(flat, shapes)

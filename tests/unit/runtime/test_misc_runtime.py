@@ -1,0 +1,218 @@
+"""Misc runtime parity: eigenvalue power iteration, progressive layer drop,
+MoQ quantize-during-training, TP state-dict split/merge, tensor fragments
+(reference runtime/{eigenvalue,progressive_layer_drop,quantize,
+state_dict_factory}.py + utils/tensor_fragment.py)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+
+class TestEigenvalue:
+    def test_quadratic_form_exact(self):
+        """For loss = 0.5 x^T A x the Hessian IS A — power iteration must
+        find its largest eigenvalue."""
+        from deepspeed_tpu.runtime.eigenvalue import Eigenvalue
+
+        rng = np.random.RandomState(0)
+        q, _ = np.linalg.qr(rng.randn(8, 8))
+        eigs = np.array([5.0, 3.0, 2.0, 1.0, 0.5, 0.3, 0.2, 0.1])
+        a = jnp.asarray(q @ np.diag(eigs) @ q.T, jnp.float32)
+
+        def loss(params):
+            x = params["x"]
+            return 0.5 * x @ a @ x
+
+        ev = Eigenvalue(max_iter=100, tol=1e-6).compute_eigenvalue(
+            loss, {"x": jnp.zeros(8)})
+        assert ev == pytest.approx(5.0, rel=1e-3)
+        # default tol=1e-2 converges early (fewer HVPs) but still close
+        ev_fast = Eigenvalue(max_iter=100).compute_eigenvalue(
+            loss, {"x": jnp.zeros(8)})
+        assert ev_fast == pytest.approx(5.0, rel=5e-2)
+
+    def test_per_layer(self):
+        from deepspeed_tpu.runtime.eigenvalue import Eigenvalue
+
+        def loss(params):
+            b = params["blocks"]["w"]
+            # layer 0 has 2x the curvature of layer 1
+            return jnp.sum(b[0] ** 2) + 0.5 * jnp.sum(b[1] ** 2)
+
+        evs = Eigenvalue(max_iter=30).compute_layer_eigenvalues(
+            loss, {"blocks": {"w": jnp.ones((2, 4))}})
+        assert evs[0] == pytest.approx(2.0, rel=1e-2)
+        assert evs[1] == pytest.approx(1.0, rel=1e-2)
+
+    def test_post_process_fills_nonfinite(self):
+        from deepspeed_tpu.runtime.eigenvalue import Eigenvalue
+
+        out = Eigenvalue().post_process({0: 2.0, 1: float("nan")})
+        assert out[1] == 2.0
+
+
+class TestProgressiveLayerDrop:
+    def test_theta_schedule(self):
+        from deepspeed_tpu.runtime.progressive_layer_drop import (
+            ProgressiveLayerDrop)
+
+        pld = ProgressiveLayerDrop(theta=0.5, gamma=0.01)
+        assert pld.update_state(0) == pytest.approx(1.0)
+        mid = pld.update_state(100)
+        assert 0.5 < mid < 1.0
+        assert pld.update_state(100000) == pytest.approx(0.5, abs=1e-6)
+        assert pld.get_state()["pld_theta"] == pld.get_theta()
+
+    def test_engine_drops_layers(self):
+        """PLD enabled must change the training trajectory (layers actually
+        drop) while still learning."""
+        import deepspeed_tpu
+        from deepspeed_tpu.models.gpt2 import GPT2Config, GPT2Model
+
+        cfg = GPT2Config(vocab_size=64, max_seq_len=16, num_layers=4,
+                         hidden_size=32, num_heads=2)
+
+        def run(pld):
+            c = {"train_batch_size": 16, "gradient_accumulation_steps": 2,
+                 "bf16": {"enabled": True},
+                 "optimizer": {"type": "AdamW", "params": {"lr": 5e-3}},
+                 "steps_per_print": 0}
+            if pld:
+                c["progressive_layer_drop"] = {"enabled": True, "theta": 0.6,
+                                               "gamma": 0.05}
+            engine, *_ = deepspeed_tpu.initialize(model=GPT2Model(cfg), config=c)
+            rng = np.random.RandomState(0)
+            losses = []
+            for _ in range(12):
+                s = (rng.randint(0, 32, size=(2, 8, 1)) + np.arange(17)) % 64
+                b = {"input_ids": s[:, :, :-1].astype(np.int32),
+                     "labels": s[:, :, 1:].astype(np.int32)}
+                losses.append(float(jax.device_get(
+                    engine.train_batch_from_stacked(b))))
+            return losses, engine
+
+        l_off, _ = run(False)
+        l_on, eng = run(True)
+        assert eng._use_pld
+        assert l_on != l_off          # layers really dropped
+        assert l_on[-1] < l_on[0]     # and it still learns
+
+    def test_keep_probs_monotone(self):
+        from deepspeed_tpu.runtime.progressive_layer_drop import (
+            layer_keep_probs, sample_layer_mask)
+
+        probs = layer_keep_probs(6, 0.4)
+        assert probs[0] == pytest.approx(1.0)
+        assert probs[-1] == pytest.approx(0.4)
+        assert all(probs[i] >= probs[i + 1] for i in range(5))
+        keep, p = sample_layer_mask(jax.random.PRNGKey(0), 6, 0.4)
+        assert keep.shape == (6,) and bool(keep[0])  # p=1 layer always kept
+
+
+class TestMoQ:
+    def test_bit_schedule_halves(self):
+        from deepspeed_tpu.runtime.quantize import Quantizer
+
+        q = Quantizer(q_start_bits=16, q_target_bits=4, q_period=10)
+        assert q.current_bits() == 16
+        q.update_step(10)    # first transition
+        assert q.current_bits() == 8
+        q.update_step(10 + 20)  # period doubles
+        assert q.current_bits() == 4
+        q.update_step(10_000)
+        assert q.current_bits() == 4  # clamped at target
+
+    def test_quantize_applies_at_schedule(self):
+        from deepspeed_tpu.runtime.quantize import Quantizer
+
+        rng = np.random.RandomState(0)
+        params = {"w": jnp.asarray(rng.randn(16, 16), jnp.float32),
+                  "b": jnp.asarray(rng.randn(16), jnp.float32)}
+        q = Quantizer(q_start_bits=16, q_target_bits=4, q_period=5)
+        out = q.quantize(params)
+        np.testing.assert_array_equal(np.asarray(out["w"]),
+                                      np.asarray(params["w"]))  # 16 bits = off
+        q.update_step(5)
+        out = q.quantize(params)
+        assert not np.array_equal(np.asarray(out["w"]), np.asarray(params["w"]))
+        assert len(np.unique(np.asarray(out["w"]))) <= 256  # 8-bit levels
+        np.testing.assert_array_equal(np.asarray(out["b"]),
+                                      np.asarray(params["b"]))  # 1-D untouched
+
+    def test_eigenvalue_scaled_period(self):
+        from deepspeed_tpu.runtime.quantize import Quantizer
+
+        q = Quantizer(q_start_bits=16, q_target_bits=8, q_period=10,
+                      eigenvalue_enabled=True,
+                      layer_eigenvalues={0: 10.0, 1: 1.0})
+        q.update_step(12)
+        # layer 0 (high curvature → period 20) still full precision;
+        # layer 1 (period 11) already quantized
+        assert q.current_bits(0) == 16
+        assert q.current_bits(1) == 8
+
+
+class TestStateDictFactory:
+    def test_split_merge_round_trip(self):
+        from deepspeed_tpu.runtime.state_dict_factory import (
+            merge_state_dicts, split_state_dict)
+
+        rng = np.random.RandomState(0)
+        state = {
+            "h.0.attn.qkv_w": rng.randn(8, 24).astype(np.float32),
+            "h.0.attn.qkv_b": rng.randn(24).astype(np.float32),
+            "h.0.attn_out_w": rng.randn(8, 8).astype(np.float32),
+            "h.0.attn_out_b": rng.randn(8).astype(np.float32),
+            "h.0.ln_scale": rng.randn(8).astype(np.float32),
+            "wte": rng.randn(32, 8).astype(np.float32),
+        }
+        shards = split_state_dict(state, tp_size=4)
+        assert shards[0]["h.0.attn.qkv_w"].shape == (8, 6)   # col: out split
+        assert shards[0]["h.0.attn_out_w"].shape == (2, 8)   # row: in split
+        assert shards[0]["h.0.attn_out_b"].shape == (8,)     # replicated
+        assert shards[0]["wte"].shape == (32, 8)             # replicated
+        merged = merge_state_dicts(shards)
+        for k in state:
+            np.testing.assert_array_equal(merged[k], state[k])
+
+    def test_indivisible_split_rejected(self):
+        """Megatron-style consumers require equal shards — reject loudly
+        (reference SDLoader asserts divisibility)."""
+        from deepspeed_tpu.runtime.state_dict_factory import split_param_for_tp
+
+        w = np.arange(30, dtype=np.float32).reshape(3, 10)
+        with pytest.raises(ValueError, match="not.*divisible"):
+            split_param_for_tp("fc_w", w, 4, 0)
+
+
+class TestTensorFragment:
+    def test_flatten_round_trip(self):
+        from deepspeed_tpu.utils.tensor_fragment import (
+            flatten_params, unflatten_params)
+
+        rng = np.random.RandomState(0)
+        params = {"a": rng.randn(3, 4).astype(np.float32),
+                  "b": rng.randn(7).astype(np.float32),
+                  "c": rng.randn(2, 2, 2).astype(np.float32)}
+        flat = flatten_params(params)
+        assert flat.size == 12 + 7 + 8
+        back = unflatten_params(flat, {k: v.shape for k, v in params.items()})
+        for k in params:
+            np.testing.assert_array_equal(back[k], params[k])
+
+    def test_gather_dp_partitions(self):
+        """Reference-style ZeRO shard import: equal flat slices (+padding)
+        reassemble into per-param tensors."""
+        from deepspeed_tpu.utils.tensor_fragment import (
+            flatten_params, gather_dp_partitions)
+
+        rng = np.random.RandomState(1)
+        params = {"w": rng.randn(5, 5).astype(np.float32),
+                  "v": rng.randn(11).astype(np.float32)}
+        flat = flatten_params(params)
+        padded = np.concatenate([flat, np.zeros(4, np.float32)])  # pad to 40
+        parts = np.split(padded, 4)
+        back = gather_dp_partitions(parts, {k: v.shape for k, v in params.items()})
+        for k in params:
+            np.testing.assert_array_equal(back[k], params[k])
