@@ -72,8 +72,11 @@ def run_experiment(cmd: List[str], overrides: Dict, exp_dir: str,
 
 
 def run_autotuning(args, active_resources, experiments: Optional[List[Dict]] = None,
-                   results_dir: str = "autotuning_results") -> Optional[str]:
-    """Drive the experiment sweep (reference Autotuner.tune:404).
+                   results_dir: str = "autotuning_results",
+                   tuner_type: Optional[str] = None,
+                   max_parallel: int = 1) -> Optional[str]:
+    """Drive the experiment sweep (reference Autotuner.tune:404) through a
+    tuner algorithm + the ResourceManager scheduler.
 
     Experiments run on the LOCAL node through the per-node launcher (all
     local slots), which is how throughput-representative profiling works on
@@ -83,27 +86,34 @@ def run_autotuning(args, active_resources, experiments: Optional[List[Dict]] = N
     Returns the path to the winning overrides file, or None if every
     experiment failed.
     """
+    from deepspeed_tpu.autotuning.scheduler import ResourceManager
+    from deepspeed_tpu.autotuning.tuner import build_tuner
+    from deepspeed_tpu.launcher.runner import build_launch_command
+
     experiments = experiments or build_experiment_space()
     # route through the per-node launcher so experiments see the same rank
     # env/world as a real single-node run
-    from deepspeed_tpu.launcher.runner import build_launch_command
-
     local_host = next(iter(active_resources))
     local = {local_host: active_resources[local_host]}
     cmd = build_launch_command(args, local, node_rank=0, host=local_host)
-    best_metric, best_cfg = None, None
     os.makedirs(results_dir, exist_ok=True)
     records = []
-    for i, overrides in enumerate(experiments):
-        exp_dir = os.path.join(results_dir, f"exp_{i}")
+
+    def run_fn(overrides, exp_id):
+        exp_dir = os.path.join(results_dir, f"exp_{exp_id}")
         t0 = time.time()
         metric = run_experiment(cmd, overrides, exp_dir)
-        records.append({"exp": i, "overrides": overrides, "metric": metric,
+        records.append({"exp": exp_id, "overrides": overrides,
+                        "metric": metric,
                         "wall_s": round(time.time() - t0, 2)})
-        logger.info(f"autotuning exp {i}/{len(experiments)}: "
-                    f"{overrides} -> {metric}")
-        if metric is not None and (best_metric is None or metric > best_metric):
-            best_metric, best_cfg = metric, overrides
+        logger.info(f"autotuning exp {exp_id}: {overrides} -> {metric}")
+        return metric
+
+    tuner = build_tuner(
+        tuner_type or getattr(args, "autotuning_tuner", "gridsearch"),
+        experiments)
+    best_cfg, best_metric = ResourceManager(
+        run_fn, max_parallel=max_parallel).schedule(tuner)
     with open(os.path.join(results_dir, "summary.json"), "w") as f:
         json.dump(records, f, indent=2)
     if best_cfg is None:

@@ -1,0 +1,55 @@
+"""Experiment scheduler (reference ``autotuning/scheduler.py``
+ResourceManager): run tuner-proposed experiments over a bounded pool of
+parallel worker slots, feeding results back to the tuner until the space or
+the experiment budget is exhausted.
+"""
+
+from __future__ import annotations
+
+import concurrent.futures as cf
+from typing import Callable, Dict, List, Optional, Tuple
+
+from deepspeed_tpu.autotuning.tuner import BaseTuner
+from deepspeed_tpu.utils.logging import logger
+
+
+class ResourceManager:
+    def __init__(self, run_fn: Callable[[Dict, int], Optional[float]],
+                 max_parallel: int = 1, max_experiments: int = 0):
+        """``run_fn(experiment_config, exp_id) -> metric or None``."""
+        self.run_fn = run_fn
+        self.max_parallel = max(1, max_parallel)
+        self.max_experiments = max_experiments  # 0 = unlimited
+
+    def schedule(self, tuner: BaseTuner) -> Tuple[Optional[Dict], Optional[float]]:
+        """Drive the tuner to completion; returns (best_config, best_metric).
+
+        Slot-refill scheduling (reference ResourceManager): each completed
+        experiment immediately frees its slot for the tuner's next proposal —
+        no batch barrier, so one slow experiment never idles the pool."""
+        launched = 0
+        budget = self.max_experiments or len(tuner.all_experiments)
+        with cf.ThreadPoolExecutor(max_workers=self.max_parallel) as pool:
+            inflight: Dict = {}
+
+            def refill():
+                nonlocal launched
+                while len(inflight) < self.max_parallel and \
+                        launched < budget and tuner.has_next():
+                    for exp in tuner.next_batch(1):
+                        inflight[pool.submit(self.run_fn, exp, launched)] = exp
+                        launched += 1
+
+            refill()
+            while inflight:
+                done, _ = cf.wait(inflight, return_when=cf.FIRST_COMPLETED)
+                for fut in done:
+                    exp = inflight.pop(fut)
+                    try:
+                        metric = fut.result()
+                    except Exception as e:
+                        logger.warning(f"experiment {exp} crashed: {e}")
+                        metric = None
+                    tuner.update(exp, metric)
+                refill()
+        return tuner.best_config, tuner.best_metric

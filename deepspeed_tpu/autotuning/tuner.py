@@ -1,0 +1,177 @@
+"""Tuner algorithms + cost model.
+
+Reference analogs: ``RandomTuner``/``GridSearchTuner``
+(autotuning/tuner/index_based_tuner.py:11,27) and ``ModelBasedTuner`` with
+``XGBoostCostModel`` (tuner/model_based_tuner.py:19, tuner/cost_model.py:14).
+The model-based tuner here uses a ridge-regression cost model over one-hot
+encoded config features — numpy-only (no xgboost dependency) with the same
+role: rank untried configs by predicted throughput and evaluate the most
+promising first (epsilon-greedy exploration).
+"""
+
+from __future__ import annotations
+
+import random
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+
+def _flatten_config(cfg: Dict, prefix: str = "") -> Dict[str, Any]:
+    out = {}
+    for k, v in cfg.items():
+        key = f"{prefix}{k}"
+        if isinstance(v, dict):
+            out.update(_flatten_config(v, key + "."))
+        else:
+            out[key] = v
+    return out
+
+
+class FeatureEncoder:
+    """One-hot encode experiment configs over the observed value vocabulary
+    (the reference feeds similar flattened features to xgboost)."""
+
+    def __init__(self, experiments: Sequence[Dict]):
+        flat = [_flatten_config(e) for e in experiments]
+        self.keys = sorted({k for f in flat for k in f})
+        self.vocab: Dict[str, List] = {
+            k: sorted({str(f.get(k)) for f in flat}) for k in self.keys}
+
+    def encode(self, cfg: Dict) -> np.ndarray:
+        flat = _flatten_config(cfg)
+        vec = []
+        for k in self.keys:
+            onehot = [0.0] * len(self.vocab[k])
+            val = str(flat.get(k))
+            if val in self.vocab[k]:
+                onehot[self.vocab[k].index(val)] = 1.0
+            vec.extend(onehot)
+        return np.asarray(vec, np.float32)
+
+
+class CostModel:
+    """Ridge regression metric predictor (reference XGBoostCostModel.fit/
+    predict surface)."""
+
+    def __init__(self, l2: float = 1e-2):
+        self.l2 = l2
+        self._w: Optional[np.ndarray] = None
+
+    def fit(self, feats: np.ndarray, metrics: np.ndarray) -> None:
+        x = np.concatenate([feats, np.ones((len(feats), 1), np.float32)], 1)
+        a = x.T @ x + self.l2 * np.eye(x.shape[1], dtype=np.float32)
+        self._w = np.linalg.solve(a, x.T @ metrics.astype(np.float32))
+
+    def predict(self, feats: np.ndarray) -> np.ndarray:
+        assert self._w is not None, "fit() first"
+        x = np.concatenate([feats, np.ones((len(feats), 1), np.float32)], 1)
+        return x @ self._w
+
+
+class BaseTuner:
+    """Iteration protocol shared by all tuners (reference BaseTuner):
+    ``next_batch(n)`` proposes experiments, ``update(exp, metric)`` records
+    results (None = failed/pruned), ``best`` tracks the winner."""
+
+    def __init__(self, experiments: Sequence[Dict]):
+        self.all_experiments = list(experiments)
+        self._untried = list(range(len(self.all_experiments)))
+        self.results: List[Tuple[Dict, Optional[float]]] = []
+        self.best_metric: Optional[float] = None
+        self.best_config: Optional[Dict] = None
+
+    def has_next(self) -> bool:
+        return bool(self._untried)
+
+    def next_batch(self, n: int = 1) -> List[Dict]:
+        idxs = self._select(min(n, len(self._untried)))
+        for i in idxs:
+            self._untried.remove(i)
+        return [self.all_experiments[i] for i in idxs]
+
+    def _select(self, n: int) -> List[int]:
+        raise NotImplementedError
+
+    def update(self, experiment: Dict, metric: Optional[float]) -> None:
+        self.results.append((experiment, metric))
+        if metric is not None and (self.best_metric is None or
+                                   metric > self.best_metric):
+            self.best_metric, self.best_config = metric, experiment
+
+
+class GridSearchTuner(BaseTuner):
+    """In-order exhaustive sweep (reference GridSearchTuner:27)."""
+
+    def _select(self, n: int) -> List[int]:
+        return self._untried[:n]
+
+
+class RandomTuner(BaseTuner):
+    """Uniform without replacement (reference RandomTuner:11)."""
+
+    def __init__(self, experiments: Sequence[Dict], seed: int = 0):
+        super().__init__(experiments)
+        self._rng = random.Random(seed)
+
+    def _select(self, n: int) -> List[int]:
+        return self._rng.sample(self._untried, n)
+
+
+class ModelBasedTuner(BaseTuner):
+    """Cost-model guided search (reference ModelBasedTuner:19): after
+    ``warmup`` random evaluations, fit the cost model on observed results
+    and propose the untried configs with the highest predicted metric
+    (epsilon-greedy random exploration keeps the model honest)."""
+
+    def __init__(self, experiments: Sequence[Dict], seed: int = 0,
+                 warmup: int = 3, epsilon: float = 0.2):
+        super().__init__(experiments)
+        self.encoder = FeatureEncoder(experiments)
+        self.model = CostModel()
+        self.warmup = warmup
+        self.epsilon = epsilon
+        self._rng = random.Random(seed)
+
+    def _observed(self):
+        pairs = [(self.encoder.encode(e), m) for e, m in self.results
+                 if m is not None]
+        if not pairs:
+            return None, None
+        feats = np.stack([f for f, _ in pairs])
+        metrics = np.asarray([m for _, m in pairs], np.float32)
+        return feats, metrics
+
+    def _select(self, n: int) -> List[int]:
+        feats, metrics = self._observed()
+        if feats is None or len(feats) < self.warmup:
+            return self._rng.sample(self._untried, n)
+        self.model.fit(feats, metrics)
+        preds = self.model.predict(np.stack(
+            [self.encoder.encode(self.all_experiments[i])
+             for i in self._untried]))
+        ranked = [i for _, i in sorted(zip(-preds, self._untried))]
+        out = []
+        for _ in range(n):
+            if self._rng.random() < self.epsilon and len(ranked) > 1:
+                pick = self._rng.choice(ranked)
+            else:
+                pick = ranked[0]
+            ranked.remove(pick)
+            out.append(pick)
+        return out
+
+
+TUNER_REGISTRY = {
+    "gridsearch": GridSearchTuner,
+    "random": RandomTuner,
+    "model_based": ModelBasedTuner,
+}
+
+
+def build_tuner(name: str, experiments: Sequence[Dict], **kw) -> BaseTuner:
+    key = name.lower().replace("-", "_")
+    if key not in TUNER_REGISTRY:
+        raise ValueError(f"unknown tuner '{name}'; options: "
+                         f"{sorted(TUNER_REGISTRY)}")
+    return TUNER_REGISTRY[key](experiments, **kw)

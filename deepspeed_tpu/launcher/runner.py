@@ -70,6 +70,11 @@ def parse_args(args=None):
     parser.add_argument("--autotuning", type=str, default="",
                         choices=["", "tune", "run"],
                         help="Run the autotuner before/instead of training")
+    parser.add_argument("--autotuning_tuner", type=str, default="gridsearch",
+                        choices=["gridsearch", "random", "model_based"],
+                        help="Autotuning search algorithm")
+    parser.add_argument("--autotuning_parallel", type=int, default=1,
+                        help="Concurrent autotuning experiments")
     parser.add_argument("--elastic_training", action="store_true",
                         help="Supervise workers with restart-on-failure "
                              "(elastic agent)")
@@ -222,7 +227,9 @@ def main(args=None):
     if args.autotuning:
         from deepspeed_tpu.autotuning.cli import run_autotuning
 
-        best_path = run_autotuning(args, active)
+        best_path = run_autotuning(args, active,
+                                   tuner_type=args.autotuning_tuner,
+                                   max_parallel=args.autotuning_parallel)
         if best_path is None:
             return 1
         if args.autotuning == "tune":

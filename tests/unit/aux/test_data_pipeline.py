@@ -1,0 +1,146 @@
+"""Indexed dataset + data analyzer (reference data_sampling/indexed_dataset
+and data_analyzer), and their wiring into curriculum sampling."""
+
+import numpy as np
+import pytest
+
+from deepspeed_tpu.runtime.data_pipeline.data_analyzer import (
+    DataAnalyzer,
+    load_difficulties,
+    seqlen_metric,
+)
+from deepspeed_tpu.runtime.data_pipeline.indexed_dataset import (
+    MMapIndexedDataset,
+    MMapIndexedDatasetBuilder,
+)
+
+
+@pytest.fixture
+def corpus(tmp_path):
+    """Variable-length token sequences in the binary format."""
+    rng = np.random.RandomState(0)
+    prefix = str(tmp_path / "corpus")
+    builder = MMapIndexedDatasetBuilder(prefix, dtype=np.int32)
+    seqs = []
+    for i in range(20):
+        seq = rng.randint(0, 1000, size=rng.randint(5, 50)).astype(np.int32)
+        seqs.append(seq)
+        builder.add_item(seq)
+        if i % 5 == 4:
+            builder.end_document()
+    builder.finalize()
+    return prefix, seqs
+
+
+class TestIndexedDataset:
+    def test_round_trip(self, corpus):
+        prefix, seqs = corpus
+        ds = MMapIndexedDataset(prefix)
+        assert len(ds) == 20
+        for i, seq in enumerate(seqs):
+            np.testing.assert_array_equal(ds[i], seq)
+        np.testing.assert_array_equal(ds.sizes,
+                                      [len(s) for s in seqs])
+
+    def test_doc_boundaries(self, corpus):
+        prefix, _ = corpus
+        ds = MMapIndexedDataset(prefix)
+        np.testing.assert_array_equal(ds.doc_idx, [0, 5, 10, 15, 20])
+
+    def test_partial_get(self, corpus):
+        prefix, seqs = corpus
+        ds = MMapIndexedDataset(prefix)
+        np.testing.assert_array_equal(ds.get(3, offset=2, length=3),
+                                      seqs[3][2:5])
+
+    def test_slice(self, corpus):
+        prefix, seqs = corpus
+        ds = MMapIndexedDataset(prefix)
+        got = ds[2:5]
+        assert len(got) == 3
+        np.testing.assert_array_equal(got[0], seqs[2])
+
+    def test_exists_and_bad_magic(self, corpus, tmp_path):
+        prefix, _ = corpus
+        assert MMapIndexedDataset.exists(prefix)
+        assert not MMapIndexedDataset.exists(str(tmp_path / "nope"))
+        bad = tmp_path / "bad"
+        (tmp_path / "bad.idx").write_bytes(b"NOTMAGIC\x00\x00\x00")
+        (tmp_path / "bad.bin").write_bytes(b"")
+        with pytest.raises(ValueError, match="bad magic"):
+            MMapIndexedDataset(str(bad))
+
+    def test_uint16_dtype(self, tmp_path):
+        prefix = str(tmp_path / "u16")
+        b = MMapIndexedDatasetBuilder(prefix, dtype=np.uint16)
+        b.add_item([1, 2, 65535])
+        b.finalize()
+        ds = MMapIndexedDataset(prefix)
+        assert ds.dtype == np.uint16
+        np.testing.assert_array_equal(ds[0], [1, 2, 65535])
+
+
+class TestDataAnalyzer:
+    def test_single_worker(self, corpus, tmp_path):
+        prefix, seqs = corpus
+        ds = MMapIndexedDataset(prefix)
+        out = str(tmp_path / "analysis")
+        artifacts = DataAnalyzer(ds, output_path=out).run()
+        diffs = load_difficulties(out, "seqlen")
+        np.testing.assert_array_equal(diffs, [len(s) for s in seqs])
+        m2s = np.load(artifacts["seqlen"]["metric_to_sample"])
+        assert list(diffs[m2s]) == sorted(diffs)
+
+    def test_multi_worker_shards_merge(self, corpus, tmp_path):
+        prefix, seqs = corpus
+        ds = MMapIndexedDataset(prefix)
+        out = str(tmp_path / "analysis")
+        for w in range(3):
+            DataAnalyzer(ds, output_path=out, num_workers=3,
+                         worker_id=w).run_map()
+        DataAnalyzer(ds, output_path=out, num_workers=3).run_reduce()
+        diffs = load_difficulties(out, "seqlen")
+        np.testing.assert_array_equal(diffs, [len(s) for s in seqs])
+
+    def test_missing_partial_raises(self, corpus, tmp_path):
+        prefix, _ = corpus
+        ds = MMapIndexedDataset(prefix)
+        out = str(tmp_path / "analysis")
+        DataAnalyzer(ds, output_path=out, num_workers=2,
+                     worker_id=0).run_map()
+        with pytest.raises(FileNotFoundError, match="worker 1"):
+            DataAnalyzer(ds, output_path=out, num_workers=2).run_reduce()
+
+    def test_custom_metric(self, corpus, tmp_path):
+        prefix, seqs = corpus
+        ds = MMapIndexedDataset(prefix)
+        out = str(tmp_path / "analysis")
+        DataAnalyzer(ds, metric_names=["maxtok"],
+                     metric_functions=[lambda s: float(np.max(s))],
+                     output_path=out).run()
+        diffs = load_difficulties(out, "maxtok")
+        np.testing.assert_array_equal(diffs, [s.max() for s in seqs])
+
+    def test_feeds_curriculum_sampler(self, corpus, tmp_path):
+        """End-to-end: analyzer difficulties drive the curriculum sampler
+        (easy samples first)."""
+        from deepspeed_tpu.runtime.data_pipeline.curriculum_scheduler import (
+            CurriculumScheduler)
+        from deepspeed_tpu.runtime.data_pipeline.data_sampler import (
+            DeepSpeedDataSampler)
+
+        prefix, seqs = corpus
+        ds = MMapIndexedDataset(prefix)
+        out = str(tmp_path / "analysis")
+        DataAnalyzer(ds, output_path=out).run()
+        diffs = load_difficulties(out, "seqlen")
+        sched = CurriculumScheduler({
+            "curriculum_type": "seqlen", "min_difficulty": 8,
+            "max_difficulty": 50, "schedule_type": "fixed_linear",
+            "schedule_config": {"total_curriculum_step": 10,
+                                "difficulty_step": 1}})
+        sampler = DeepSpeedDataSampler(diffs, batch_size=4, curriculum=sched)
+        first = sampler.next_batch_indices()
+        # early curriculum: only short sequences eligible
+        assert all(len(seqs[i]) <= max(12, sorted(len(s) for s in seqs)[3])
+                   for i in first)

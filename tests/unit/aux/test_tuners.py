@@ -1,0 +1,144 @@
+"""Tuner algorithms + cost model + scheduler (reference
+autotuning/tuner/index_based_tuner.py, model_based_tuner.py, cost_model.py,
+scheduler.py ResourceManager)."""
+
+import threading
+
+import numpy as np
+import pytest
+
+from deepspeed_tpu.autotuning.scheduler import ResourceManager
+from deepspeed_tpu.autotuning.tuner import (
+    CostModel,
+    FeatureEncoder,
+    GridSearchTuner,
+    ModelBasedTuner,
+    RandomTuner,
+    build_tuner,
+)
+
+SPACE = [{"zero_optimization": {"stage": s},
+          "train_micro_batch_size_per_gpu": mb}
+         for s in (0, 1, 2, 3) for mb in (1, 2, 4)]
+
+
+def metric_of(cfg):
+    """Synthetic throughput: bigger micro batch helps; stage 3 costs."""
+    mb = cfg["train_micro_batch_size_per_gpu"]
+    stage = cfg["zero_optimization"]["stage"]
+    return 100.0 * mb - 15.0 * stage
+
+
+class TestIndexTuners:
+    def test_gridsearch_exhausts_in_order(self):
+        t = GridSearchTuner(SPACE)
+        seen = []
+        while t.has_next():
+            seen.extend(t.next_batch(5))
+        assert seen == SPACE
+
+    def test_random_covers_space(self):
+        t = RandomTuner(SPACE, seed=1)
+        seen = []
+        while t.has_next():
+            seen.extend(t.next_batch(3))
+        assert len(seen) == len(SPACE)
+        assert {str(s) for s in seen} == {str(s) for s in SPACE}
+        assert seen != SPACE  # actually shuffled
+
+    def test_best_tracking(self):
+        t = GridSearchTuner(SPACE)
+        while t.has_next():
+            for e in t.next_batch(1):
+                t.update(e, metric_of(e))
+        assert t.best_config == {"zero_optimization": {"stage": 0},
+                                 "train_micro_batch_size_per_gpu": 4}
+        assert t.best_metric == pytest.approx(400.0)
+
+    def test_failed_experiments_ignored_for_best(self):
+        t = GridSearchTuner(SPACE[:3])
+        while t.has_next():
+            for e in t.next_batch(1):
+                t.update(e, None)
+        assert t.best_config is None
+
+
+class TestCostModel:
+    def test_ridge_fits_linear_metric(self):
+        enc = FeatureEncoder(SPACE)
+        feats = np.stack([enc.encode(e) for e in SPACE])
+        metrics = np.asarray([metric_of(e) for e in SPACE], np.float32)
+        cm = CostModel()
+        cm.fit(feats, metrics)
+        preds = cm.predict(feats)
+        # one-hot features make the metric exactly representable
+        np.testing.assert_allclose(preds, metrics, atol=1.0)
+
+    def test_model_based_tuner_finds_best_early(self):
+        """After warmup, the cost model should steer toward good configs —
+        the best config is found in fewer evaluations than grid order."""
+        t = ModelBasedTuner(SPACE, seed=0, warmup=4, epsilon=0.0)
+        evals = 0
+        while t.has_next():
+            for e in t.next_batch(1):
+                evals += 1
+                t.update(e, metric_of(e))
+                if t.best_metric == pytest.approx(400.0):
+                    break
+            if t.best_metric == pytest.approx(400.0):
+                break
+        # grid order would need 12 evals (best is last); model-guided < 12
+        assert evals < len(SPACE)
+
+    def test_registry(self):
+        assert isinstance(build_tuner("GridSearch", SPACE), GridSearchTuner)
+        assert isinstance(build_tuner("random", SPACE), RandomTuner)
+        assert isinstance(build_tuner("model_based", SPACE), ModelBasedTuner)
+        with pytest.raises(ValueError, match="unknown tuner"):
+            build_tuner("bayes", SPACE)
+
+
+class TestResourceManager:
+    def test_parallel_scheduling(self):
+        lock = threading.Lock()
+        inflight = [0]
+        peak = [0]
+
+        def run_fn(exp, exp_id):
+            with lock:
+                inflight[0] += 1
+                peak[0] = max(peak[0], inflight[0])
+            import time
+
+            time.sleep(0.05)
+            with lock:
+                inflight[0] -= 1
+            return metric_of(exp)
+
+        tuner = GridSearchTuner(SPACE)
+        best_cfg, best_metric = ResourceManager(
+            run_fn, max_parallel=4).schedule(tuner)
+        assert best_metric == pytest.approx(400.0)
+        assert peak[0] > 1  # actually ran concurrently
+        assert len(tuner.results) == len(SPACE)
+
+    def test_experiment_budget(self):
+        calls = []
+
+        def run_fn(exp, exp_id):
+            calls.append(exp_id)
+            return metric_of(exp)
+
+        tuner = GridSearchTuner(SPACE)
+        ResourceManager(run_fn, max_parallel=2,
+                        max_experiments=5).schedule(tuner)
+        assert len(calls) == 5
+
+    def test_crashing_experiment_recorded_as_failed(self):
+        def run_fn(exp, exp_id):
+            raise RuntimeError("boom")
+
+        tuner = GridSearchTuner(SPACE[:2])
+        best_cfg, best_metric = ResourceManager(run_fn).schedule(tuner)
+        assert best_cfg is None
+        assert all(m is None for _, m in tuner.results)
