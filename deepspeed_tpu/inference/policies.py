@@ -429,3 +429,94 @@ def gptj_policy(hf_model, dtype):
     if "lm_head.bias" in sd:
         params["lm_head_bias"] = jnp.asarray(_np(sd["lm_head.bias"]))
     return model, params
+
+
+def _bert_common(hf_model, dtype, head):
+    """Shared BERT mapping (reference containers/bert.py HFBertLayerPolicy)."""
+    import jax.numpy as jnp
+
+    from deepspeed_tpu.models.bert import BertConfig, BertModel
+
+    hc = hf_model.config
+    cfg = BertConfig(
+        vocab_size=hc.vocab_size, max_seq_len=hc.max_position_embeddings,
+        type_vocab_size=hc.type_vocab_size, num_layers=hc.num_hidden_layers,
+        hidden_size=hc.hidden_size, num_heads=hc.num_attention_heads,
+        mlp_dim=hc.intermediate_size, eps=hc.layer_norm_eps,
+        num_labels=getattr(hc, "num_labels", 2))
+    model = BertModel(cfg, compute_dtype=dtype, head=head)
+    sd = hf_model.state_dict()
+    p = "bert."
+    L = cfg.num_layers
+    d = cfg.hidden_size
+
+    def qkv(i):
+        return np.concatenate(
+            [_lin(_np(sd[f"{p}encoder.layer.{i}.attention.self.{x}.weight"]))
+             for x in ("query", "key", "value")], axis=1)
+
+    def qkv_b(i):
+        return np.concatenate(
+            [_np(sd[f"{p}encoder.layer.{i}.attention.self.{x}.bias"])
+             for x in ("query", "key", "value")])
+
+    blocks = _dense_blocks(sd, L, {
+        "attn_out_w": p + "encoder.layer.{i}.attention.output.dense.weight",
+        "attn_out_b": p + "encoder.layer.{i}.attention.output.dense.bias",
+        "attn_ln_scale": p + "encoder.layer.{i}.attention.output.LayerNorm.weight",
+        "attn_ln_bias": p + "encoder.layer.{i}.attention.output.LayerNorm.bias",
+        "mlp_fc_w": p + "encoder.layer.{i}.intermediate.dense.weight",
+        "mlp_fc_b": p + "encoder.layer.{i}.intermediate.dense.bias",
+        "mlp_out_w": p + "encoder.layer.{i}.output.dense.weight",
+        "mlp_out_b": p + "encoder.layer.{i}.output.dense.bias",
+        "mlp_ln_scale": p + "encoder.layer.{i}.output.LayerNorm.weight",
+        "mlp_ln_bias": p + "encoder.layer.{i}.output.LayerNorm.bias",
+    }, post_map={"attn_out_w": _lin, "mlp_fc_w": _lin, "mlp_out_w": _lin})
+    blocks["qkv_w"] = jnp.asarray(np.stack([qkv(i) for i in range(L)]))
+    blocks["qkv_b"] = jnp.asarray(np.stack([qkv_b(i) for i in range(L)]))
+    params = {
+        "wte": jnp.asarray(_np(sd[p + "embeddings.word_embeddings.weight"])),
+        "wpe": jnp.asarray(_np(sd[p + "embeddings.position_embeddings.weight"])),
+        "wtt": jnp.asarray(_np(sd[p + "embeddings.token_type_embeddings.weight"])),
+        "emb_ln_scale": jnp.asarray(_np(sd[p + "embeddings.LayerNorm.weight"])),
+        "emb_ln_bias": jnp.asarray(_np(sd[p + "embeddings.LayerNorm.bias"])),
+        "blocks": blocks,
+    }
+    if f"{p}pooler.dense.weight" in sd:
+        params["pooler_w"] = jnp.asarray(_lin(_np(sd[p + "pooler.dense.weight"])))
+        params["pooler_b"] = jnp.asarray(_np(sd[p + "pooler.dense.bias"]))
+    else:  # BertForMaskedLM omits the pooler
+        params["pooler_w"] = jnp.zeros((d, d), jnp.float32)
+        params["pooler_b"] = jnp.zeros((d,), jnp.float32)
+    return model, params, sd
+
+
+@register_policy("BertForMaskedLM")
+def bert_mlm_policy(hf_model, dtype):
+    import jax.numpy as jnp
+
+    model, params, sd = _bert_common(hf_model, dtype, head="mlm")
+    params["mlm"] = {
+        "transform_w": jnp.asarray(_lin(_np(
+            sd["cls.predictions.transform.dense.weight"]))),
+        "transform_b": jnp.asarray(_np(
+            sd["cls.predictions.transform.dense.bias"])),
+        "ln_scale": jnp.asarray(_np(
+            sd["cls.predictions.transform.LayerNorm.weight"])),
+        "ln_bias": jnp.asarray(_np(
+            sd["cls.predictions.transform.LayerNorm.bias"])),
+        "decoder_bias": jnp.asarray(_np(sd["cls.predictions.bias"])),
+    }
+    return model, params
+
+
+@register_policy("BertForSequenceClassification")
+def bert_cls_policy(hf_model, dtype):
+    import jax.numpy as jnp
+
+    model, params, sd = _bert_common(hf_model, dtype, head="cls")
+    params["cls"] = {
+        "w": jnp.asarray(_lin(_np(sd["classifier.weight"]))),
+        "b": jnp.asarray(_np(sd["classifier.bias"])),
+    }
+    return model, params
