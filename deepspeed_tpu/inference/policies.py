@@ -443,6 +443,7 @@ def _bert_common(hf_model, dtype, head):
         type_vocab_size=hc.type_vocab_size, num_layers=hc.num_hidden_layers,
         hidden_size=hc.hidden_size, num_heads=hc.num_attention_heads,
         mlp_dim=hc.intermediate_size, eps=hc.layer_norm_eps,
+        hidden_act=hc.hidden_act,
         num_labels=getattr(hc, "num_labels", 2))
     model = BertModel(cfg, compute_dtype=dtype, head=head)
     sd = hf_model.state_dict()
@@ -507,6 +508,11 @@ def bert_mlm_policy(hf_model, dtype):
             sd["cls.predictions.transform.LayerNorm.bias"])),
         "decoder_bias": jnp.asarray(_np(sd["cls.predictions.bias"])),
     }
+    # untied MLM decoder: keep the checkpoint's projection rather than wte
+    dec_key = "cls.predictions.decoder.weight"
+    if dec_key in sd and not getattr(hf_model.config, "tie_word_embeddings",
+                                     True):
+        params["mlm"]["decoder_w"] = jnp.asarray(_np(sd[dec_key]))
     return model, params
 
 

@@ -21,8 +21,16 @@ from typing import Any, Dict, Optional
 import jax
 import jax.numpy as jnp
 
-from deepspeed_tpu.models.base import gelu, layer_norm
+from deepspeed_tpu.models.base import cross_entropy_loss, layer_norm
 from deepspeed_tpu.ops.attention import multihead_attention
+
+_ACTS = {
+    # HF BERT's default is the EXACT (erf) gelu — the repo-wide tanh
+    # approximation would drift per layer across deep post-LN stacks
+    "gelu": lambda x: jax.nn.gelu(x, approximate=False),
+    "gelu_new": lambda x: jax.nn.gelu(x, approximate=True),
+    "relu": jax.nn.relu,
+}
 
 
 @dataclasses.dataclass
@@ -36,6 +44,8 @@ class BertConfig:
     mlp_dim: int = 3072
     eps: float = 1e-12
     num_labels: int = 2          # sequence classification head width
+    hidden_act: str = "gelu"     # exact erf gelu (HF BERT default)
+    tie_mlm_decoder: bool = True
 
     @property
     def head_dim(self) -> int:
@@ -71,6 +81,8 @@ class BertModel:
         self.compute_dtype = compute_dtype
         self.head = head
         self.remat = remat
+        assert config.hidden_act in _ACTS, config.hidden_act
+        self._act = _ACTS[config.hidden_act]
 
     # ------------------------------------------------------------------- init
     def init(self, rng):
@@ -160,9 +172,9 @@ class BertModel:
             blk["attn_out_b"].astype(x.dtype)
         x = layer_norm(x + a_out, blk["attn_ln_scale"], blk["attn_ln_bias"],
                        c.eps)                                  # post-LN
-        mid = gelu(jnp.einsum("btd,dm->btm", x,
-                              blk["mlp_fc_w"].astype(x.dtype)) +
-                   blk["mlp_fc_b"].astype(x.dtype))
+        mid = self._act(jnp.einsum("btd,dm->btm", x,
+                                   blk["mlp_fc_w"].astype(x.dtype)) +
+                        blk["mlp_fc_b"].astype(x.dtype))
         m_out = jnp.einsum("btm,md->btd", mid,
                            blk["mlp_out_w"].astype(x.dtype)) + \
             blk["mlp_out_b"].astype(x.dtype)
@@ -206,11 +218,11 @@ class BertModel:
         c = self.config
         if self.head == "mlm":
             m = params["mlm"]
-            h = gelu(hidden @ m["transform_w"].astype(hidden.dtype) +
-                     m["transform_b"].astype(hidden.dtype))
+            h = self._act(hidden @ m["transform_w"].astype(hidden.dtype) +
+                          m["transform_b"].astype(hidden.dtype))
             h = layer_norm(h, m["ln_scale"], m["ln_bias"], c.eps)
-            return jnp.einsum("btd,vd->btv", h,
-                              params["wte"].astype(h.dtype)) + \
+            dec = m["decoder_w"] if "decoder_w" in m else params["wte"]
+            return jnp.einsum("btd,vd->btv", h, dec.astype(h.dtype)) + \
                 m["decoder_bias"].astype(h.dtype)
         if self.head == "cls":
             p = self.pooled(params, hidden)
@@ -219,18 +231,15 @@ class BertModel:
         return hidden
 
     def apply(self, params, batch, *, rngs=None, train=False):
+        assert self.head in ("mlm", "cls"), \
+            "head='none' is a feature extractor — use forward_hidden()"
         hidden = self.forward_hidden(
             params, batch["input_ids"], batch.get("attention_mask"),
             batch.get("token_type_ids"), rngs=rngs, train=train)
         logits = self.logits(params, hidden)
         labels = batch["labels"]
         if self.head == "mlm":
-            valid = labels != -100
-            safe = jnp.where(valid, labels, 0)
-            logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
-            nll = -jnp.take_along_axis(logp, safe[..., None], -1)[..., 0]
-            n = jnp.maximum(valid.sum(), 1)
-            loss = jnp.where(valid, nll, 0.0).sum() / n
+            loss, n = cross_entropy_loss(logits, labels)
         else:
             logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
             loss = -jnp.take_along_axis(logp, labels[:, None], -1).mean()

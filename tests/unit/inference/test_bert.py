@@ -36,7 +36,7 @@ class TestBertParity:
         model, params = convert_hf_model(hf, compute_dtype=jnp.float32)
         hidden = model.forward_hidden(params, jnp.asarray(IDS))
         ours = np.asarray(model.logits(params, hidden))
-        np.testing.assert_allclose(ours, ref, atol=2e-2, rtol=1e-3)
+        np.testing.assert_allclose(ours, ref, atol=2e-3, rtol=1e-3)
 
     def test_cls_logits_match(self):
         hf = transformers.BertForSequenceClassification(
@@ -46,7 +46,7 @@ class TestBertParity:
         model, params = convert_hf_model(hf, compute_dtype=jnp.float32)
         hidden = model.forward_hidden(params, jnp.asarray(IDS))
         ours = np.asarray(model.logits(params, hidden))
-        np.testing.assert_allclose(ours, ref, atol=2e-2, rtol=1e-3)
+        np.testing.assert_allclose(ours, ref, atol=2e-3, rtol=1e-3)
 
     def test_attention_mask_parity(self):
         """Padded positions must be masked identically to HF."""
@@ -74,7 +74,7 @@ class TestBertParity:
         hidden = model.forward_hidden(params, jnp.asarray(IDS),
                                       token_type_ids=jnp.asarray(tt))
         ours = np.asarray(model.logits(params, hidden))
-        np.testing.assert_allclose(ours, ref, atol=2e-2, rtol=1e-3)
+        np.testing.assert_allclose(ours, ref, atol=2e-3, rtol=1e-3)
 
 
 class TestBertTraining:
