@@ -11,16 +11,44 @@ import os
 # Must be set before the first jax backend initialisation.
 _flags = os.environ.get("XLA_FLAGS", "")
 if "host_platform_device_count" not in _flags:
-    os.environ["XLA_FLAGS"] = _flags + " --xla_force_host_platform_device_count=8"
+    _flags += " --xla_force_host_platform_device_count=8"
+if "collective_call_terminate" not in _flags:
+    # this sandbox exposes ONE cpu core: 8 virtual-device collective threads
+    # timeshare it, and long XLA compiles can starve a rendezvous past the
+    # default ~20/40s warn/terminate deadlines → spurious hard aborts.
+    # Give the rendezvous generous deadlines instead.
+    _flags += (" --xla_cpu_collective_call_warn_stuck_seconds=120"
+               " --xla_cpu_collective_call_terminate_timeout_seconds=900"
+               " --xla_cpu_collective_timeout_seconds=900")
+os.environ["XLA_FLAGS"] = _flags
 os.environ["DSTPU_ACCELERATOR"] = "cpu"
 
 import jax  # noqa: E402
+
+# persistent compile cache: cuts repeat-compile time (the main source of
+# single-core contention) across tests and across suite runs
+jax.config.update("jax_compilation_cache_dir",
+                  os.environ.get("DSTPU_TEST_CACHE", "/tmp/dstpu_jax_cache"))
+jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
 
 # The axon sitecustomize pins JAX_PLATFORMS=axon (one real TPU chip); tests
 # run on the virtual 8-device CPU backend instead.
 jax.config.update("jax_platforms", "cpu")
 
 import pytest  # noqa: E402
+
+# Modules that import torch must run LAST: on a single-core host, torch's
+# runtime (once loaded) starves XLA:CPU's multi-device collective rendezvous
+# threads — a later 8-device ppermute/psum times out after 20s and the
+# process aborts (observed: tests/unit/model_parallelism after
+# tests/unit/inference). Ordering all jax-collective tests before the first
+# torch import sidesteps the interaction deterministically.
+_TORCH_MODULES = ("test_policies", "test_bert", "test_inference")
+
+
+def pytest_collection_modifyitems(config, items):
+    items.sort(key=lambda it: any(m in it.nodeid for m in _TORCH_MODULES))
 
 
 @pytest.fixture(autouse=True)

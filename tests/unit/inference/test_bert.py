@@ -4,16 +4,24 @@ MLM training loss (reference tests' BingBertSquad / BERT container role)."""
 import numpy as np
 import pytest
 
-torch = pytest.importorskip("torch")
-transformers = pytest.importorskip("transformers")
+import jax
+import jax.numpy as jnp
 
-import jax  # noqa: E402
-import jax.numpy as jnp  # noqa: E402
-
-from deepspeed_tpu.inference.policies import convert_hf_model  # noqa: E402
+from deepspeed_tpu.inference.policies import convert_hf_model
 
 
-def _hf_cfg(**kw):
+@pytest.fixture(scope="module")
+def torch():
+    # lazy: see tests/conftest.py — torch loads only after collective tests
+    return pytest.importorskip("torch")
+
+
+@pytest.fixture(scope="module")
+def transformers(torch):
+    return pytest.importorskip("transformers")
+
+
+def _hf_cfg(transformers, **kw):
     kw.setdefault("vocab_size", 128)
     kw.setdefault("hidden_size", 32)
     kw.setdefault("num_hidden_layers", 2)
@@ -29,8 +37,8 @@ IDS = (np.arange(1, 17, dtype=np.int32).reshape(1, 16) * 3) % 100
 
 
 class TestBertParity:
-    def test_mlm_logits_match(self):
-        hf = transformers.BertForMaskedLM(_hf_cfg()).eval()
+    def test_mlm_logits_match(self, torch, transformers):
+        hf = transformers.BertForMaskedLM(_hf_cfg(transformers)).eval()
         with torch.no_grad():
             ref = hf(torch.tensor(IDS)).logits.float().numpy()
         model, params = convert_hf_model(hf, compute_dtype=jnp.float32)
@@ -38,9 +46,9 @@ class TestBertParity:
         ours = np.asarray(model.logits(params, hidden))
         np.testing.assert_allclose(ours, ref, atol=2e-3, rtol=1e-3)
 
-    def test_cls_logits_match(self):
+    def test_cls_logits_match(self, torch, transformers):
         hf = transformers.BertForSequenceClassification(
-            _hf_cfg(num_labels=3)).eval()
+            _hf_cfg(transformers, num_labels=3)).eval()
         with torch.no_grad():
             ref = hf(torch.tensor(IDS)).logits.float().numpy()
         model, params = convert_hf_model(hf, compute_dtype=jnp.float32)
@@ -48,9 +56,9 @@ class TestBertParity:
         ours = np.asarray(model.logits(params, hidden))
         np.testing.assert_allclose(ours, ref, atol=2e-3, rtol=1e-3)
 
-    def test_attention_mask_parity(self):
+    def test_attention_mask_parity(self, torch, transformers):
         """Padded positions must be masked identically to HF."""
-        hf = transformers.BertForMaskedLM(_hf_cfg()).eval()
+        hf = transformers.BertForMaskedLM(_hf_cfg(transformers)).eval()
         mask = np.ones((1, 16), np.int32)
         mask[0, 10:] = 0
         with torch.no_grad():
@@ -63,8 +71,8 @@ class TestBertParity:
         np.testing.assert_allclose(ours[:, :10], ref[:, :10], atol=2e-2,
                                    rtol=1e-3)
 
-    def test_token_type_parity(self):
-        hf = transformers.BertForMaskedLM(_hf_cfg()).eval()
+    def test_token_type_parity(self, torch, transformers):
+        hf = transformers.BertForMaskedLM(_hf_cfg(transformers)).eval()
         tt = np.zeros((1, 16), np.int32)
         tt[0, 8:] = 1
         with torch.no_grad():

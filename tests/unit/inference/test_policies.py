@@ -7,13 +7,23 @@ injected models against the HF baseline.  Also covers the AutoTP parser.
 import numpy as np
 import pytest
 
-torch = pytest.importorskip("torch")
-transformers = pytest.importorskip("transformers")
-
-from deepspeed_tpu.inference.policies import convert_hf_model  # noqa: E402
+from deepspeed_tpu.inference.policies import convert_hf_model
 
 
-def _logits_match(hf_model, ids, atol=2e-2):
+@pytest.fixture(scope="module")
+def torch():
+    # lazy: torch must not load at collection time — on a 1-core host its
+    # runtime starves XLA:CPU collective rendezvous threads, so conftest
+    # orders these modules last and the import happens only when they run
+    return pytest.importorskip("torch")
+
+
+@pytest.fixture(scope="module")
+def transformers(torch):
+    return pytest.importorskip("transformers")
+
+
+def _logits_match(torch, hf_model, ids, atol=2e-2):
     import jax
     import jax.numpy as jnp
 
@@ -32,43 +42,43 @@ IDS = np.arange(1, 17, dtype=np.int32).reshape(1, 16) % 100
 
 
 class TestPolicyParity:
-    def test_gpt2(self):
+    def test_gpt2(self, torch, transformers):
         cfg = transformers.GPT2Config(
             vocab_size=128, n_positions=64, n_embd=32, n_layer=2, n_head=2)
-        _logits_match(transformers.GPT2LMHeadModel(cfg), IDS)
+        _logits_match(torch, transformers.GPT2LMHeadModel(cfg), IDS)
 
-    def test_opt(self):
+    def test_opt(self, torch, transformers):
         cfg = transformers.OPTConfig(
             vocab_size=128, hidden_size=32, num_hidden_layers=2,
             num_attention_heads=2, ffn_dim=64, max_position_embeddings=64,
             do_layer_norm_before=True)
-        _logits_match(transformers.OPTForCausalLM(cfg), IDS)
+        _logits_match(torch, transformers.OPTForCausalLM(cfg), IDS)
 
-    def test_bloom(self):
+    def test_bloom(self, torch, transformers):
         cfg = transformers.BloomConfig(
             vocab_size=128, hidden_size=32, n_layer=2, n_head=2)
-        _logits_match(transformers.BloomForCausalLM(cfg), IDS)
+        _logits_match(torch, transformers.BloomForCausalLM(cfg), IDS)
 
-    def test_gpt_neox(self):
+    def test_gpt_neox(self, torch, transformers):
         cfg = transformers.GPTNeoXConfig(
             vocab_size=128, hidden_size=32, num_hidden_layers=2,
             num_attention_heads=2, intermediate_size=64,
             max_position_embeddings=64, rotary_pct=0.25,
             use_parallel_residual=True)
-        _logits_match(transformers.GPTNeoXForCausalLM(cfg), IDS)
+        _logits_match(torch, transformers.GPTNeoXForCausalLM(cfg), IDS)
 
-    def test_gptj(self):
+    def test_gptj(self, torch, transformers):
         cfg = transformers.GPTJConfig(
             vocab_size=128, n_positions=64, n_embd=32, n_layer=2, n_head=2,
             rotary_dim=8)
-        _logits_match(transformers.GPTJForCausalLM(cfg), IDS)
+        _logits_match(torch, transformers.GPTJForCausalLM(cfg), IDS)
 
-    def test_llama(self):
+    def test_llama(self, torch, transformers):
         cfg = transformers.LlamaConfig(
             vocab_size=128, hidden_size=32, num_hidden_layers=2,
             num_attention_heads=4, num_key_value_heads=2,
             intermediate_size=64, max_position_embeddings=64)
-        _logits_match(transformers.LlamaForCausalLM(cfg), IDS)
+        _logits_match(torch, transformers.LlamaForCausalLM(cfg), IDS)
 
     def test_unknown_arch_raises(self):
         class Mystery:
@@ -79,7 +89,7 @@ class TestPolicyParity:
 
 
 class TestDecodeParity:
-    def test_cached_decode_matches_full_forward(self):
+    def test_cached_decode_matches_full_forward(self, torch, transformers):
         """KV-cache decode must reproduce full-context logits (OPT; covers
         pos_offset + relu path)."""
         import jax
@@ -101,7 +111,7 @@ class TestDecodeParity:
                                        np.asarray(full[0, t]), atol=2e-3,
                                        rtol=1e-3)
 
-    def test_alibi_decode_matches_full_forward(self):
+    def test_alibi_decode_matches_full_forward(self, torch, transformers):
         """BLOOM (alibi) cached decode parity."""
         import jax.numpy as jnp
 
