@@ -17,8 +17,9 @@ if "collective_call_terminate" not in _flags:
     # timeshare it, and long XLA compiles can starve a rendezvous past the
     # default ~20/40s warn/terminate deadlines → spurious hard aborts.
     # Give the rendezvous generous deadlines instead.
-    _flags += (" --xla_cpu_collective_call_warn_stuck_seconds=120"
-               " --xla_cpu_collective_call_terminate_timeout_seconds=900"
+    # (warn_stuck_seconds is NOT registered in this jaxlib's flag parser and
+    # would be a fatal XLA_FLAGS error)
+    _flags += (" --xla_cpu_collective_call_terminate_timeout_seconds=900"
                " --xla_cpu_collective_timeout_seconds=900")
 os.environ["XLA_FLAGS"] = _flags
 os.environ["DSTPU_ACCELERATOR"] = "cpu"
