@@ -209,23 +209,18 @@ def opt_policy(hf_model, dtype):
     from deepspeed_tpu.models.transformer import DecoderConfig, DecoderModel
 
     hc = hf_model.config
-    # opt-350m style variants project embeddings (word_embed_proj_dim !=
-    # hidden) and/or use post-LN — reject with a clear message rather than
-    # mis-mapping weights
-    if getattr(hc, "word_embed_proj_dim", hc.hidden_size) != hc.hidden_size:
-        raise ValueError(
-            "opt_policy: word_embed_proj_dim != hidden_size (project_in/out "
-            "variants like opt-350m) is not supported")
-    if not getattr(hc, "do_layer_norm_before", True):
-        raise ValueError("opt_policy: post-LN OPT variants "
-                         "(do_layer_norm_before=False) are not supported")
+    sd = hf_model.state_dict()
+    p = "model.decoder."
+    we_dim = getattr(hc, "word_embed_proj_dim", hc.hidden_size)
     cfg = DecoderConfig.opt(
         vocab_size=hc.vocab_size, max_seq_len=hc.max_position_embeddings,
         num_layers=hc.num_hidden_layers, hidden_size=hc.hidden_size,
-        num_heads=hc.num_attention_heads, mlp_dim=hc.ffn_dim)
+        num_heads=hc.num_attention_heads, mlp_dim=hc.ffn_dim,
+        # opt-350m family: post-LN blocks, projected embeddings, no final LN
+        post_ln=not getattr(hc, "do_layer_norm_before", True),
+        final_ln=f"{p}final_layer_norm.weight" in sd,
+        word_embed_dim=we_dim if we_dim != hc.hidden_size else 0)
     model = DecoderModel(cfg, compute_dtype=dtype)
-    sd = hf_model.state_dict()
-    p = "model.decoder."
     L = cfg.num_layers
 
     def qkv(i):
@@ -256,9 +251,13 @@ def opt_policy(hf_model, dtype):
         "wte": jnp.asarray(_np(sd[p + "embed_tokens.weight"])),
         "wpe": jnp.asarray(_np(sd[p + "embed_positions.weight"])),
         "blocks": blocks,
-        "ln_f_scale": jnp.asarray(_np(sd[p + "final_layer_norm.weight"])),
-        "ln_f_bias": jnp.asarray(_np(sd[p + "final_layer_norm.bias"])),
     }
+    if cfg.final_ln:
+        params["ln_f_scale"] = jnp.asarray(_np(sd[p + "final_layer_norm.weight"]))
+        params["ln_f_bias"] = jnp.asarray(_np(sd[p + "final_layer_norm.bias"]))
+    if cfg.word_embed_dim:
+        params["project_in"] = jnp.asarray(_lin(_np(sd[p + "project_in.weight"])))
+        params["project_out"] = jnp.asarray(_lin(_np(sd[p + "project_out.weight"])))
     return model, params
 
 
@@ -524,5 +523,210 @@ def bert_cls_policy(hf_model, dtype):
     params["cls"] = {
         "w": jnp.asarray(_lin(_np(sd["classifier.weight"]))),
         "b": jnp.asarray(_np(sd["classifier.bias"])),
+    }
+    return model, params
+
+
+@register_policy("GPTNeoForCausalLM")
+def gpt_neo_policy(hf_model, dtype):
+    """HF GPTNeoForCausalLM → DecoderModel.gpt_neo (reference
+    module_inject/containers/gptneo.py HFGPTNEOLayerPolicy): unscaled QK^T,
+    alternating global/local (sliding-window) attention layers, bias-free
+    q/k/v projections."""
+    import jax.numpy as jnp
+
+    from deepspeed_tpu.models.transformer import DecoderConfig, DecoderModel
+
+    hc = hf_model.config
+    act_map = {"gelu_new": "gelu", "gelu": "gelu_exact", "relu": "relu"}
+    if hc.activation_function not in act_map:
+        raise ValueError(
+            f"gpt_neo_policy: unsupported activation_function "
+            f"{hc.activation_function!r}; supported: {sorted(act_map)}")
+    act = act_map[hc.activation_function]
+    cfg = DecoderConfig.gpt_neo(
+        vocab_size=hc.vocab_size, max_seq_len=hc.max_position_embeddings,
+        num_layers=hc.num_layers, hidden_size=hc.hidden_size,
+        num_heads=hc.num_heads,
+        mlp_dim=hc.intermediate_size or 4 * hc.hidden_size,
+        eps=hc.layer_norm_epsilon, activation=act,
+        local_attn_window=hc.window_size,
+        attn_layer_pattern=tuple(hc.attention_layers))
+    model = DecoderModel(cfg, compute_dtype=dtype)
+    sd = hf_model.state_dict()
+    p = "transformer."
+    L, d = cfg.num_layers, cfg.hidden_size
+
+    def qkv(i):
+        return np.concatenate(
+            [_lin(_np(sd[f"{p}h.{i}.attn.attention.{x}_proj.weight"]))
+             for x in ("q", "k", "v")], axis=1)
+
+    blocks = _dense_blocks(sd, L, {
+        "ln1_scale": p + "h.{i}.ln_1.weight",
+        "ln1_bias": p + "h.{i}.ln_1.bias",
+        "attn_out_w": p + "h.{i}.attn.attention.out_proj.weight",
+        "attn_out_b": p + "h.{i}.attn.attention.out_proj.bias",
+        "ln2_scale": p + "h.{i}.ln_2.weight",
+        "ln2_bias": p + "h.{i}.ln_2.bias",
+        "mlp_fc_w": p + "h.{i}.mlp.c_fc.weight",
+        "mlp_fc_b": p + "h.{i}.mlp.c_fc.bias",
+        "mlp_out_w": p + "h.{i}.mlp.c_proj.weight",
+        "mlp_out_b": p + "h.{i}.mlp.c_proj.bias",
+    }, post_map={"attn_out_w": _lin, "mlp_fc_w": _lin, "mlp_out_w": _lin})
+    blocks["qkv_w"] = jnp.asarray(np.stack([qkv(i) for i in range(L)]))
+    blocks["qkv_b"] = jnp.zeros((L, 3 * d))    # GPT-Neo q/k/v have no bias
+    params = {
+        "wte": jnp.asarray(_np(sd[p + "wte.weight"])),
+        "wpe": jnp.asarray(_np(sd[p + "wpe.weight"])),
+        "blocks": blocks,
+        "ln_f_scale": jnp.asarray(_np(sd[p + "ln_f.weight"])),
+        "ln_f_bias": jnp.asarray(_np(sd[p + "ln_f.bias"])),
+    }
+    return model, params
+
+
+def _distilbert_common(hf_model, dtype, head):
+    """Shared DistilBERT mapping (reference
+    module_inject/containers/distil_bert.py HFDistilBertLayerPolicy): BERT
+    post-LN encoder without token-type embeddings."""
+    import jax.numpy as jnp
+
+    from deepspeed_tpu.models.bert import BertConfig, BertModel
+
+    hc = hf_model.config
+    cfg = BertConfig(
+        vocab_size=hc.vocab_size, max_seq_len=hc.max_position_embeddings,
+        type_vocab_size=0, num_layers=hc.n_layers, hidden_size=hc.dim,
+        num_heads=hc.n_heads, mlp_dim=hc.hidden_dim, eps=1e-12,
+        hidden_act=hc.activation, pooler_act="relu",
+        num_labels=getattr(hc, "num_labels", 2))
+    model = BertModel(cfg, compute_dtype=dtype, head=head)
+    sd = hf_model.state_dict()
+    p = "distilbert."
+    L, d = cfg.num_layers, cfg.hidden_size
+
+    def qkv(i):
+        return np.concatenate(
+            [_lin(_np(sd[f"{p}transformer.layer.{i}.attention.{x}_lin.weight"]))
+             for x in ("q", "k", "v")], axis=1)
+
+    def qkv_b(i):
+        return np.concatenate(
+            [_np(sd[f"{p}transformer.layer.{i}.attention.{x}_lin.bias"])
+             for x in ("q", "k", "v")])
+
+    blocks = _dense_blocks(sd, L, {
+        "attn_out_w": p + "transformer.layer.{i}.attention.out_lin.weight",
+        "attn_out_b": p + "transformer.layer.{i}.attention.out_lin.bias",
+        "attn_ln_scale": p + "transformer.layer.{i}.sa_layer_norm.weight",
+        "attn_ln_bias": p + "transformer.layer.{i}.sa_layer_norm.bias",
+        "mlp_fc_w": p + "transformer.layer.{i}.ffn.lin1.weight",
+        "mlp_fc_b": p + "transformer.layer.{i}.ffn.lin1.bias",
+        "mlp_out_w": p + "transformer.layer.{i}.ffn.lin2.weight",
+        "mlp_out_b": p + "transformer.layer.{i}.ffn.lin2.bias",
+        "mlp_ln_scale": p + "transformer.layer.{i}.output_layer_norm.weight",
+        "mlp_ln_bias": p + "transformer.layer.{i}.output_layer_norm.bias",
+    }, post_map={"attn_out_w": _lin, "mlp_fc_w": _lin, "mlp_out_w": _lin})
+    blocks["qkv_w"] = jnp.asarray(np.stack([qkv(i) for i in range(L)]))
+    blocks["qkv_b"] = jnp.asarray(np.stack([qkv_b(i) for i in range(L)]))
+    params = {
+        "wte": jnp.asarray(_np(sd[p + "embeddings.word_embeddings.weight"])),
+        "wpe": jnp.asarray(_np(sd[p + "embeddings.position_embeddings.weight"])),
+        "emb_ln_scale": jnp.asarray(_np(sd[p + "embeddings.LayerNorm.weight"])),
+        "emb_ln_bias": jnp.asarray(_np(sd[p + "embeddings.LayerNorm.bias"])),
+        "blocks": blocks,
+        "pooler_w": jnp.zeros((d, d), jnp.float32),
+        "pooler_b": jnp.zeros((d,), jnp.float32),
+    }
+    return model, params, sd
+
+
+@register_policy("DistilBertForMaskedLM")
+def distilbert_mlm_policy(hf_model, dtype):
+    import jax.numpy as jnp
+
+    model, params, sd = _distilbert_common(hf_model, dtype, head="mlm")
+    params["mlm"] = {
+        "transform_w": jnp.asarray(_lin(_np(sd["vocab_transform.weight"]))),
+        "transform_b": jnp.asarray(_np(sd["vocab_transform.bias"])),
+        "ln_scale": jnp.asarray(_np(sd["vocab_layer_norm.weight"])),
+        "ln_bias": jnp.asarray(_np(sd["vocab_layer_norm.bias"])),
+        "decoder_w": jnp.asarray(_np(sd["vocab_projector.weight"])),
+        "decoder_bias": jnp.asarray(_np(sd["vocab_projector.bias"])),
+    }
+    return model, params
+
+
+@register_policy("DistilBertForSequenceClassification")
+def distilbert_cls_policy(hf_model, dtype):
+    import jax.numpy as jnp
+
+    model, params, sd = _distilbert_common(hf_model, dtype, head="cls")
+    # relu pre_classifier plays the pooler's role; classifier on top
+    params["pooler_w"] = jnp.asarray(_lin(_np(sd["pre_classifier.weight"])))
+    params["pooler_b"] = jnp.asarray(_np(sd["pre_classifier.bias"]))
+    params["cls"] = {
+        "w": jnp.asarray(_lin(_np(sd["classifier.weight"]))),
+        "b": jnp.asarray(_np(sd["classifier.bias"])),
+    }
+    return model, params
+
+
+def convert_megatron_gpt_checkpoint(sd, *, num_heads, megatron_v2=True,
+                                    compute_dtype=None, eps=1e-5):
+    """Megatron-LM GPT state dict → (GPT2Model, params).
+
+    Reference analog: ``module_inject/containers/megatron_gpt.py``
+    (MegatronLayerPolicy) + ``state_dict_factory.py`` — serving Megatron
+    checkpoints through the same engine as HF ones.  Handles both fused-qkv
+    row layouts: ``megatron_v2=True`` = rows ordered (heads, 3, head_dim)
+    (Megatron ≥ 2.0 "version 2"), ``False`` = (3, heads, head_dim).
+    Shapes are inferred from the checkpoint; padded vocab rows are kept
+    (harmless: the extra logits are never sampled by HF tokenizers).
+    """
+    import jax.numpy as jnp
+
+    from deepspeed_tpu.models.gpt2 import GPT2Config, GPT2Model
+
+    sd = {k.replace("language_model.", "").replace("encoder.", "transformer.")
+           .replace("transformer.layers.", "layers.")
+           .replace("embedding.", ""): v
+          for k, v in sd.items()}
+    wte = _np(sd["word_embeddings.weight"])
+    wpe = _np(sd["position_embeddings.weight"])
+    num_layers = 1 + max(int(k.split(".")[1]) for k in sd
+                         if k.startswith("layers."))
+    d = wte.shape[1]
+    cfg = GPT2Config(vocab_size=wte.shape[0], max_seq_len=wpe.shape[0],
+                     num_layers=num_layers, hidden_size=d,
+                     num_heads=num_heads, eps=eps, tie_embeddings=True)
+    model = GPT2Model(cfg, compute_dtype=compute_dtype or jnp.bfloat16)
+
+    def qkv_w(x):
+        return _fuse_headwise_qkv(x, num_heads) if megatron_v2 else x.T
+
+    def qkv_b(x):
+        return (_fuse_headwise_qkv_bias(x, num_heads) if megatron_v2 else x)
+
+    blocks = _dense_blocks(sd, num_layers, {
+        "ln1_scale": "layers.{i}.input_layernorm.weight",
+        "ln1_bias": "layers.{i}.input_layernorm.bias",
+        "qkv_w": "layers.{i}.attention.query_key_value.weight",
+        "qkv_b": "layers.{i}.attention.query_key_value.bias",
+        "attn_out_w": "layers.{i}.attention.dense.weight",
+        "attn_out_b": "layers.{i}.attention.dense.bias",
+        "ln2_scale": "layers.{i}.post_attention_layernorm.weight",
+        "ln2_bias": "layers.{i}.post_attention_layernorm.bias",
+        "mlp_fc_w": "layers.{i}.mlp.dense_h_to_4h.weight",
+        "mlp_fc_b": "layers.{i}.mlp.dense_h_to_4h.bias",
+        "mlp_out_w": "layers.{i}.mlp.dense_4h_to_h.weight",
+        "mlp_out_b": "layers.{i}.mlp.dense_4h_to_h.bias",
+    }, post_map={"qkv_w": qkv_w, "qkv_b": qkv_b,
+                 "attn_out_w": _lin, "mlp_fc_w": _lin, "mlp_out_w": _lin})
+    params = {
+        "wte": jnp.asarray(wte), "wpe": jnp.asarray(wpe), "blocks": blocks,
+        "ln_f_scale": jnp.asarray(_np(sd["transformer.final_layernorm.weight"])),
+        "ln_f_bias": jnp.asarray(_np(sd["transformer.final_layernorm.bias"])),
     }
     return model, params

@@ -46,6 +46,9 @@ class BertConfig:
     num_labels: int = 2          # sequence classification head width
     hidden_act: str = "gelu"     # exact erf gelu (HF BERT default)
     tie_mlm_decoder: bool = True
+    # DistilBERT: no token-type embeddings (type_vocab_size=0) and a
+    # relu pre-classifier instead of BERT's tanh pooler
+    pooler_act: str = "tanh"     # "tanh" | "relu"
 
     @property
     def head_dim(self) -> int:
@@ -93,7 +96,6 @@ class BertModel:
         params = {
             "wte": init(k[0], (v, d), jnp.float32),
             "wpe": init(k[1], (c.max_seq_len, d), jnp.float32),
-            "wtt": init(k[2], (c.type_vocab_size, d), jnp.float32),
             "emb_ln_scale": jnp.ones((d,)), "emb_ln_bias": jnp.zeros((d,)),
             "blocks": {
                 "qkv_w": init(k[3], (l, d, 3 * d), jnp.float32),
@@ -112,6 +114,8 @@ class BertModel:
             "pooler_w": init(k[7], (d, d), jnp.float32),
             "pooler_b": jnp.zeros((d,)),
         }
+        if c.type_vocab_size > 0:
+            params["wtt"] = init(k[2], (c.type_vocab_size, d), jnp.float32)
         if self.head == "mlm":
             params["mlm"] = {
                 "transform_w": init(k[8], (d, d), jnp.float32),
@@ -130,7 +134,6 @@ class BertModel:
         c = self.config
         axes = {
             "wte": ("vocab_in", "hidden"), "wpe": ("seq", "hidden"),
-            "wtt": (None, "hidden"),
             "emb_ln_scale": ("hidden",), "emb_ln_bias": ("hidden",),
             "blocks": {
                 "qkv_w": ("layer", "hidden", "heads"),
@@ -148,6 +151,8 @@ class BertModel:
             },
             "pooler_w": ("hidden", "hidden"), "pooler_b": ("hidden",),
         }
+        if c.type_vocab_size > 0:
+            axes["wtt"] = (None, "hidden")
         if self.head == "mlm":
             axes["mlm"] = {"transform_w": ("hidden", "hidden"),
                            "transform_b": ("hidden",),
@@ -188,9 +193,10 @@ class BertModel:
         b, t = input_ids.shape
         x = params["wte"].astype(self.compute_dtype)[input_ids]
         x = x + params["wpe"].astype(self.compute_dtype)[:t][None]
-        if token_type_ids is None:
-            token_type_ids = jnp.zeros_like(input_ids)
-        x = x + params["wtt"].astype(self.compute_dtype)[token_type_ids]
+        if c.type_vocab_size > 0:
+            if token_type_ids is None:
+                token_type_ids = jnp.zeros_like(input_ids)
+            x = x + params["wtt"].astype(self.compute_dtype)[token_type_ids]
         x = layer_norm(x, params["emb_ln_scale"], params["emb_ln_bias"], c.eps)
 
         mask_bias = None
@@ -209,10 +215,12 @@ class BertModel:
         return x
 
     def pooled(self, params, hidden):
-        """tanh(dense(CLS)) (reference BertPooler)."""
+        """act(dense(CLS)) — tanh (reference BertPooler) or relu
+        (DistilBERT pre_classifier)."""
         cls = hidden[:, 0]
-        return jnp.tanh(cls @ params["pooler_w"].astype(cls.dtype) +
-                        params["pooler_b"].astype(cls.dtype))
+        act = jnp.tanh if self.config.pooler_act == "tanh" else jax.nn.relu
+        return act(cls @ params["pooler_w"].astype(cls.dtype) +
+                   params["pooler_b"].astype(cls.dtype))
 
     def logits(self, params, hidden):
         c = self.config

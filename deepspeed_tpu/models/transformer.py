@@ -65,9 +65,19 @@ class DecoderConfig:
     # block topology
     parallel_residual: bool = False   # NeoX / GPT-J
     dual_ln: bool = True              # NeoX two LNs; GPT-J single
-    activation: str = "gelu"          # "gelu" | "relu"
+    post_ln: bool = False             # OPT do_layer_norm_before=False
+    final_ln: bool = True             # opt-350m has no final LayerNorm
+    activation: str = "gelu"          # "gelu" (tanh) | "gelu_exact" | "relu"
     embedding_ln: bool = False        # BLOOM word_embeddings_layernorm
     tie_embeddings: bool = False
+    # OPT word_embed_proj_dim != hidden (opt-350m): embeddings live in a
+    # smaller space with project_in/project_out linears around the stack
+    word_embed_dim: int = 0           # 0 = same as hidden_size
+    # attention-score scale override (GPT-Neo scales by 1.0, not dh^-0.5)
+    qk_scale: Optional[float] = None
+    # GPT-Neo local (sliding-window causal) attention on marked layers
+    local_attn_window: int = 0
+    attn_layer_pattern: tuple = ()    # per-layer: "global" | "local"
 
     @property
     def head_dim(self) -> int:
@@ -85,6 +95,13 @@ class DecoderConfig:
     def opt(cls, **kw):
         kw.setdefault("activation", "relu")
         kw.setdefault("pos_offset", 2)
+        kw.setdefault("tie_embeddings", True)
+        return cls(**kw)
+
+    @classmethod
+    def gpt_neo(cls, **kw):
+        kw.setdefault("qk_scale", 1.0)        # HF GPTNeo never scales QK^T
+        kw.setdefault("local_attn_window", 256)
         kw.setdefault("tie_embeddings", True)
         return cls(**kw)
 
@@ -121,21 +138,34 @@ class DecoderModel:
         self.remat = remat
         self.remat_policy = remat_policy
         c = config
-        assert c.activation in ("gelu", "relu"), c.activation
+        assert c.activation in ("gelu", "gelu_exact", "relu"), c.activation
         assert c.pos_emb in ("learned", "none"), c.pos_emb
+        assert not (c.post_ln and c.parallel_residual), \
+            "post_ln is a sequential-residual (OPT) topology"
         if c.alibi:
             self._alibi = jnp.asarray(alibi_slopes(c.num_heads), jnp.float32)
         if c.rotary_dim > 0:
             self._rope_cos, self._rope_sin = rope_frequencies(
                 c.rotary_dim, c.max_seq_len, theta=c.rope_theta)
+        self._local_flags = None
+        if c.attn_layer_pattern:
+            assert c.local_attn_window > 0, \
+                "attn_layer_pattern needs local_attn_window"
+            assert len(c.attn_layer_pattern) == c.num_layers
+            self._local_flags = jnp.asarray(
+                [p == "local" for p in c.attn_layer_pattern], bool)
 
     def _act(self, x):
-        return gelu(x) if self.config.activation == "gelu" else jax.nn.relu(x)
+        if self.config.activation == "gelu":
+            return gelu(x)                       # tanh approximation
+        if self.config.activation == "gelu_exact":
+            return jax.nn.gelu(x, approximate=False)
+        return jax.nn.relu(x)
 
     # ------------------------------------------------------------------- init
     def init(self, rng):
         c = self.config
-        k = jax.random.split(rng, 8)
+        k = jax.random.split(rng, 9)
         d, l, m, v = c.hidden_size, c.num_layers, c.mlp_dim, c.vocab_size
         init = jax.nn.initializers.normal(0.02)
         blocks = {
@@ -152,11 +182,17 @@ class DecoderModel:
         if c.dual_ln or not c.parallel_residual:
             blocks["ln2_scale"] = jnp.ones((l, d))
             blocks["ln2_bias"] = jnp.zeros((l, d))
+        we = c.word_embed_dim or d
         params = {
-            "wte": init(k[0], (v, d), jnp.float32),
+            "wte": init(k[0], (v, we), jnp.float32),
             "blocks": blocks,
-            "ln_f_scale": jnp.ones((d,)), "ln_f_bias": jnp.zeros((d,)),
         }
+        if c.final_ln:
+            params["ln_f_scale"] = jnp.ones((d,))
+            params["ln_f_bias"] = jnp.zeros((d,))
+        if we != d:
+            params["project_in"] = init(k[7], (we, d), jnp.float32)
+            params["project_out"] = init(k[8], (d, we), jnp.float32)
         if c.pos_emb == "learned":
             params["wpe"] = init(k[1], (c.max_seq_len + c.pos_offset, d),
                                  jnp.float32)
@@ -183,8 +219,13 @@ class DecoderModel:
         if c.dual_ln or not c.parallel_residual:
             blocks["ln2_scale"] = ("layer", "hidden")
             blocks["ln2_bias"] = ("layer", "hidden")
-        axes = {"wte": ("vocab_in", "hidden"), "blocks": blocks,
-                "ln_f_scale": ("hidden",), "ln_f_bias": ("hidden",)}
+        axes = {"wte": ("vocab_in", "hidden"), "blocks": blocks}
+        if c.final_ln:
+            axes["ln_f_scale"] = ("hidden",)
+            axes["ln_f_bias"] = ("hidden",)
+        if (c.word_embed_dim or c.hidden_size) != c.hidden_size:
+            axes["project_in"] = (None, "hidden")
+            axes["project_out"] = ("hidden", None)
         if c.pos_emb == "learned":
             axes["wpe"] = ("seq", "hidden")
         if c.embedding_ln:
@@ -224,16 +265,25 @@ class DecoderModel:
             k_ = jnp.concatenate([rk, pk], axis=-1)
         return q, k_, v_
 
-    def _block_impl(self, x, blk, cache):
+    def _block_impl(self, x, blk, cache, local_flag=None):
         c = self.config
         b, t, d = x.shape
         idx = cache[2] if cache is not None else 0
 
-        y1 = layer_norm(x, blk["ln1_scale"], blk["ln1_bias"], c.eps)
+        y1 = x if c.post_ln else layer_norm(x, blk["ln1_scale"],
+                                            blk["ln1_bias"], c.eps)
         q, k_, v_ = self._qkv(y1, blk, idx)
         if cache is None:
-            attn = multihead_attention(q, k_, v_, causal=True,
-                                       bias=self._attn_bias(t, t))
+            mask = None
+            if local_flag is not None:
+                # sliding-window causal: key allowed iff q_pos-k_pos < window
+                # (on layers whose pattern says "local"; others stay global)
+                delta = jnp.arange(t)[:, None] - jnp.arange(t)[None, :]
+                mask = (jnp.logical_not(local_flag) |
+                        (delta < c.local_attn_window))[None, None]
+            attn = multihead_attention(q, k_, v_, causal=True, mask=mask,
+                                       bias=self._attn_bias(t, t),
+                                       scale=c.qk_scale)
             kc = vc = None
         else:
             kc, vc, _ = cache
@@ -241,8 +291,14 @@ class DecoderModel:
             if c.alibi:
                 dec_bias = self._alibi[:, None] * jnp.arange(
                     kc.shape[1], dtype=jnp.float32)[None, :]
+            window = None
+            if local_flag is not None:
+                window = jnp.where(local_flag, c.local_attn_window,
+                                   kc.shape[1] + 1)
             attn, kc, vc = attention_with_kv_cache(q, k_, v_, kc, vc, idx,
-                                                   bias=dec_bias)
+                                                   bias=dec_bias,
+                                                   scale=c.qk_scale,
+                                                   window=window)
         attn = attn.reshape(b, t, d)
         attn_out = jnp.einsum("btd,de->bte", attn,
                               blk["attn_out_w"].astype(x.dtype)) + \
@@ -260,13 +316,18 @@ class DecoderModel:
             x = x + attn_out + mlp_out
         else:
             x = x + attn_out
-            y2 = layer_norm(x, blk["ln2_scale"], blk["ln2_bias"], c.eps)
+            if c.post_ln:      # OPT do_layer_norm_before=False: LN after add
+                x = layer_norm(x, blk["ln1_scale"], blk["ln1_bias"], c.eps)
+            y2 = x if c.post_ln else layer_norm(x, blk["ln2_scale"],
+                                                blk["ln2_bias"], c.eps)
             mid = self._act(jnp.einsum("btd,dm->btm", y2,
                                        blk["mlp_fc_w"].astype(x.dtype)) +
                             blk["mlp_fc_b"].astype(x.dtype))
             x = x + jnp.einsum("btm,md->btd", mid,
                                blk["mlp_out_w"].astype(x.dtype)) + \
                 blk["mlp_out_b"].astype(x.dtype)
+            if c.post_ln:
+                x = layer_norm(x, blk["ln2_scale"], blk["ln2_bias"], c.eps)
         return x, kc, vc
 
     # ---------------------------------------------------------------- forward
@@ -274,6 +335,8 @@ class DecoderModel:
         c = self.config
         b, t = input_ids.shape
         x = params["wte"].astype(self.compute_dtype)[input_ids]
+        if "project_in" in params:
+            x = x @ params["project_in"].astype(x.dtype)
         if c.pos_emb == "learned":
             pos = idx + jnp.arange(t) + c.pos_offset
             x = x + params["wpe"].astype(self.compute_dtype)[pos][None]
@@ -286,8 +349,8 @@ class DecoderModel:
         c = self.config
         x = self._embed(params, input_ids, jnp.zeros((), jnp.int32))
 
-        def block_fn(x, blk):
-            return self._block_impl(x, blk, None)[0]
+        def block_fn(x, blk, flag):
+            return self._block_impl(x, blk, None, local_flag=flag)[0]
 
         if self.remat:
             from deepspeed_tpu.runtime.activation_checkpointing import (
@@ -296,13 +359,26 @@ class DecoderModel:
             block_fn = jax.checkpoint(block_fn,
                                       policy=checkpoint_policy(self.remat_policy))
 
-        def scan_body(x, blk):
-            return block_fn(x, blk), None
+        if self._local_flags is not None:
+            def scan_body(x, layer_in):
+                blk, flag = layer_in
+                return block_fn(x, blk, flag), None
 
-        x, _ = jax.lax.scan(scan_body, x, params["blocks"])
-        return layer_norm(x, params["ln_f_scale"], params["ln_f_bias"], c.eps)
+            x, _ = jax.lax.scan(scan_body, x,
+                                (params["blocks"], self._local_flags))
+        else:
+            def scan_body(x, blk):
+                return block_fn(x, blk, None), None
+
+            x, _ = jax.lax.scan(scan_body, x, params["blocks"])
+        if c.final_ln:
+            x = layer_norm(x, params["ln_f_scale"], params["ln_f_bias"],
+                           c.eps)
+        return x
 
     def logits(self, params, hidden):
+        if "project_out" in params:
+            hidden = hidden @ params["project_out"].astype(hidden.dtype)
         if self.config.tie_embeddings:
             out = jnp.einsum("btd,vd->btv", hidden,
                              params["wte"].astype(hidden.dtype))
@@ -332,17 +408,27 @@ class DecoderModel:
         c = self.config
         idx = cache["index"]
         x = self._embed(params, input_ids, idx)
+        flags = self._local_flags
+        if flags is None:
+            flags = jnp.zeros((c.num_layers,), bool)
+            use_flags = False
+        else:
+            use_flags = True
 
         def scan_body(x, layer_in):
-            blk, kc, vc = layer_in
-            x, kc, vc = self._block_impl(x, blk, (kc, vc, idx))
+            blk, kc, vc, flag = layer_in
+            x, kc, vc = self._block_impl(
+                x, blk, (kc, vc, idx),
+                local_flag=flag if use_flags else None)
             return x, (kc, vc)
 
         x, (k_new, v_new) = jax.lax.scan(
-            scan_body, x, (params["blocks"], cache["k"], cache["v"]))
-        hidden = layer_norm(x, params["ln_f_scale"], params["ln_f_bias"], c.eps)
-        return self.logits(params, hidden), {"k": k_new, "v": v_new,
-                                             "index": idx + input_ids.shape[1]}
+            scan_body, x, (params["blocks"], cache["k"], cache["v"], flags))
+        if c.final_ln:
+            x = layer_norm(x, params["ln_f_scale"], params["ln_f_bias"],
+                           c.eps)
+        return self.logits(params, x), {"k": k_new, "v": v_new,
+                                        "index": idx + input_ids.shape[1]}
 
     def flops_per_token(self) -> float:
         c = self.config

@@ -62,6 +62,8 @@ def attention_with_kv_cache(
     scale: Optional[float] = None,
     bias: Optional[jax.Array] = None,  # [H, S_max] additive (alibi: softmax
     # shift-invariance makes slopes*key_pos correct for every query position)
+    window: Optional[jax.Array] = None,  # scalar: keys older than
+    # q_pos-window are masked (GPT-Neo local attention); None = full causal
 ):
     """Decode-time attention against a static-shape KV cache.
 
@@ -88,6 +90,8 @@ def attention_with_kv_cache(
     pos = jnp.arange(s_max)[None, :]  # [1, S]
     q_pos = cache_index + jnp.arange(t)[:, None]  # [T, 1]
     valid = pos <= q_pos  # [T, S]
+    if window is not None:
+        valid = valid & (q_pos - pos < window)
     logits = jnp.where(valid[None, None, None], logits, jnp.finfo(jnp.float32).min)
     probs = jax.nn.softmax(logits, axis=-1).astype(v_cache.dtype)
     out = jnp.einsum("bkrts,bskd->btkrd", probs, v_cache)

@@ -80,12 +80,122 @@ class TestPolicyParity:
             intermediate_size=64, max_position_embeddings=64)
         _logits_match(torch, transformers.LlamaForCausalLM(cfg), IDS)
 
+    def test_opt_350m_style(self, torch, transformers):
+        """post-LN blocks + word_embed_proj_dim != hidden (project_in/out,
+        no final LayerNorm) — the opt-350m layout."""
+        cfg = transformers.OPTConfig(
+            vocab_size=128, hidden_size=32, num_hidden_layers=2,
+            num_attention_heads=2, ffn_dim=64, max_position_embeddings=64,
+            do_layer_norm_before=False, word_embed_proj_dim=16)
+        _logits_match(torch, transformers.OPTForCausalLM(cfg), IDS)
+
+    def test_gpt_neo(self, torch, transformers):
+        """alternating global/local attention with window < seq, unscaled
+        QK^T, bias-free qkv."""
+        cfg = transformers.GPTNeoConfig(
+            vocab_size=128, hidden_size=32, num_layers=2, num_heads=2,
+            max_position_embeddings=64, window_size=8,
+            attention_types=[[["global", "local"], 1]])
+        _logits_match(torch, transformers.GPTNeoForCausalLM(cfg), IDS)
+
+    def test_gpt_neo_exact_gelu(self, torch, transformers):
+        """activation_function='gelu' is HF's EXACT erf gelu, not gelu_new."""
+        cfg = transformers.GPTNeoConfig(
+            vocab_size=128, hidden_size=32, num_layers=2, num_heads=2,
+            max_position_embeddings=64, window_size=8,
+            attention_types=[[["global", "local"], 1]],
+            activation_function="gelu")
+        _logits_match(torch, transformers.GPTNeoForCausalLM(cfg), IDS)
+
+    def test_distilbert_mlm(self, torch, transformers):
+        cfg = transformers.DistilBertConfig(
+            vocab_size=128, dim=32, n_layers=2, n_heads=2, hidden_dim=64,
+            max_position_embeddings=64)
+        _logits_match(torch, transformers.DistilBertForMaskedLM(cfg), IDS)
+
+    def test_distilbert_cls(self, torch, transformers):
+        cfg = transformers.DistilBertConfig(
+            vocab_size=128, dim=32, n_layers=2, n_heads=2, hidden_dim=64,
+            max_position_embeddings=64, num_labels=3)
+        _logits_match(torch,
+                      transformers.DistilBertForSequenceClassification(cfg),
+                      IDS)
+
     def test_unknown_arch_raises(self):
         class Mystery:
             pass
 
         with pytest.raises(ValueError, match="no inference policy"):
             convert_hf_model(Mystery())
+
+
+def _megatron_sd_from_gpt2(sd, num_heads, num_layers, v2):
+    """Re-encode a HF GPT-2 state dict in Megatron-LM naming/layouts (the
+    inverse of the converter) so parity can be checked against HF logits."""
+    out = {
+        "language_model.embedding.word_embeddings.weight":
+            sd["transformer.wte.weight"],
+        "language_model.embedding.position_embeddings.weight":
+            sd["transformer.wpe.weight"],
+        "language_model.transformer.final_layernorm.weight":
+            sd["transformer.ln_f.weight"],
+        "language_model.transformer.final_layernorm.bias":
+            sd["transformer.ln_f.bias"],
+    }
+    for i in range(num_layers):
+        pre = f"language_model.transformer.layers.{i}."
+        g = lambda k: sd[f"transformer.h.{i}.{k}"]
+        W, b = g("attn.c_attn.weight"), g("attn.c_attn.bias")   # [d,3d] Conv1D
+        d = W.shape[0]
+        dh = d // num_heads
+        qkv_w = W.T.contiguous()                 # rows (3, H, dh) = "v1"
+        qkv_b = b
+        if v2:                                   # rows (H, 3, dh)
+            qkv_w = qkv_w.reshape(3, num_heads, dh, d).permute(
+                1, 0, 2, 3).reshape(3 * d, d).contiguous()
+            qkv_b = b.reshape(3, num_heads, dh).permute(1, 0, 2).reshape(-1)
+        out.update({
+            pre + "input_layernorm.weight": g("ln_1.weight"),
+            pre + "input_layernorm.bias": g("ln_1.bias"),
+            pre + "attention.query_key_value.weight": qkv_w,
+            pre + "attention.query_key_value.bias": qkv_b,
+            pre + "attention.dense.weight": g("attn.c_proj.weight").T,
+            pre + "attention.dense.bias": g("attn.c_proj.bias"),
+            pre + "post_attention_layernorm.weight": g("ln_2.weight"),
+            pre + "post_attention_layernorm.bias": g("ln_2.bias"),
+            pre + "mlp.dense_h_to_4h.weight": g("mlp.c_fc.weight").T,
+            pre + "mlp.dense_h_to_4h.bias": g("mlp.c_fc.bias"),
+            pre + "mlp.dense_4h_to_h.weight": g("mlp.c_proj.weight").T,
+            pre + "mlp.dense_4h_to_h.bias": g("mlp.c_proj.bias"),
+        })
+    return out
+
+
+class TestMegatronPolicy:
+    @pytest.mark.parametrize("v2", [True, False])
+    def test_megatron_gpt(self, torch, transformers, v2):
+        """Megatron-format checkpoint (both fused-qkv layouts) served through
+        GPT2Model matches the equivalent HF model's logits."""
+        import jax
+        import jax.numpy as jnp
+
+        from deepspeed_tpu.inference.policies import (
+            convert_megatron_gpt_checkpoint)
+
+        cfg = transformers.GPT2Config(
+            vocab_size=128, n_positions=64, n_embd=32, n_layer=2, n_head=2)
+        hf = transformers.GPT2LMHeadModel(cfg)
+        hf.eval()
+        with torch.no_grad():
+            ref = hf(torch.tensor(IDS)).logits.float().numpy()
+        meg_sd = _megatron_sd_from_gpt2(hf.state_dict(), 2, 2, v2)
+        model, params = convert_megatron_gpt_checkpoint(
+            meg_sd, num_heads=2, megatron_v2=v2, compute_dtype=jnp.float32,
+            eps=cfg.layer_norm_epsilon)
+        ours = np.asarray(jax.jit(
+            lambda p, i: model.logits(p, model.forward_hidden(p, i)))(
+            params, jnp.asarray(IDS)))
+        np.testing.assert_allclose(ours, ref, atol=2e-2, rtol=1e-3)
 
 
 class TestDecodeParity:
@@ -119,6 +229,30 @@ class TestDecodeParity:
             vocab_size=128, hidden_size=32, n_layer=2, n_head=2)
         model, params = convert_hf_model(
             transformers.BloomForCausalLM(cfg), compute_dtype=jnp.float32)
+        ids = IDS
+        full = model.logits(params, model.forward_hidden(params, jnp.asarray(ids)))
+        cache = model.init_cache(1, 32, dtype=jnp.float32)
+        lg, cache = model.forward_with_cache(params, jnp.asarray(ids[:, :8]), cache)
+        for t in range(8, 16):
+            lg, cache = model.forward_with_cache(
+                params, jnp.asarray(ids[:, t:t + 1]), cache)
+            np.testing.assert_allclose(np.asarray(lg[0, -1]),
+                                       np.asarray(full[0, t]), atol=2e-3,
+                                       rtol=1e-3)
+
+
+    def test_local_attention_decode_matches_full_forward(self, torch,
+                                                         transformers):
+        """GPT-Neo sliding-window layers: cached decode (window mask against
+        the KV cache) must reproduce full-context logits past the window."""
+        import jax.numpy as jnp
+
+        cfg = transformers.GPTNeoConfig(
+            vocab_size=128, hidden_size=32, num_layers=2, num_heads=2,
+            max_position_embeddings=64, window_size=8,
+            attention_types=[[["global", "local"], 1]])
+        model, params = convert_hf_model(
+            transformers.GPTNeoForCausalLM(cfg), compute_dtype=jnp.float32)
         ids = IDS
         full = model.logits(params, model.forward_hidden(params, jnp.asarray(ids)))
         cache = model.init_cache(1, 32, dtype=jnp.float32)
