@@ -125,17 +125,29 @@ class DeepSpeedEngine:
             optimizer = build_optimizer("adam", {"lr": 1e-3})
         from deepspeed_tpu.ops.onebit import _OnebitBase
 
+        self._onebit_compressed = False
         if isinstance(optimizer, _OnebitBase) and optimizer.with_compression:
-            # the engine's GSPMD step communicates grads exactly (XLA-
-            # scheduled), so compression would never engage — run the exact
-            # math and skip the error-state memory; the true 1-bit path is
-            # the shard_map loop with local grads (ops/onebit.py docstring).
-            # replace, don't mutate: the caller may use the same instance on
-            # the compressed path
-            optimizer = dataclasses.replace(optimizer, with_compression=False)
-            log_dist("1-bit optimizer under the GSPMD engine uses exact "
-                     "communication (no compression, no error-state memory); "
-                     "use the shard_map path for compressed comm", ranks=[0])
+            # true 1-bit comm needs LOCAL (unreduced) grads: the engine runs
+            # the whole step under shard_map over the data axis so the
+            # optimizer's compressed momentum sync REPLACES the grad
+            # allreduce (reference disables backward allreduce for 1-bit
+            # optimizers the same way). Only meaningful on a pure-DP stage-0
+            # layout — other topologies fall back to exact math.
+            pure_dp = (topology.data_parallel_size > 1 and
+                       all(topology.get_dim(a) == 1
+                           for a in ("model", "seq", "pipe", "expert")))
+            if pure_dp and self.zero_stage == 0 and not \
+                    self.offload_optimizer:
+                self._onebit_compressed = True
+            else:
+                # replace, don't mutate: the caller may use the same
+                # instance on the compressed path
+                optimizer = dataclasses.replace(optimizer,
+                                                with_compression=False)
+                log_dist(
+                    "1-bit optimizer: compressed comm needs pure-DP ZeRO-0 "
+                    "without offload — falling back to exact communication "
+                    "(no compression, no error-state memory)", ranks=[0])
         self.optimizer = optimizer
 
         # ---- host (ZeRO-Offload/Infinity) optimizer: fp32 master + moments in
@@ -184,6 +196,13 @@ class DeepSpeedEngine:
                 log_dist("progressive_layer_drop: model.apply does not "
                          "accept pld_theta — schedule tracked but layers "
                          "are NOT dropped", ranks=[0])
+
+        # XLA:CPU's collective rendezvous keys executions by (run_id, op_id)
+        # only; on a starved host a straggler async step can join the NEXT
+        # step's rendezvous and deadlock both.  The CPU (test) backend
+        # therefore synchronizes every step; TPU keeps async dispatch.
+        self._sync_each_step = (self.accelerator.name() == "cpu" and
+                                os.environ.get("DSTPU_SYNC_EACH_STEP") != "0")
 
         # ---- counters (reference engine attrs)
         self.micro_steps = 0
@@ -241,7 +260,17 @@ class DeepSpeedEngine:
         mem_kind = "pinned_host" if (self.offload_optimizer and
                                      self.accelerator.name() == "tpu") else None
         self.master_shardings = self.plan.shardings(self.master_specs)
-        if self._host_opt is None:
+        if self._onebit_compressed:
+            # error-feedback tensors are PER-DEVICE state: leading [dp] dim
+            # sharded over the data axis (never replicated)
+            opt_state_shape = jax.eval_shape(self._onebit_opt_init, params_shape)
+            specs = self._specs_like(opt_state_shape)
+            err = lambda t: jax.tree_util.tree_map(lambda _: P("data"), t)
+            self.opt_specs = specs._replace(
+                worker_error=err(opt_state_shape.worker_error),
+                server_error=err(opt_state_shape.server_error))
+            self.opt_shardings = self.plan.shardings(self.opt_specs)
+        elif self._host_opt is None:
             opt_state_shape = jax.eval_shape(self.optimizer.init, params_shape)
             self.opt_specs = self._specs_like(opt_state_shape)
             self.opt_shardings = self.plan.shardings(self.opt_specs, memory_kind=mem_kind)
@@ -298,9 +327,22 @@ class DeepSpeedEngine:
             return TrainState(params=cast(params), opt_state={},
                               scaler=scaler_state,
                               global_step=jnp.zeros((), jnp.int32))
-        opt_state = jax.jit(self.optimizer.init, out_shardings=self.opt_shardings)(params)
+        opt_init = self._onebit_opt_init if self._onebit_compressed \
+            else self.optimizer.init
+        opt_state = jax.jit(opt_init, out_shardings=self.opt_shardings)(params)
         return TrainState(params=params, opt_state=opt_state, scaler=scaler_state,
                           global_step=jnp.zeros((), jnp.int32))
+
+    def _onebit_opt_init(self, params):
+        """Optimizer state for the compressed 1-bit path: worker/server
+        error carriers get a leading [dp] device dim (per-device distinct,
+        sharded over the data axis)."""
+        base = self.optimizer.init(params)
+        dp = self.topology.data_parallel_size
+        stack = lambda t: jax.tree_util.tree_map(
+            lambda a: jnp.zeros((dp,) + a.shape, a.dtype), t)
+        return base._replace(worker_error=stack(base.worker_error),
+                             server_error=stack(base.server_error))
 
     # ---------------------------------------------------------- micro helpers
     def _cast_for_compute(self, params):
@@ -312,23 +354,33 @@ class DeepSpeedEngine:
 
         return jax.tree_util.tree_map(cast, params, specs)
 
-    def _micro_loss_and_grads(self, params, batch, scale, rng, pld_theta=None):
+    def _micro_loss_and_grads(self, params, batch, scale, rng, pld_theta=None,
+                              constrain=True):
         """Single microbatch loss+grads in compute dtype; grads carry the
-        stage-dependent sharding constraint (→ reduce-scatter from stage 2)."""
+        stage-dependent sharding constraint (→ reduce-scatter from stage 2).
+        ``constrain=False`` drops the NamedSharding constraints for callers
+        already inside a shard_map manual context (the 1-bit path)."""
         kwargs = {"pld_theta": pld_theta} if pld_theta is not None else {}
 
         def loss_fn(master_params):
-            cparams = self._cast_for_compute(master_params)
+            cparams = self._cast_for_compute(master_params) if constrain else \
+                jax.tree_util.tree_map(
+                    lambda x: x.astype(self.compute_dtype)
+                    if x.dtype == jnp.float32 else x, master_params)
             loss, metrics = self.module.apply(cparams, batch,
                                               rngs={"dropout": rng},
                                               train=True, **kwargs)
             return loss * scale, metrics
 
         (scaled_loss, metrics), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
-        grads = jax.tree_util.tree_map(
-            lambda g, s: jax.lax.with_sharding_constraint(
-                g.astype(jnp.float32), NamedSharding(self.mesh, s)),
-            grads, self.grad_specs)
+        if constrain:
+            grads = jax.tree_util.tree_map(
+                lambda g, s: jax.lax.with_sharding_constraint(
+                    g.astype(jnp.float32), NamedSharding(self.mesh, s)),
+                grads, self.grad_specs)
+        else:
+            grads = jax.tree_util.tree_map(
+                lambda g: g.astype(jnp.float32), grads)
         return scaled_loss, grads, metrics
 
     def _apply_grads(self, state: TrainState, grads, lr):
@@ -355,24 +407,32 @@ class DeepSpeedEngine:
         return new_state, overflow, norm
 
     # ---------------------------------------------------- shared step pieces
-    def _scan_micro_grads(self, state: TrainState, batch, rng, pld_theta=None):
+    def _scan_micro_grads(self, state: TrainState, batch, rng, pld_theta=None,
+                          constrain=True, rng_fold=None):
         """Grad-accumulation scan over the gas microbatches (shared by the
-        fused device step and the host-offload grad step)."""
+        fused device step, the host-offload grad step and the 1-bit
+        shard_map step). ``rng_fold(rng, i)`` customizes the per-microbatch
+        rng derivation (the 1-bit path folds in the device index)."""
         scale = state.scaler.cur_scale
+        rng_fold = rng_fold or jax.random.fold_in
 
         def micro(carry, mb_and_i):
             grads_acc, loss_acc = carry
             mb, i = mb_and_i
-            sub = jax.random.fold_in(rng, i)
+            sub = rng_fold(rng, i)
             _, grads, metrics = self._micro_loss_and_grads(
-                state.params, mb, scale, sub, pld_theta)
+                state.params, mb, scale, sub, pld_theta, constrain=constrain)
             grads_acc = jax.tree_util.tree_map(jnp.add, grads_acc, grads)
             return (grads_acc, loss_acc + metrics["loss"]), None
 
-        grads0 = jax.tree_util.tree_map(
-            lambda p, s: jax.lax.with_sharding_constraint(
-                jnp.zeros(p.shape, jnp.float32), NamedSharding(self.mesh, s)),
-            state.params, self.grad_specs)
+        if constrain:
+            grads0 = jax.tree_util.tree_map(
+                lambda p, s: jax.lax.with_sharding_constraint(
+                    jnp.zeros(p.shape, jnp.float32), NamedSharding(self.mesh, s)),
+                state.params, self.grad_specs)
+        else:
+            grads0 = jax.tree_util.tree_map(
+                lambda p: jnp.zeros(p.shape, jnp.float32), state.params)
         (grads, loss_sum), _ = jax.lax.scan(
             micro, (grads0, jnp.zeros((), jnp.float32)),
             (batch, jnp.arange(self.gas)))
@@ -429,7 +489,9 @@ class DeepSpeedEngine:
             global_step=self.state.global_step + 1)
 
     # -------------------------------------------------------- fused train step
-    def _build_train_step(self):
+    def _build_train_step(self, batch=None):
+        if self._onebit_compressed:
+            return self._build_onebit_train_step(batch)
         gas = self.gas
 
         def train_step(state: TrainState, batch, lr, rng, pld_theta=None):
@@ -443,6 +505,97 @@ class DeepSpeedEngine:
 
         batch_sharding_fn = self._gas_batch_shardings
         self._compiled_train_step = jax.jit(train_step, donate_argnums=(0,))
+        return self._compiled_train_step
+
+    def _build_onebit_train_step(self, batch):
+        """Compressed-comm train step (reference: engine disables backward
+        allreduce for 1-bit optimizers and lets compressed_allreduce carry
+        the sync — runtime/comm/nccl.py:54). shard_map over the data axis
+        keeps grads LOCAL; the optimizer's error-compensated momentum sync
+        is the only cross-device traffic (int8 signs over ICI)."""
+        from jax import shard_map
+
+        if self._use_pld:
+            log_dist("progressive_layer_drop is not supported on the 1-bit "
+                     "compressed path; disabling", ranks=[0])
+            self._use_pld = False
+        if self.config.gradient_clipping and self.config.gradient_clipping > 0:
+            # the global grad norm is undefined when grads never leave the
+            # device (only the momentum is synced) — same limitation as the
+            # reference's 1-bit optimizers; grad_norm stays a diagnostic
+            # (norm of the concatenated local grads)
+            log_dist("gradient_clipping is not supported with compressed "
+                     "1-bit communication; ignoring (reference 1-bit Adam "
+                     "has the same limitation)", ranks=[0])
+
+        mesh, gas, opt = self.mesh, self.gas, self.optimizer
+        fp16 = self.fp16_enabled
+        loss_scaler = self.loss_scaler
+
+        rep = lambda t: jax.tree_util.tree_map(lambda _: P(), t)
+        err_specs = jax.tree_util.tree_map(
+            lambda _: P("data"), self.state.opt_state.worker_error)
+        state_specs = TrainState(
+            params=rep(self.state.params),
+            opt_state=rep(self.state.opt_state)._replace(
+                worker_error=err_specs, server_error=err_specs),
+            scaler=rep(self.state.scaler),
+            global_step=P())
+        batch_specs = jax.tree_util.tree_map(
+            lambda x: P(None, *self.plan.batch_spec(x.ndim - 1)), batch)
+        metric_specs = {"loss": P(), "overflow": P(), "grad_norm": P(),
+                        "loss_scale": P()}
+
+        def step(state: TrainState, batch, lr, rng):
+            params = state.params
+            drop0 = lambda t: jax.tree_util.tree_map(lambda a: a[0], t)
+            add0 = lambda t: jax.tree_util.tree_map(lambda a: a[None], t)
+            my = jax.lax.axis_index("data")
+
+            grads, loss_sum = self._scan_micro_grads(
+                state, batch, rng, constrain=False,
+                rng_fold=lambda r, i: jax.random.fold_in(
+                    jax.random.fold_in(r, i), my))
+            grads, overflow, _ = self._unscale_epilogue(grads, state.scaler)
+            if fp16:
+                overflow = jax.lax.psum(
+                    overflow.astype(jnp.int32), "data") > 0
+            # diagnostic only — NOT used for clipping (see builder note):
+            # norm of the concatenated per-device local grads
+            # (fp16/fused_optimizer get_grad_norm over local groups)
+            sumsq = sum(jnp.sum(g.astype(jnp.float32) ** 2)
+                        for g in jax.tree_util.tree_leaves(grads))
+            norm = jnp.sqrt(jax.lax.psum(sumsq, "data"))
+            inner = state.opt_state._replace(
+                worker_error=drop0(state.opt_state.worker_error),
+                server_error=drop0(state.opt_state.server_error))
+            new_p, new_opt = opt.step(params, grads, inner, lr,
+                                      axis_name="data")
+            skip = lambda old, new: jax.tree_util.tree_map(
+                lambda o, n: jnp.where(overflow, o, n), old, new)
+            new_p = skip(params, new_p)
+            new_opt = skip(inner, new_opt)
+            new_state = TrainState(
+                params=new_p,
+                opt_state=new_opt._replace(
+                    worker_error=add0(new_opt.worker_error),
+                    server_error=add0(new_opt.server_error)),
+                scaler=loss_scaler.update(state.scaler, overflow),
+                global_step=state.global_step + 1 - overflow.astype(jnp.int32))
+            metrics = {"loss": jax.lax.pmean(loss_sum / gas, "data"),
+                       "overflow": overflow, "grad_norm": norm,
+                       "loss_scale": state.scaler.cur_scale}
+            return new_state, metrics
+
+        sharded = shard_map(
+            step, mesh=mesh,
+            in_specs=(state_specs, batch_specs, P(), P()),
+            out_specs=(state_specs, metric_specs),
+            # params/moments stay consensus by construction (compressed sync
+            # ends in an allgather reconstruction identical on every device)
+            # — vma typing cannot prove that statically
+            check_vma=False)
+        self._compiled_train_step = jax.jit(sharded, donate_argnums=(0,))
         return self._compiled_train_step
 
     def _gas_batch_shardings(self, batch):
@@ -479,7 +632,7 @@ class DeepSpeedEngine:
         if self._host_opt is not None:
             return self._run_host_step(batch)
         if self._compiled_train_step is None:
-            self._build_train_step()
+            self._build_train_step(batch)
         self.tput_timer.start()
         self.timers(TRAIN_BATCH_TIMER).start()
         lr = jnp.asarray(self.get_lr()[0], jnp.float32)
@@ -501,6 +654,8 @@ class DeepSpeedEngine:
         self._after_step(metrics)
         self.timers(TRAIN_BATCH_TIMER).stop(record=True)
         self.tput_timer.stop(global_step=True)
+        if self._sync_each_step:
+            jax.block_until_ready(self.state.params)
         return metrics["loss"]
 
     def _run_host_step(self, batch):
@@ -523,6 +678,8 @@ class DeepSpeedEngine:
         self._after_step(metrics)
         self.timers(TRAIN_BATCH_TIMER).stop(record=True)
         self.tput_timer.stop(global_step=True)
+        if self._sync_each_step:
+            jax.block_until_ready(self.state.params)
         return metrics["loss"]
 
     def _after_step(self, metrics):

@@ -26,12 +26,20 @@ os.environ["DSTPU_ACCELERATOR"] = "cpu"
 
 import jax  # noqa: E402
 
-# persistent compile cache: cuts repeat-compile time (the main source of
-# single-core contention) across tests and across suite runs
-jax.config.update("jax_compilation_cache_dir",
-                  os.environ.get("DSTPU_TEST_CACHE", "/tmp/dstpu_jax_cache"))
-jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
-jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+# NO persistent compile cache: deserializing a cached XLA:CPU executable
+# that contains SUBGROUP collectives (e.g. data-axis allreduce on a tp>1
+# mesh) deterministically deadlocks the collective rendezvous — device
+# threads end up parked across different collectives of the same run while
+# fresh compiles of the identical program run fine (reproduced:
+# tests/unit/model_parallelism hangs on a cache HIT, passes after
+# `rm -rf` of the cache dir; full-mesh-only programs are unaffected).
+# Until the upstream runtime rebuilds collective state on deserialization,
+# repeat-compile time is the price of a deadlock-free suite.
+if os.environ.get("DSTPU_TEST_CACHE"):       # opt-in escape hatch
+    jax.config.update("jax_compilation_cache_dir",
+                      os.environ["DSTPU_TEST_CACHE"])
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
 
 # The axon sitecustomize pins JAX_PLATFORMS=axon (one real TPU chip); tests
 # run on the virtual 8-device CPU backend instead.
