@@ -1,0 +1,332 @@
+"""Diffusion serving pipeline — the DeepSpeed-Diffusers analog.
+
+Reference: ``deepspeed.init_inference`` on a diffusers pipeline routes
+UNet/VAE/CLIP through ``module_inject/replace_module.py:184
+generic_injection`` into CUDA-graphed channels-last wrappers
+(``model_implementations/diffusers/{unet,vae}.py``, ``csrc/spatial`` ops).
+
+TPU shape of the same capability:
+  * ``convert_diffusers_unet/vae`` map a diffusers-format torch state dict
+    (SD-1.x lineage) onto the NHWC JAX models in ``models/diffusion.py``
+    (conv kernels OIHW→HWIO, linears [out,in]→[in,out]).
+  * ``StableDiffusionEngine`` compiles ONE classifier-free-guidance
+    denoise step (jit = the CUDA-graph analog) and drives the DDIM loop
+    with a ``lax.scan`` — the whole sampler is a single XLA program.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deepspeed_tpu.models.diffusion import (
+    AutoencoderKL,
+    UNet2DConditionModel,
+)
+
+
+def _np(t) -> np.ndarray:
+    return t.detach().cpu().float().numpy()
+
+
+def _conv(sd, name):
+    """OIHW torch conv kernel → HWIO."""
+    return np.transpose(_np(sd[name]), (2, 3, 1, 0))
+
+
+def _lin_t(sd, name):
+    return _np(sd[name]).T
+
+
+# ------------------------------------------------------------- converters
+def _convert_resnet(sd, p):
+    out = {
+        "norm1_scale": _np(sd[p + "norm1.weight"]),
+        "norm1_bias": _np(sd[p + "norm1.bias"]),
+        "conv1_w": _conv(sd, p + "conv1.weight"),
+        "conv1_b": _np(sd[p + "conv1.bias"]),
+        "norm2_scale": _np(sd[p + "norm2.weight"]),
+        "norm2_bias": _np(sd[p + "norm2.bias"]),
+        "conv2_w": _conv(sd, p + "conv2.weight"),
+        "conv2_b": _np(sd[p + "conv2.bias"]),
+    }
+    if p + "time_emb_proj.weight" in sd:
+        out["time_emb_w"] = _lin_t(sd, p + "time_emb_proj.weight")
+        out["time_emb_b"] = _np(sd[p + "time_emb_proj.bias"])
+    if p + "conv_shortcut.weight" in sd:
+        out["shortcut_w"] = _conv(sd, p + "conv_shortcut.weight")
+        out["shortcut_b"] = _np(sd[p + "conv_shortcut.bias"])
+    return out
+
+
+def _convert_tblock(sd, p):
+    ln = lambda n: {"scale": _np(sd[p + n + ".weight"]),
+                    "bias": _np(sd[p + n + ".bias"])}
+    lin = lambda n: {"w": _lin_t(sd, p + n + ".weight"),
+                     "b": _np(sd[p + n + ".bias"])}
+    return {
+        "norm1": ln("norm1"), "norm2": ln("norm2"), "norm3": ln("norm3"),
+        "attn1_q": _lin_t(sd, p + "attn1.to_q.weight"),
+        "attn1_k": _lin_t(sd, p + "attn1.to_k.weight"),
+        "attn1_v": _lin_t(sd, p + "attn1.to_v.weight"),
+        "attn1_out": lin("attn1.to_out.0"),
+        "attn2_q": _lin_t(sd, p + "attn2.to_q.weight"),
+        "attn2_k": _lin_t(sd, p + "attn2.to_k.weight"),
+        "attn2_v": _lin_t(sd, p + "attn2.to_v.weight"),
+        "attn2_out": lin("attn2.to_out.0"),
+        "ff_in": {"w": _lin_t(sd, p + "ff.net.0.proj.weight"),
+                  "b": _np(sd[p + "ff.net.0.proj.bias"])},
+        "ff_out": {"w": _lin_t(sd, p + "ff.net.2.weight"),
+                   "b": _np(sd[p + "ff.net.2.bias"])},
+    }
+
+
+def _convert_attn2d(sd, p, depth):
+    return {
+        "norm_scale": _np(sd[p + "norm.weight"]),
+        "norm_bias": _np(sd[p + "norm.bias"]),
+        "proj_in_w": _conv(sd, p + "proj_in.weight"),
+        "proj_in_b": _np(sd[p + "proj_in.bias"]),
+        "blocks": [_convert_tblock(sd, f"{p}transformer_blocks.{k}.")
+                   for k in range(depth)],
+        "proj_out_w": _conv(sd, p + "proj_out.weight"),
+        "proj_out_b": _np(sd[p + "proj_out.bias"]),
+    }
+
+
+def convert_diffusers_unet(sd, config) -> Dict[str, Any]:
+    """diffusers UNet2DConditionModel state dict → UNet2DConditionModel
+    params (models/diffusion.py). SD-1.x layout: conv proj_in/out."""
+    c = config
+    params: Dict[str, Any] = {
+        "time_mlp1": {"w": _lin_t(sd, "time_embedding.linear_1.weight"),
+                      "b": _np(sd["time_embedding.linear_1.bias"])},
+        "time_mlp2": {"w": _lin_t(sd, "time_embedding.linear_2.weight"),
+                      "b": _np(sd["time_embedding.linear_2.bias"])},
+        "conv_in_w": _conv(sd, "conv_in.weight"),
+        "conv_in_b": _np(sd["conv_in.bias"]),
+        "norm_out_scale": _np(sd["conv_norm_out.weight"]),
+        "norm_out_bias": _np(sd["conv_norm_out.bias"]),
+        "conv_out_w": _conv(sd, "conv_out.weight"),
+        "conv_out_b": _np(sd["conv_out.bias"]),
+    }
+    down = []
+    for i, btype in enumerate(c.down_block_types):
+        pre = f"down_blocks.{i}."
+        blk = {"resnets": [], "attns": []}
+        for j in range(c.layers_per_block):
+            blk["resnets"].append(_convert_resnet(sd, f"{pre}resnets.{j}."))
+            if btype == "CrossAttnDownBlock2D":
+                blk["attns"].append(_convert_attn2d(
+                    sd, f"{pre}attentions.{j}.", c.transformer_depth))
+        if f"{pre}downsamplers.0.conv.weight" in sd:
+            blk["down_w"] = _conv(sd, f"{pre}downsamplers.0.conv.weight")
+            blk["down_b"] = _np(sd[f"{pre}downsamplers.0.conv.bias"])
+        down.append(blk)
+    params["down"] = down
+    params["mid"] = {
+        "resnet1": _convert_resnet(sd, "mid_block.resnets.0."),
+        "attn": _convert_attn2d(sd, "mid_block.attentions.0.",
+                                c.transformer_depth),
+        "resnet2": _convert_resnet(sd, "mid_block.resnets.1."),
+    }
+    up = []
+    for i, btype in enumerate(c.up_block_types):
+        pre = f"up_blocks.{i}."
+        blk = {"resnets": [], "attns": []}
+        for j in range(c.layers_per_block + 1):
+            blk["resnets"].append(_convert_resnet(sd, f"{pre}resnets.{j}."))
+            if btype == "CrossAttnUpBlock2D":
+                blk["attns"].append(_convert_attn2d(
+                    sd, f"{pre}attentions.{j}.", c.transformer_depth))
+        if f"{pre}upsamplers.0.conv.weight" in sd:
+            blk["up_w"] = _conv(sd, f"{pre}upsamplers.0.conv.weight")
+            blk["up_b"] = _np(sd[f"{pre}upsamplers.0.conv.bias"])
+        up.append(blk)
+    params["up"] = up
+    return jax.tree_util.tree_map(jnp.asarray, params)
+
+
+def _convert_vae_attn(sd, p):
+    # diffusers ≥0.15 names (to_q/...); legacy AttentionBlock (query/...)
+    new = p + "to_q.weight" in sd
+    n = lambda a, b: a if new else b
+    lin = lambda nm: {"w": _lin_t(sd, p + nm + ".weight"),
+                      "b": _np(sd[p + nm + ".bias"])}
+    return {
+        "norm_scale": _np(sd[p + "group_norm.weight"]),
+        "norm_bias": _np(sd[p + "group_norm.bias"]),
+        "q": lin(n("to_q", "query")), "k": lin(n("to_k", "key")),
+        "v": lin(n("to_v", "value")),
+        "out": lin(n("to_out.0", "proj_attn")),
+    }
+
+
+def convert_diffusers_vae(sd, config) -> Dict[str, Any]:
+    """diffusers AutoencoderKL state dict → AutoencoderKL params."""
+    c = config
+    n_blocks = len(c.block_out_channels)
+    enc: Dict[str, Any] = {
+        "conv_in_w": _conv(sd, "encoder.conv_in.weight"),
+        "conv_in_b": _np(sd["encoder.conv_in.bias"]),
+        "down": [],
+        "norm_out_scale": _np(sd["encoder.conv_norm_out.weight"]),
+        "norm_out_bias": _np(sd["encoder.conv_norm_out.bias"]),
+        "conv_out_w": _conv(sd, "encoder.conv_out.weight"),
+        "conv_out_b": _np(sd["encoder.conv_out.bias"]),
+    }
+    for i in range(n_blocks):
+        pre = f"encoder.down_blocks.{i}."
+        blk = {"resnets": [_convert_resnet(sd, f"{pre}resnets.{j}.")
+                           for j in range(c.layers_per_block)]}
+        if f"{pre}downsamplers.0.conv.weight" in sd:
+            blk["down_w"] = _conv(sd, f"{pre}downsamplers.0.conv.weight")
+            blk["down_b"] = _np(sd[f"{pre}downsamplers.0.conv.bias"])
+        enc["down"].append(blk)
+    enc["mid"] = {
+        "resnet1": _convert_resnet(sd, "encoder.mid_block.resnets.0."),
+        "attn": _convert_vae_attn(sd, "encoder.mid_block.attentions.0."),
+        "resnet2": _convert_resnet(sd, "encoder.mid_block.resnets.1."),
+    }
+    dec: Dict[str, Any] = {
+        "conv_in_w": _conv(sd, "decoder.conv_in.weight"),
+        "conv_in_b": _np(sd["decoder.conv_in.bias"]),
+        "mid": {
+            "resnet1": _convert_resnet(sd, "decoder.mid_block.resnets.0."),
+            "attn": _convert_vae_attn(sd, "decoder.mid_block.attentions.0."),
+            "resnet2": _convert_resnet(sd, "decoder.mid_block.resnets.1."),
+        },
+        "up": [],
+        "norm_out_scale": _np(sd["decoder.conv_norm_out.weight"]),
+        "norm_out_bias": _np(sd["decoder.conv_norm_out.bias"]),
+        "conv_out_w": _conv(sd, "decoder.conv_out.weight"),
+        "conv_out_b": _np(sd["decoder.conv_out.bias"]),
+    }
+    for i in range(n_blocks):
+        pre = f"decoder.up_blocks.{i}."
+        blk = {"resnets": [_convert_resnet(sd, f"{pre}resnets.{j}.")
+                           for j in range(c.layers_per_block + 1)]}
+        if f"{pre}upsamplers.0.conv.weight" in sd:
+            blk["up_w"] = _conv(sd, f"{pre}upsamplers.0.conv.weight")
+            blk["up_b"] = _np(sd[f"{pre}upsamplers.0.conv.bias"])
+        dec["up"].append(blk)
+    params = {
+        "encoder": enc, "decoder": dec,
+        "quant_w": _conv(sd, "quant_conv.weight"),
+        "quant_b": _np(sd["quant_conv.bias"]),
+        "post_quant_w": _conv(sd, "post_quant_conv.weight"),
+        "post_quant_b": _np(sd["post_quant_conv.bias"]),
+    }
+    return jax.tree_util.tree_map(jnp.asarray, params)
+
+
+# --------------------------------------------------------------- scheduler
+@dataclasses.dataclass
+class DDIMScheduler:
+    """Deterministic DDIM (eta=0) with the SD scheduler config: the
+    'scaled_linear' beta schedule, 'leading' timestep spacing with
+    steps_offset=1, and set_alpha_to_one=False (final previous-alpha is
+    alphas_cumprod[0]) — matching diffusers' StableDiffusionPipeline
+    trajectory for the same seed."""
+
+    num_train_timesteps: int = 1000
+    beta_start: float = 0.00085
+    beta_end: float = 0.012
+    steps_offset: int = 1
+    set_alpha_to_one: bool = False
+
+    def __post_init__(self):
+        betas = np.linspace(self.beta_start ** 0.5, self.beta_end ** 0.5,
+                            self.num_train_timesteps,
+                            dtype=np.float64) ** 2
+        self.alphas_cumprod = jnp.asarray(
+            np.cumprod(1.0 - betas), jnp.float32)
+        self.final_alpha_cumprod = jnp.asarray(
+            1.0 if self.set_alpha_to_one else float(self.alphas_cumprod[0]),
+            jnp.float32)
+
+    def timesteps(self, num_inference_steps: int) -> jnp.ndarray:
+        step = self.num_train_timesteps // num_inference_steps
+        ts = jnp.arange(0, num_inference_steps, dtype=jnp.int32)[::-1] * step
+        return jnp.minimum(ts + self.steps_offset,
+                           self.num_train_timesteps - 1)
+
+    def step(self, eps, t, t_prev, sample):
+        acp = self.alphas_cumprod[t]
+        acp_prev = jnp.where(t_prev >= 0, self.alphas_cumprod[t_prev],
+                             self.final_alpha_cumprod)
+        x0 = (sample - jnp.sqrt(1.0 - acp) * eps) / jnp.sqrt(acp)
+        return jnp.sqrt(acp_prev) * x0 + jnp.sqrt(1.0 - acp_prev) * eps
+
+
+# ----------------------------------------------------------------- engine
+class StableDiffusionEngine:
+    """Text→image serving engine (DeepSpeed-Diffusers ``init_inference``
+    analog). The denoise scan (CFG: one batched uncond+cond UNet call per
+    step) and the VAE decode compile once."""
+
+    def __init__(self, unet: UNet2DConditionModel, unet_params,
+                 vae: AutoencoderKL, vae_params,
+                 text_encoder=None, text_params=None,
+                 scheduler: Optional[DDIMScheduler] = None):
+        self.unet = unet
+        self.unet_params = unet_params
+        self.vae = vae
+        self.vae_params = vae_params
+        self.text_encoder = text_encoder
+        self.text_params = text_params
+        self.scheduler = scheduler or DDIMScheduler()
+        self._samplers: Dict[int, Any] = {}   # compiled, keyed by num_steps
+
+    def encode_prompt(self, input_ids):
+        assert self.text_encoder is not None, "no text encoder configured"
+        return self.text_encoder.forward_hidden(
+            self.text_params, jnp.asarray(input_ids))
+
+    def _build(self, num_steps: int):
+        sched = self.scheduler
+        ts = sched.timesteps(num_steps)
+        ts_prev = jnp.concatenate([ts[1:], jnp.array([-1], jnp.int32)])
+
+        def sample_fn(unet_params, vae_params, latents, ctx, uncond_ctx,
+                      guidance):
+            both_ctx = jnp.concatenate([uncond_ctx, ctx], axis=0)
+
+            def denoise(lat, t_pair):
+                t, t_prev = t_pair
+                b = lat.shape[0]
+                both = jnp.concatenate([lat, lat], axis=0)
+                tt = jnp.full((2 * b,), t, jnp.int32)
+                eps = self.unet(unet_params, both, tt, both_ctx)
+                eps_u, eps_c = jnp.split(eps, 2, axis=0)
+                eps = eps_u + guidance * (eps_c - eps_u)
+                return sched.step(eps, t, t_prev, lat), None
+
+            latents, _ = jax.lax.scan(denoise, latents, (ts, ts_prev))
+            images = self.vae.decode(
+                vae_params, latents / self.vae.config.scaling_factor)
+            return jnp.clip(images / 2 + 0.5, 0.0, 1.0)
+
+        self._samplers[num_steps] = jax.jit(sample_fn)
+        return self._samplers[num_steps]
+
+    def generate(self, prompt_ids, uncond_ids, *, num_steps: int = 50,
+                 guidance_scale: float = 7.5, height: int = 512,
+                 width: int = 512, rng=None):
+        """[B, T] token ids (cond + uncond) → [B, H, W, 3] images in
+        [0, 1]."""
+        ctx = self.encode_prompt(prompt_ids)
+        uncond = self.encode_prompt(uncond_ids)
+        rng = rng if rng is not None else jax.random.PRNGKey(0)
+        lat_c = self.unet.config.in_channels
+        # VAE spatial factor = one 2x per non-final block (8x for SD)
+        f = 2 ** (len(self.vae.config.block_out_channels) - 1)
+        latents = jax.random.normal(
+            rng, (ctx.shape[0], height // f, width // f, lat_c), jnp.float32)
+        sample = self._samplers.get(num_steps) or self._build(num_steps)
+        return sample(self.unet_params, self.vae_params, latents, ctx,
+                      uncond, jnp.asarray(guidance_scale, jnp.float32))

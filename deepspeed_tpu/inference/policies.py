@@ -673,6 +673,73 @@ def distilbert_cls_policy(hf_model, dtype):
     return model, params
 
 
+def _clip_text_common(hf_model, dtype, sd_prefix=""):
+    """HF CLIPTextModel(-WithProjection) → models/clip.CLIPTextModel
+    (reference module_inject/containers/clip.py HFCLIPLayerPolicy)."""
+    import jax.numpy as jnp
+
+    from deepspeed_tpu.models.clip import CLIPTextConfig, CLIPTextModel
+
+    hc = hf_model.config
+    sd = hf_model.state_dict()
+    proj_key = sd_prefix + "text_projection.weight"
+    cfg = CLIPTextConfig(
+        vocab_size=hc.vocab_size, max_seq_len=hc.max_position_embeddings,
+        num_layers=hc.num_hidden_layers, hidden_size=hc.hidden_size,
+        num_heads=hc.num_attention_heads, mlp_dim=hc.intermediate_size,
+        eps=hc.layer_norm_eps, hidden_act=hc.hidden_act,
+        projection_dim=hc.projection_dim if proj_key in sd else 0)
+    model = CLIPTextModel(cfg, compute_dtype=dtype)
+    p = sd_prefix + "text_model."
+    L = cfg.num_layers
+
+    def qkv(i):
+        return np.concatenate(
+            [_lin(_np(sd[f"{p}encoder.layers.{i}.self_attn.{x}_proj.weight"]))
+             for x in ("q", "k", "v")], axis=1)
+
+    def qkv_b(i):
+        return np.concatenate(
+            [_np(sd[f"{p}encoder.layers.{i}.self_attn.{x}_proj.bias"])
+             for x in ("q", "k", "v")])
+
+    blocks = _dense_blocks(sd, L, {
+        "ln1_scale": p + "encoder.layers.{i}.layer_norm1.weight",
+        "ln1_bias": p + "encoder.layers.{i}.layer_norm1.bias",
+        "attn_out_w": p + "encoder.layers.{i}.self_attn.out_proj.weight",
+        "attn_out_b": p + "encoder.layers.{i}.self_attn.out_proj.bias",
+        "ln2_scale": p + "encoder.layers.{i}.layer_norm2.weight",
+        "ln2_bias": p + "encoder.layers.{i}.layer_norm2.bias",
+        "mlp_fc_w": p + "encoder.layers.{i}.mlp.fc1.weight",
+        "mlp_fc_b": p + "encoder.layers.{i}.mlp.fc1.bias",
+        "mlp_out_w": p + "encoder.layers.{i}.mlp.fc2.weight",
+        "mlp_out_b": p + "encoder.layers.{i}.mlp.fc2.bias",
+    }, post_map={"attn_out_w": _lin, "mlp_fc_w": _lin, "mlp_out_w": _lin})
+    blocks["qkv_w"] = jnp.asarray(np.stack([qkv(i) for i in range(L)]))
+    blocks["qkv_b"] = jnp.asarray(np.stack([qkv_b(i) for i in range(L)]))
+    params = {
+        "wte": jnp.asarray(_np(sd[p + "embeddings.token_embedding.weight"])),
+        "wpe": jnp.asarray(
+            _np(sd[p + "embeddings.position_embedding.weight"])),
+        "blocks": blocks,
+        "ln_f_scale": jnp.asarray(_np(sd[p + "final_layer_norm.weight"])),
+        "ln_f_bias": jnp.asarray(_np(sd[p + "final_layer_norm.bias"])),
+    }
+    if cfg.projection_dim:
+        params["text_projection"] = jnp.asarray(_lin(_np(sd[proj_key])))
+    return model, params
+
+
+@register_policy("CLIPTextModel")
+def clip_text_policy(hf_model, dtype):
+    return _clip_text_common(hf_model, dtype)
+
+
+@register_policy("CLIPTextModelWithProjection")
+def clip_text_proj_policy(hf_model, dtype):
+    return _clip_text_common(hf_model, dtype)
+
+
 def convert_megatron_gpt_checkpoint(sd, *, num_heads, megatron_v2=True,
                                     compute_dtype=None, eps=1e-5):
     """Megatron-LM GPT state dict → (GPT2Model, params).

@@ -1,0 +1,536 @@
+"""Diffusion model family (UNet2DCondition + AutoencoderKL), TPU-first.
+
+Reference analog: the DeepSpeed-Diffusers serving pillar — ``csrc/spatial``
+(fused bias-add / NHWC channels-last kernels for diffusion),
+``module_inject/containers/{unet,vae}.py`` and
+``model_implementations/diffusers/{unet,vae}.py`` (module wrappers whose
+main job is CUDA-graph capture + channels-last).  On TPU:
+
+  * NHWC is the native convolution layout (the reference's
+    ``spatial_inference`` ops exist to coerce torch into channels-last;
+    here every tensor is born [B, H, W, C] and conv kernels are HWIO).
+  * bias+silu+groupnorm fusion is XLA's job; there is nothing to
+    hand-fuse.
+  * the CUDA-graph machinery maps to jit: the denoise step is one compiled
+    program (see inference/diffusion.py).
+
+Layouts follow diffusers' ``UNet2DConditionModel`` / ``AutoencoderKL``
+(SD-1.x lineage: conv proj_in/out in attention blocks, GEGLU feed-forward,
+bias-free q/k/v cross-attention) so checkpoints map 1:1 — see
+``inference/diffusion.py convert_diffusers_unet/vae`` for the name map.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Any, Dict, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deepspeed_tpu.models.base import layer_norm
+from deepspeed_tpu.ops.attention import multihead_attention
+
+# --------------------------------------------------------------- primitives
+
+
+def conv2d(x, w, b=None, *, stride=1, padding=1):
+    """NHWC conv with HWIO kernel (TPU-native layouts)."""
+    out = jax.lax.conv_general_dilated(
+        x, w.astype(x.dtype), window_strides=(stride, stride),
+        padding=[(padding, padding), (padding, padding)],
+        dimension_numbers=("NHWC", "HWIO", "NHWC"))
+    if b is not None:
+        out = out + b.astype(out.dtype)
+    return out
+
+
+def group_norm(x, scale, bias, *, groups=32, eps=1e-6):
+    """GroupNorm over the channel (last) dim of an NHWC tensor."""
+    b, h, w, c = x.shape
+    g = min(groups, c)
+    xf = x.astype(jnp.float32).reshape(b, h, w, g, c // g)
+    mean = xf.mean(axis=(1, 2, 4), keepdims=True)
+    var = xf.var(axis=(1, 2, 4), keepdims=True)
+    xf = (xf - mean) * jax.lax.rsqrt(var + eps)
+    xf = xf.reshape(b, h, w, c)
+    return (xf * scale.astype(jnp.float32) +
+            bias.astype(jnp.float32)).astype(x.dtype)
+
+
+def timestep_embedding(t, dim, *, max_period=10000.0):
+    """Sinusoidal timestep embedding (diffusers Timesteps with
+    flip_sin_to_cos=True, downscale_freq_shift=0): [cos | sin]."""
+    half = dim // 2
+    freqs = jnp.exp(-math.log(max_period) *
+                    jnp.arange(half, dtype=jnp.float32) / half)
+    args = t.astype(jnp.float32)[:, None] * freqs[None]
+    return jnp.concatenate([jnp.cos(args), jnp.sin(args)], axis=-1)
+
+
+def _linear(x, p):
+    return x @ p["w"].astype(x.dtype) + p["b"].astype(x.dtype)
+
+
+def _attention(q, k, v, num_heads):
+    """[B, N, C] x [B, M, C] attention via the shared op (routes through
+    the registry's flash-attention fast path on TPU)."""
+    b, n, c = q.shape
+    m = k.shape[1]
+    dh = c // num_heads
+    out = multihead_attention(
+        q.reshape(b, n, num_heads, dh), k.reshape(b, m, num_heads, dh),
+        v.reshape(b, m, num_heads, dh), causal=False)
+    return out.reshape(b, n, c)
+
+
+def _layer_norm(x, p, eps=1e-5):
+    return layer_norm(x, p["scale"], p["bias"], eps)
+
+
+# ----------------------------------------------------------------- resnet
+
+
+def resnet_block(x, temb, p, *, groups=32, eps=1e-6):
+    """diffusers ResnetBlock2D: GN→silu→conv3x3 (+time proj) →GN→silu→
+    conv3x3, learned 1x1 shortcut on channel change."""
+    h = group_norm(x, p["norm1_scale"], p["norm1_bias"], groups=groups,
+                   eps=eps)
+    h = conv2d(jax.nn.silu(h), p["conv1_w"], p["conv1_b"])
+    if temb is not None and "time_emb_w" in p:
+        h = h + _linear(jax.nn.silu(temb),
+                        {"w": p["time_emb_w"], "b": p["time_emb_b"]}
+                        )[:, None, None, :]
+    h = group_norm(h, p["norm2_scale"], p["norm2_bias"], groups=groups,
+                   eps=eps)
+    h = conv2d(jax.nn.silu(h), p["conv2_w"], p["conv2_b"])
+    if "shortcut_w" in p:
+        x = conv2d(x, p["shortcut_w"], p["shortcut_b"], padding=0)
+    return x + h
+
+
+def init_resnet_block(rng, c_in, c_out, temb_dim=None):
+    k = jax.random.split(rng, 4)
+    he = jax.nn.initializers.variance_scaling(1.0, "fan_in", "normal")
+    p = {
+        "norm1_scale": jnp.ones((c_in,)), "norm1_bias": jnp.zeros((c_in,)),
+        "conv1_w": he(k[0], (3, 3, c_in, c_out), jnp.float32),
+        "conv1_b": jnp.zeros((c_out,)),
+        "norm2_scale": jnp.ones((c_out,)), "norm2_bias": jnp.zeros((c_out,)),
+        "conv2_w": he(k[1], (3, 3, c_out, c_out), jnp.float32),
+        "conv2_b": jnp.zeros((c_out,)),
+    }
+    if temb_dim:
+        p["time_emb_w"] = he(k[2], (temb_dim, c_out), jnp.float32)
+        p["time_emb_b"] = jnp.zeros((c_out,))
+    if c_in != c_out:
+        p["shortcut_w"] = he(k[3], (1, 1, c_in, c_out), jnp.float32)
+        p["shortcut_b"] = jnp.zeros((c_out,))
+    return p
+
+
+# ------------------------------------------------- transformer (cross-attn)
+
+
+def basic_transformer_block(x, ctx, p, num_heads):
+    """diffusers BasicTransformerBlock: pre-LN self-attn → pre-LN
+    cross-attn → pre-LN GEGLU feed-forward."""
+    y = _layer_norm(x, p["norm1"])
+    q = y @ p["attn1_q"].astype(y.dtype)
+    k = y @ p["attn1_k"].astype(y.dtype)
+    v = y @ p["attn1_v"].astype(y.dtype)
+    x = x + _linear(_attention(q, k, v, num_heads), p["attn1_out"])
+    y = _layer_norm(x, p["norm2"])
+    q = y @ p["attn2_q"].astype(y.dtype)
+    k = ctx @ p["attn2_k"].astype(ctx.dtype)
+    v = ctx @ p["attn2_v"].astype(ctx.dtype)
+    x = x + _linear(_attention(q, k, v, num_heads), p["attn2_out"])
+    y = _layer_norm(x, p["norm3"])
+    h = _linear(y, p["ff_in"])               # [.., 2*inner] GEGLU
+    h, gate = jnp.split(h, 2, axis=-1)
+    h = h * jax.nn.gelu(gate, approximate=False)
+    return x + _linear(h, p["ff_out"])
+
+
+def init_transformer_block(rng, dim, ctx_dim, ff_mult=4):
+    k = jax.random.split(rng, 8)
+    he = jax.nn.initializers.variance_scaling(1.0, "fan_in", "normal")
+    ln = lambda: {"scale": jnp.ones((dim,)), "bias": jnp.zeros((dim,))}
+    lin = lambda kk, i, o: {"w": he(kk, (i, o), jnp.float32),
+                            "b": jnp.zeros((o,))}
+    inner = ff_mult * dim
+    return {
+        "norm1": ln(), "norm2": ln(), "norm3": ln(),
+        "attn1_q": he(k[0], (dim, dim), jnp.float32),
+        "attn1_k": he(k[1], (dim, dim), jnp.float32),
+        "attn1_v": he(k[2], (dim, dim), jnp.float32),
+        "attn1_out": lin(k[3], dim, dim),
+        "attn2_q": he(k[4], (dim, dim), jnp.float32),
+        "attn2_k": he(k[5], (ctx_dim, dim), jnp.float32),
+        "attn2_v": he(k[6], (ctx_dim, dim), jnp.float32),
+        "attn2_out": lin(k[7], dim, dim),
+        "ff_in": lin(k[3], dim, 2 * inner),
+        "ff_out": lin(k[4], inner, dim),
+    }
+
+
+def transformer_2d(x, ctx, p, num_heads):
+    """diffusers Transformer2DModel (conv projections, SD-1.x): GN →
+    conv1x1 proj_in → [B, HW, C] blocks → conv1x1 proj_out, residual."""
+    b, h, w, c = x.shape
+    res = x
+    y = group_norm(x, p["norm_scale"], p["norm_bias"], eps=1e-6)
+    y = conv2d(y, p["proj_in_w"], p["proj_in_b"], padding=0)
+    y = y.reshape(b, h * w, c)
+    for blk in p["blocks"]:
+        y = basic_transformer_block(y, ctx, blk, num_heads)
+    y = y.reshape(b, h, w, c)
+    return conv2d(y, p["proj_out_w"], p["proj_out_b"], padding=0) + res
+
+
+def init_transformer_2d(rng, dim, ctx_dim, depth=1):
+    k = jax.random.split(rng, depth + 2)
+    he = jax.nn.initializers.variance_scaling(1.0, "fan_in", "normal")
+    return {
+        "norm_scale": jnp.ones((dim,)), "norm_bias": jnp.zeros((dim,)),
+        "proj_in_w": he(k[0], (1, 1, dim, dim), jnp.float32),
+        "proj_in_b": jnp.zeros((dim,)),
+        "blocks": [init_transformer_block(k[2 + i], dim, ctx_dim)
+                   for i in range(depth)],
+        "proj_out_w": he(k[1], (1, 1, dim, dim), jnp.float32),
+        "proj_out_b": jnp.zeros((dim,)),
+    }
+
+
+# ---------------------------------------------------------------- UNet
+
+
+@dataclasses.dataclass
+class UNetConfig:
+    in_channels: int = 4
+    out_channels: int = 4
+    block_out_channels: Tuple[int, ...] = (320, 640, 1280, 1280)
+    layers_per_block: int = 2
+    down_block_types: Tuple[str, ...] = (
+        "CrossAttnDownBlock2D", "CrossAttnDownBlock2D",
+        "CrossAttnDownBlock2D", "DownBlock2D")
+    up_block_types: Tuple[str, ...] = (
+        "UpBlock2D", "CrossAttnUpBlock2D", "CrossAttnUpBlock2D",
+        "CrossAttnUpBlock2D")
+    cross_attention_dim: int = 768
+    attention_head_dim: int = 8      # heads per attention (SD-1.x semantics)
+    norm_groups: int = 32
+    transformer_depth: int = 1
+
+    @classmethod
+    def tiny(cls, **kw):
+        kw.setdefault("block_out_channels", (32, 64))
+        kw.setdefault("down_block_types",
+                      ("CrossAttnDownBlock2D", "DownBlock2D"))
+        kw.setdefault("up_block_types",
+                      ("UpBlock2D", "CrossAttnUpBlock2D"))
+        kw.setdefault("layers_per_block", 1)
+        kw.setdefault("cross_attention_dim", 32)
+        kw.setdefault("attention_head_dim", 4)
+        kw.setdefault("norm_groups", 8)
+        return cls(**kw)
+
+
+class UNet2DConditionModel:
+    """Conditional denoising UNet. __call__(params, sample [B,H,W,C_in],
+    timesteps [B], encoder_hidden_states [B,S,ctx]) → eps [B,H,W,C_out]."""
+
+    def __init__(self, config: UNetConfig, compute_dtype=jnp.float32):
+        self.config = config
+        self.compute_dtype = compute_dtype
+
+    # ------------------------------------------------------------- init
+    def init(self, rng):
+        c = self.config
+        ch = c.block_out_channels
+        temb = 4 * ch[0]
+        heads = c.attention_head_dim
+        keys = iter(jax.random.split(rng, 256))
+        he = jax.nn.initializers.variance_scaling(1.0, "fan_in", "normal")
+        nk = lambda: next(keys)
+        params: Dict[str, Any] = {
+            "time_mlp1": {"w": he(nk(), (ch[0], temb), jnp.float32),
+                          "b": jnp.zeros((temb,))},
+            "time_mlp2": {"w": he(nk(), (temb, temb), jnp.float32),
+                          "b": jnp.zeros((temb,))},
+            "conv_in_w": he(nk(), (3, 3, c.in_channels, ch[0]), jnp.float32),
+            "conv_in_b": jnp.zeros((ch[0],)),
+        }
+        # down
+        down = []
+        c_prev = ch[0]
+        for i, btype in enumerate(c.down_block_types):
+            c_out = ch[i]
+            blk = {"resnets": [], "attns": []}
+            for j in range(c.layers_per_block):
+                blk["resnets"].append(init_resnet_block(
+                    nk(), c_prev if j == 0 else c_out, c_out, temb))
+                if btype == "CrossAttnDownBlock2D":
+                    blk["attns"].append(init_transformer_2d(
+                        nk(), c_out, c.cross_attention_dim,
+                        c.transformer_depth))
+            if i < len(ch) - 1:
+                blk["down_w"] = he(nk(), (3, 3, c_out, c_out), jnp.float32)
+                blk["down_b"] = jnp.zeros((c_out,))
+            down.append(blk)
+            c_prev = c_out
+        params["down"] = down
+        # mid
+        params["mid"] = {
+            "resnet1": init_resnet_block(nk(), ch[-1], ch[-1], temb),
+            "attn": init_transformer_2d(nk(), ch[-1], c.cross_attention_dim,
+                                        c.transformer_depth),
+            "resnet2": init_resnet_block(nk(), ch[-1], ch[-1], temb),
+        }
+        # up (reversed channels, layers_per_block+1 resnets w/ skip concat)
+        up = []
+        rev = list(reversed(ch))
+        for i, btype in enumerate(c.up_block_types):
+            c_out = rev[i]
+            c_skip_prev = rev[min(i + 1, len(rev) - 1)]
+            blk = {"resnets": [], "attns": []}
+            for j in range(c.layers_per_block + 1):
+                res_skip = c_out if j < c.layers_per_block else c_skip_prev
+                res_in = (rev[max(i - 1, 0)] if i > 0 else rev[0]) \
+                    if j == 0 else c_out
+                blk["resnets"].append(init_resnet_block(
+                    nk(), res_in + res_skip, c_out, temb))
+                if btype == "CrossAttnUpBlock2D":
+                    blk["attns"].append(init_transformer_2d(
+                        nk(), c_out, c.cross_attention_dim,
+                        c.transformer_depth))
+            if i < len(ch) - 1:
+                blk["up_w"] = he(nk(), (3, 3, c_out, c_out), jnp.float32)
+                blk["up_b"] = jnp.zeros((c_out,))
+            up.append(blk)
+        params["up"] = up
+        params["norm_out_scale"] = jnp.ones((ch[0],))
+        params["norm_out_bias"] = jnp.zeros((ch[0],))
+        params["conv_out_w"] = he(nk(), (3, 3, ch[0], c.out_channels),
+                                  jnp.float32)
+        params["conv_out_b"] = jnp.zeros((c.out_channels,))
+        return params
+
+    # ---------------------------------------------------------- forward
+    def __call__(self, params, sample, timesteps, encoder_hidden_states):
+        c = self.config
+        heads = c.attention_head_dim
+        g = c.norm_groups
+        temb = timestep_embedding(timesteps, c.block_out_channels[0])
+        temb = _linear(jax.nn.silu(_linear(temb, params["time_mlp1"])),
+                       params["time_mlp2"])
+
+        x = conv2d(sample.astype(self.compute_dtype), params["conv_in_w"],
+                   params["conv_in_b"])
+        skips = [x]
+        for i, blk in enumerate(params["down"]):
+            has_attn = len(blk["attns"]) > 0
+            for j, rp in enumerate(blk["resnets"]):
+                x = resnet_block(x, temb, rp, groups=g)
+                if has_attn:
+                    x = transformer_2d(x, encoder_hidden_states,
+                                       blk["attns"][j], heads)
+                skips.append(x)
+            if "down_w" in blk:
+                x = conv2d(x, blk["down_w"], blk["down_b"], stride=2)
+                skips.append(x)
+
+        m = params["mid"]
+        x = resnet_block(x, temb, m["resnet1"], groups=g)
+        x = transformer_2d(x, encoder_hidden_states, m["attn"], heads)
+        x = resnet_block(x, temb, m["resnet2"], groups=g)
+
+        for i, blk in enumerate(params["up"]):
+            has_attn = len(blk["attns"]) > 0
+            for j, rp in enumerate(blk["resnets"]):
+                skip = skips.pop()
+                x = jnp.concatenate([x, skip], axis=-1)
+                x = resnet_block(x, temb, rp, groups=g)
+                if has_attn:
+                    x = transformer_2d(x, encoder_hidden_states,
+                                       blk["attns"][j], heads)
+            if "up_w" in blk:
+                b, h, w, cc = x.shape
+                x = jax.image.resize(x, (b, 2 * h, 2 * w, cc), "nearest")
+                x = conv2d(x, blk["up_w"], blk["up_b"])
+
+        x = group_norm(x, params["norm_out_scale"], params["norm_out_bias"],
+                       groups=g)
+        return conv2d(jax.nn.silu(x), params["conv_out_w"],
+                      params["conv_out_b"])
+
+
+# ----------------------------------------------------------------- VAE
+
+
+@dataclasses.dataclass
+class VAEConfig:
+    in_channels: int = 3
+    out_channels: int = 3
+    latent_channels: int = 4
+    block_out_channels: Tuple[int, ...] = (128, 256, 512, 512)
+    layers_per_block: int = 2
+    norm_groups: int = 32
+    scaling_factor: float = 0.18215
+
+    @classmethod
+    def tiny(cls, **kw):
+        kw.setdefault("block_out_channels", (32, 64))
+        kw.setdefault("layers_per_block", 1)
+        kw.setdefault("norm_groups", 8)
+        return cls(**kw)
+
+
+def _init_vae_attn(rng, dim):
+    k = jax.random.split(rng, 4)
+    he = jax.nn.initializers.variance_scaling(1.0, "fan_in", "normal")
+    lin = lambda kk: {"w": he(kk, (dim, dim), jnp.float32),
+                      "b": jnp.zeros((dim,))}
+    return {"norm_scale": jnp.ones((dim,)), "norm_bias": jnp.zeros((dim,)),
+            "q": lin(k[0]), "k": lin(k[1]), "v": lin(k[2]),
+            "out": lin(k[3])}
+
+
+def _vae_attn(x, p, groups):
+    """Single-head spatial self-attention (diffusers VAE mid attention)."""
+    b, h, w, c = x.shape
+    y = group_norm(x, p["norm_scale"], p["norm_bias"], groups=groups)
+    y = y.reshape(b, h * w, c)
+    out = _attention(_linear(y, p["q"]), _linear(y, p["k"]),
+                     _linear(y, p["v"]), num_heads=1)
+    return x + _linear(out, p["out"]).reshape(b, h, w, c)
+
+
+class AutoencoderKL:
+    """VAE with KL latent (diffusers AutoencoderKL layout).
+
+    encode(params, images [B,H,W,3]) → (mean, logvar) [B,H/8,W/8,latent]
+    decode(params, latents) → images [B,H,W,3]
+    """
+
+    def __init__(self, config: VAEConfig, compute_dtype=jnp.float32):
+        self.config = config
+        self.compute_dtype = compute_dtype
+
+    def init(self, rng):
+        c = self.config
+        ch = c.block_out_channels
+        keys = iter(jax.random.split(rng, 128))
+        nk = lambda: next(keys)
+        he = jax.nn.initializers.variance_scaling(1.0, "fan_in", "normal")
+        enc: Dict[str, Any] = {
+            "conv_in_w": he(nk(), (3, 3, c.in_channels, ch[0]), jnp.float32),
+            "conv_in_b": jnp.zeros((ch[0],)),
+            "down": [],
+        }
+        c_prev = ch[0]
+        for i, c_out in enumerate(ch):
+            blk = {"resnets": [init_resnet_block(
+                nk(), c_prev if j == 0 else c_out, c_out)
+                for j in range(c.layers_per_block)]}
+            if i < len(ch) - 1:
+                blk["down_w"] = he(nk(), (3, 3, c_out, c_out), jnp.float32)
+                blk["down_b"] = jnp.zeros((c_out,))
+            enc["down"].append(blk)
+            c_prev = c_out
+        enc["mid"] = {
+            "resnet1": init_resnet_block(nk(), ch[-1], ch[-1]),
+            "attn": _init_vae_attn(nk(), ch[-1]),
+            "resnet2": init_resnet_block(nk(), ch[-1], ch[-1]),
+        }
+        enc["norm_out_scale"] = jnp.ones((ch[-1],))
+        enc["norm_out_bias"] = jnp.zeros((ch[-1],))
+        enc["conv_out_w"] = he(nk(), (3, 3, ch[-1], 2 * c.latent_channels),
+                               jnp.float32)
+        enc["conv_out_b"] = jnp.zeros((2 * c.latent_channels,))
+
+        dec: Dict[str, Any] = {
+            "conv_in_w": he(nk(), (3, 3, c.latent_channels, ch[-1]),
+                            jnp.float32),
+            "conv_in_b": jnp.zeros((ch[-1],)),
+            "mid": {
+                "resnet1": init_resnet_block(nk(), ch[-1], ch[-1]),
+                "attn": _init_vae_attn(nk(), ch[-1]),
+                "resnet2": init_resnet_block(nk(), ch[-1], ch[-1]),
+            },
+            "up": [],
+        }
+        rev = list(reversed(ch))
+        c_prev = rev[0]
+        for i, c_out in enumerate(rev):
+            blk = {"resnets": [init_resnet_block(
+                nk(), c_prev if j == 0 else c_out, c_out)
+                for j in range(c.layers_per_block + 1)]}
+            if i < len(ch) - 1:
+                blk["up_w"] = he(nk(), (3, 3, c_out, c_out), jnp.float32)
+                blk["up_b"] = jnp.zeros((c_out,))
+            dec["up"].append(blk)
+            c_prev = c_out
+        dec["norm_out_scale"] = jnp.ones((ch[0],))
+        dec["norm_out_bias"] = jnp.zeros((ch[0],))
+        dec["conv_out_w"] = he(nk(), (3, 3, ch[0], c.out_channels),
+                               jnp.float32)
+        dec["conv_out_b"] = jnp.zeros((c.out_channels,))
+        return {
+            "encoder": enc, "decoder": dec,
+            "quant_w": he(nk(), (1, 1, 2 * c.latent_channels,
+                                 2 * c.latent_channels), jnp.float32),
+            "quant_b": jnp.zeros((2 * c.latent_channels,)),
+            "post_quant_w": he(nk(), (1, 1, c.latent_channels,
+                                      c.latent_channels), jnp.float32),
+            "post_quant_b": jnp.zeros((c.latent_channels,)),
+        }
+
+    def encode(self, params, images):
+        c = self.config
+        g = c.norm_groups
+        e = params["encoder"]
+        x = conv2d(images.astype(self.compute_dtype), e["conv_in_w"],
+                   e["conv_in_b"])
+        for blk in e["down"]:
+            for rp in blk["resnets"]:
+                x = resnet_block(x, None, rp, groups=g)
+            if "down_w" in blk:
+                # diffusers encoder downsample pads (0,1,0,1) asymmetrically
+                x = jnp.pad(x, ((0, 0), (0, 1), (0, 1), (0, 0)))
+                x = jax.lax.conv_general_dilated(
+                    x, blk["down_w"].astype(x.dtype), (2, 2), "VALID",
+                    dimension_numbers=("NHWC", "HWIO", "NHWC")) + \
+                    blk["down_b"].astype(x.dtype)
+        m = e["mid"]
+        x = resnet_block(x, None, m["resnet1"], groups=g)
+        x = _vae_attn(x, m["attn"], g)
+        x = resnet_block(x, None, m["resnet2"], groups=g)
+        x = group_norm(x, e["norm_out_scale"], e["norm_out_bias"], groups=g)
+        x = conv2d(jax.nn.silu(x), e["conv_out_w"], e["conv_out_b"])
+        moments = conv2d(x, params["quant_w"], params["quant_b"], padding=0)
+        mean, logvar = jnp.split(moments, 2, axis=-1)
+        return mean, jnp.clip(logvar, -30.0, 20.0)
+
+    def decode(self, params, latents):
+        c = self.config
+        g = c.norm_groups
+        d = params["decoder"]
+        x = conv2d(latents.astype(self.compute_dtype), params["post_quant_w"],
+                   params["post_quant_b"], padding=0)
+        x = conv2d(x, d["conv_in_w"], d["conv_in_b"])
+        m = d["mid"]
+        x = resnet_block(x, None, m["resnet1"], groups=g)
+        x = _vae_attn(x, m["attn"], g)
+        x = resnet_block(x, None, m["resnet2"], groups=g)
+        for blk in d["up"]:
+            for rp in blk["resnets"]:
+                x = resnet_block(x, None, rp, groups=g)
+            if "up_w" in blk:
+                b, h, w, cc = x.shape
+                x = jax.image.resize(x, (b, 2 * h, 2 * w, cc), "nearest")
+                x = conv2d(x, blk["up_w"], blk["up_b"])
+        x = group_norm(x, d["norm_out_scale"], d["norm_out_bias"], groups=g)
+        return conv2d(jax.nn.silu(x), d["conv_out_w"], d["conv_out_b"])

@@ -1,0 +1,342 @@
+"""Diffusion pillar tests (reference: csrc/spatial ops + clip/unet/vae
+containers + tests/unit/ops/spatial).
+
+diffusers is not installed in this image, so parity is pinned two ways:
+  * CLIP text encoder: logit/pooled parity vs HF transformers (real
+    external reference).
+  * UNet/VAE building blocks: numeric parity vs torch modules constructed
+    per the diffusers block definitions (GroupNorm/Conv2d/attention math).
+  * Weight converters: round-trip through a synthetic diffusers-format
+    state dict (validates the name map + layout transposes).
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+
+@pytest.fixture(scope="module")
+def torch():
+    return pytest.importorskip("torch")
+
+
+@pytest.fixture(scope="module")
+def transformers(torch):
+    return pytest.importorskip("transformers")
+
+
+class TestCLIPText:
+    def test_parity_vs_hf(self, torch, transformers):
+        from deepspeed_tpu.inference.policies import convert_hf_model
+
+        cfg = transformers.CLIPTextConfig(
+            vocab_size=99, hidden_size=32, intermediate_size=64,
+            num_hidden_layers=2, num_attention_heads=2,
+            max_position_embeddings=32, eos_token_id=98)
+        hf = transformers.CLIPTextModel(cfg)
+        hf.eval()
+        # eos (=98) is also the max id → HF's argmax pooling conventions and
+        # ours agree regardless of transformers version
+        ids = np.array([[5, 17, 40, 77, 3, 98]], dtype=np.int32)
+        with torch.no_grad():
+            out = hf(torch.tensor(ids))
+        model, params = convert_hf_model(hf, compute_dtype=jnp.float32)
+        hidden = model.forward_hidden(params, jnp.asarray(ids))
+        np.testing.assert_allclose(np.asarray(hidden),
+                                   out.last_hidden_state.numpy(),
+                                   atol=2e-5, rtol=1e-4)
+        pooled = model.pooled(params, hidden, jnp.asarray(ids))
+        np.testing.assert_allclose(np.asarray(pooled),
+                                   out.pooler_output.numpy(),
+                                   atol=2e-5, rtol=1e-4)
+
+
+class TestBlocks:
+    def test_group_norm_matches_torch(self, torch):
+        from deepspeed_tpu.models.diffusion import group_norm
+
+        rng = np.random.RandomState(0)
+        x = rng.randn(2, 6, 6, 16).astype(np.float32)
+        scale = rng.randn(16).astype(np.float32)
+        bias = rng.randn(16).astype(np.float32)
+        gn = torch.nn.GroupNorm(4, 16, eps=1e-6)
+        with torch.no_grad():
+            gn.weight.copy_(torch.tensor(scale))
+            gn.bias.copy_(torch.tensor(bias))
+            ref = gn(torch.tensor(x).permute(0, 3, 1, 2)) \
+                .permute(0, 2, 3, 1).numpy()
+        ours = np.asarray(group_norm(jnp.asarray(x), jnp.asarray(scale),
+                                     jnp.asarray(bias), groups=4))
+        np.testing.assert_allclose(ours, ref, atol=1e-5, rtol=1e-4)
+
+    def test_conv2d_matches_torch(self, torch):
+        from deepspeed_tpu.models.diffusion import conv2d
+
+        rng = np.random.RandomState(1)
+        x = rng.randn(2, 8, 8, 3).astype(np.float32)
+        w = rng.randn(16, 3, 3, 3).astype(np.float32)   # OIHW
+        b = rng.randn(16).astype(np.float32)
+        conv = torch.nn.Conv2d(3, 16, 3, padding=1)
+        with torch.no_grad():
+            conv.weight.copy_(torch.tensor(w))
+            conv.bias.copy_(torch.tensor(b))
+            ref = conv(torch.tensor(x).permute(0, 3, 1, 2)) \
+                .permute(0, 2, 3, 1).numpy()
+        ours = np.asarray(conv2d(jnp.asarray(x),
+                                 jnp.asarray(w.transpose(2, 3, 1, 0)),
+                                 jnp.asarray(b)))
+        np.testing.assert_allclose(ours, ref, atol=1e-4, rtol=1e-4)
+
+    def test_resnet_block_matches_torch(self, torch):
+        """Full ResnetBlock2D (diffusers definition: GN→silu→conv→+temb→
+        GN→silu→conv, 1x1 shortcut) vs torch primitives."""
+        from deepspeed_tpu.models.diffusion import (
+            init_resnet_block, resnet_block)
+
+        rng = np.random.RandomState(2)
+        c_in, c_out, temb_dim = 8, 16, 12
+        p = init_resnet_block(jax.random.PRNGKey(0), c_in, c_out, temb_dim)
+        p = jax.tree_util.tree_map(
+            lambda a: jnp.asarray(rng.randn(*a.shape).astype(np.float32)
+                                  * 0.2), p)
+        x = rng.randn(2, 6, 6, c_in).astype(np.float32)
+        temb = rng.randn(2, temb_dim).astype(np.float32)
+
+        tt = lambda a: torch.tensor(np.asarray(a))
+        xt = tt(x).permute(0, 3, 1, 2)
+        with torch.no_grad():
+            h = torch.nn.functional.group_norm(
+                xt, 4, tt(p["norm1_scale"]), tt(p["norm1_bias"]), eps=1e-6)
+            h = torch.nn.functional.conv2d(
+                torch.nn.functional.silu(h),
+                tt(p["conv1_w"]).permute(3, 2, 0, 1), tt(p["conv1_b"]),
+                padding=1)
+            te = torch.nn.functional.linear(
+                torch.nn.functional.silu(tt(temb)),
+                tt(p["time_emb_w"]).T, tt(p["time_emb_b"]))
+            h = h + te[:, :, None, None]
+            h = torch.nn.functional.group_norm(
+                h, 4, tt(p["norm2_scale"]), tt(p["norm2_bias"]), eps=1e-6)
+            h = torch.nn.functional.conv2d(
+                torch.nn.functional.silu(h),
+                tt(p["conv2_w"]).permute(3, 2, 0, 1), tt(p["conv2_b"]),
+                padding=1)
+            sc = torch.nn.functional.conv2d(
+                xt, tt(p["shortcut_w"]).permute(3, 2, 0, 1),
+                tt(p["shortcut_b"]))
+            ref = (sc + h).permute(0, 2, 3, 1).numpy()
+        ours = np.asarray(resnet_block(jnp.asarray(x), jnp.asarray(temb), p,
+                                       groups=4))
+        np.testing.assert_allclose(ours, ref, atol=1e-4, rtol=1e-3)
+
+    def test_transformer_block_matches_torch(self, torch):
+        """BasicTransformerBlock (self-attn → cross-attn → GEGLU) vs a
+        torch re-implementation."""
+        from deepspeed_tpu.models.diffusion import (
+            basic_transformer_block, init_transformer_block)
+
+        rng = np.random.RandomState(3)
+        dim, ctx_dim, heads = 16, 12, 4
+        p = init_transformer_block(jax.random.PRNGKey(0), dim, ctx_dim)
+        p = jax.tree_util.tree_map(
+            lambda a: jnp.asarray(rng.randn(*a.shape).astype(np.float32)
+                                  * 0.2), p)
+        x = rng.randn(2, 9, dim).astype(np.float32)
+        ctx = rng.randn(2, 5, ctx_dim).astype(np.float32)
+
+        tt = lambda a: torch.tensor(np.asarray(a))
+
+        def t_attn(q, k, v, h):
+            b, n, c = q.shape
+            m = k.shape[1]
+            dh = c // h
+            q = q.reshape(b, n, h, dh).permute(0, 2, 1, 3)
+            k = k.reshape(b, m, h, dh).permute(0, 2, 1, 3)
+            v = v.reshape(b, m, h, dh).permute(0, 2, 1, 3)
+            a = torch.softmax(q @ k.transpose(-1, -2) * dh ** -0.5, dim=-1)
+            return (a @ v).permute(0, 2, 1, 3).reshape(b, n, c)
+
+        with torch.no_grad():
+            xt, ct = tt(x), tt(ctx)
+            ln = lambda y, q: torch.nn.functional.layer_norm(
+                y, (dim,), tt(p[q]["scale"]), tt(p[q]["bias"]))
+            y = ln(xt, "norm1")
+            a = t_attn(y @ tt(p["attn1_q"]), y @ tt(p["attn1_k"]),
+                       y @ tt(p["attn1_v"]), heads)
+            xt = xt + a @ tt(p["attn1_out"]["w"]) + tt(p["attn1_out"]["b"])
+            y = ln(xt, "norm2")
+            a = t_attn(y @ tt(p["attn2_q"]), ct @ tt(p["attn2_k"]),
+                       ct @ tt(p["attn2_v"]), heads)
+            xt = xt + a @ tt(p["attn2_out"]["w"]) + tt(p["attn2_out"]["b"])
+            y = ln(xt, "norm3")
+            hgate = y @ tt(p["ff_in"]["w"]) + tt(p["ff_in"]["b"])
+            hh, gate = hgate.chunk(2, dim=-1)
+            hh = hh * torch.nn.functional.gelu(gate)
+            ref = (xt + hh @ tt(p["ff_out"]["w"]) +
+                   tt(p["ff_out"]["b"])).numpy()
+        ours = np.asarray(basic_transformer_block(
+            jnp.asarray(x), jnp.asarray(ctx), p, heads))
+        np.testing.assert_allclose(ours, ref, atol=1e-4, rtol=1e-3)
+
+
+class TestUNetVAE:
+    def test_unet_forward_shapes(self):
+        from deepspeed_tpu.models.diffusion import (
+            UNet2DConditionModel, UNetConfig)
+
+        cfg = UNetConfig.tiny()
+        unet = UNet2DConditionModel(cfg)
+        params = unet.init(jax.random.PRNGKey(0))
+        x = jnp.zeros((2, 16, 16, cfg.in_channels))
+        t = jnp.array([1, 500], jnp.int32)
+        ctx = jnp.zeros((2, 7, cfg.cross_attention_dim))
+        out = jax.jit(unet)(params, x, t, ctx)
+        assert out.shape == (2, 16, 16, cfg.out_channels)
+        assert np.isfinite(np.asarray(out)).all()
+
+    def test_unet_converter_round_trip(self, torch):
+        """our init → synthetic diffusers-format sd → convert → identical
+        tree (validates the full name map + every layout transpose)."""
+        from deepspeed_tpu.inference.diffusion import convert_diffusers_unet
+        from deepspeed_tpu.models.diffusion import (
+            UNet2DConditionModel, UNetConfig)
+
+        cfg = UNetConfig.tiny()
+        params = UNet2DConditionModel(cfg).init(jax.random.PRNGKey(1))
+        sd = {}
+        tt = lambda a: torch.tensor(np.asarray(a))
+        conv = lambda a: tt(np.transpose(np.asarray(a), (3, 2, 0, 1)))
+        lin = lambda a: tt(np.asarray(a).T)
+
+        def put_resnet(pre, p):
+            sd[pre + "norm1.weight"] = tt(p["norm1_scale"])
+            sd[pre + "norm1.bias"] = tt(p["norm1_bias"])
+            sd[pre + "conv1.weight"] = conv(p["conv1_w"])
+            sd[pre + "conv1.bias"] = tt(p["conv1_b"])
+            sd[pre + "norm2.weight"] = tt(p["norm2_scale"])
+            sd[pre + "norm2.bias"] = tt(p["norm2_bias"])
+            sd[pre + "conv2.weight"] = conv(p["conv2_w"])
+            sd[pre + "conv2.bias"] = tt(p["conv2_b"])
+            if "time_emb_w" in p:
+                sd[pre + "time_emb_proj.weight"] = lin(p["time_emb_w"])
+                sd[pre + "time_emb_proj.bias"] = tt(p["time_emb_b"])
+            if "shortcut_w" in p:
+                sd[pre + "conv_shortcut.weight"] = conv(p["shortcut_w"])
+                sd[pre + "conv_shortcut.bias"] = tt(p["shortcut_b"])
+
+        def put_attn(pre, p):
+            sd[pre + "norm.weight"] = tt(p["norm_scale"])
+            sd[pre + "norm.bias"] = tt(p["norm_bias"])
+            sd[pre + "proj_in.weight"] = conv(p["proj_in_w"])
+            sd[pre + "proj_in.bias"] = tt(p["proj_in_b"])
+            sd[pre + "proj_out.weight"] = conv(p["proj_out_w"])
+            sd[pre + "proj_out.bias"] = tt(p["proj_out_b"])
+            for k, b in enumerate(p["blocks"]):
+                tp = f"{pre}transformer_blocks.{k}."
+                for n in ("norm1", "norm2", "norm3"):
+                    sd[tp + n + ".weight"] = tt(b[n]["scale"])
+                    sd[tp + n + ".bias"] = tt(b[n]["bias"])
+                for a in ("attn1", "attn2"):
+                    for proj in ("q", "k", "v"):
+                        sd[f"{tp}{a}.to_{proj}.weight"] = lin(
+                            b[f"{a}_{proj}"])
+                    sd[f"{tp}{a}.to_out.0.weight"] = lin(b[a + "_out"]["w"])
+                    sd[f"{tp}{a}.to_out.0.bias"] = tt(b[a + "_out"]["b"])
+                sd[tp + "ff.net.0.proj.weight"] = lin(b["ff_in"]["w"])
+                sd[tp + "ff.net.0.proj.bias"] = tt(b["ff_in"]["b"])
+                sd[tp + "ff.net.2.weight"] = lin(b["ff_out"]["w"])
+                sd[tp + "ff.net.2.bias"] = tt(b["ff_out"]["b"])
+
+        sd["time_embedding.linear_1.weight"] = lin(params["time_mlp1"]["w"])
+        sd["time_embedding.linear_1.bias"] = tt(params["time_mlp1"]["b"])
+        sd["time_embedding.linear_2.weight"] = lin(params["time_mlp2"]["w"])
+        sd["time_embedding.linear_2.bias"] = tt(params["time_mlp2"]["b"])
+        sd["conv_in.weight"] = conv(params["conv_in_w"])
+        sd["conv_in.bias"] = tt(params["conv_in_b"])
+        sd["conv_norm_out.weight"] = tt(params["norm_out_scale"])
+        sd["conv_norm_out.bias"] = tt(params["norm_out_bias"])
+        sd["conv_out.weight"] = conv(params["conv_out_w"])
+        sd["conv_out.bias"] = tt(params["conv_out_b"])
+        for i, blk in enumerate(params["down"]):
+            for j, rp in enumerate(blk["resnets"]):
+                put_resnet(f"down_blocks.{i}.resnets.{j}.", rp)
+            for j, ap in enumerate(blk["attns"]):
+                put_attn(f"down_blocks.{i}.attentions.{j}.", ap)
+            if "down_w" in blk:
+                sd[f"down_blocks.{i}.downsamplers.0.conv.weight"] = \
+                    conv(blk["down_w"])
+                sd[f"down_blocks.{i}.downsamplers.0.conv.bias"] = \
+                    tt(blk["down_b"])
+        put_resnet("mid_block.resnets.0.", params["mid"]["resnet1"])
+        put_attn("mid_block.attentions.0.", params["mid"]["attn"])
+        put_resnet("mid_block.resnets.1.", params["mid"]["resnet2"])
+        for i, blk in enumerate(params["up"]):
+            for j, rp in enumerate(blk["resnets"]):
+                put_resnet(f"up_blocks.{i}.resnets.{j}.", rp)
+            for j, ap in enumerate(blk["attns"]):
+                put_attn(f"up_blocks.{i}.attentions.{j}.", ap)
+            if "up_w" in blk:
+                sd[f"up_blocks.{i}.upsamplers.0.conv.weight"] = \
+                    conv(blk["up_w"])
+                sd[f"up_blocks.{i}.upsamplers.0.conv.bias"] = tt(blk["up_b"])
+
+        back = convert_diffusers_unet(sd, cfg)
+        flat_a = jax.tree_util.tree_leaves(params)
+        flat_b = jax.tree_util.tree_leaves(back)
+        assert len(flat_a) == len(flat_b)
+        for a, b in zip(flat_a, flat_b):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       atol=1e-6)
+
+    def test_vae_round_trip_shapes(self):
+        from deepspeed_tpu.models.diffusion import AutoencoderKL, VAEConfig
+
+        cfg = VAEConfig.tiny()
+        vae = AutoencoderKL(cfg)
+        params = vae.init(jax.random.PRNGKey(0))
+        img = jnp.zeros((1, 16, 16, 3))
+        mean, logvar = jax.jit(vae.encode)(params, img)
+        assert mean.shape == (1, 8, 8, cfg.latent_channels)
+        assert logvar.shape == mean.shape
+        out = jax.jit(vae.decode)(params, mean)
+        assert out.shape == (1, 16, 16, 3)
+        assert np.isfinite(np.asarray(out)).all()
+
+
+class TestPipeline:
+    def test_ddim_denoises_to_finite_image(self, torch, transformers):
+        """End-to-end: CLIP-encoded prompt → DDIM scan → VAE decode."""
+        from deepspeed_tpu.inference.diffusion import (
+            DDIMScheduler, StableDiffusionEngine)
+        from deepspeed_tpu.inference.policies import convert_hf_model
+        from deepspeed_tpu.models.diffusion import (
+            AutoencoderKL, UNet2DConditionModel, UNetConfig, VAEConfig)
+
+        ccfg = transformers.CLIPTextConfig(
+            vocab_size=99, hidden_size=32, intermediate_size=64,
+            num_hidden_layers=2, num_attention_heads=2,
+            max_position_embeddings=32, eos_token_id=98)
+        text, text_params = convert_hf_model(
+            transformers.CLIPTextModel(ccfg), compute_dtype=jnp.float32)
+
+        ucfg = UNetConfig.tiny()
+        unet = UNet2DConditionModel(ucfg)
+        uparams = unet.init(jax.random.PRNGKey(0))
+        vcfg = VAEConfig.tiny(latent_channels=ucfg.in_channels)
+        vae = AutoencoderKL(vcfg)
+        vparams = vae.init(jax.random.PRNGKey(1))
+
+        engine = StableDiffusionEngine(
+            unet, uparams, vae, vparams, text_encoder=text,
+            text_params=text_params, scheduler=DDIMScheduler())
+        ids = np.array([[5, 17, 40, 98]], dtype=np.int32)
+        uncond = np.array([[0, 98, 98, 98]], dtype=np.int32)
+        img = engine.generate(ids, uncond, num_steps=2, guidance_scale=4.0,
+                              height=16, width=16,
+                              rng=jax.random.PRNGKey(2))
+        # tiny VAE has one upsample (2x), so latents H/8*... height//8=2 → 4
+        assert img.shape[0] == 1 and img.shape[3] == 3
+        a = np.asarray(img)
+        assert np.isfinite(a).all() and a.min() >= 0.0 and a.max() <= 1.0
