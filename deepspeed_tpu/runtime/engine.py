@@ -456,6 +456,12 @@ class DeepSpeedEngine:
         def grad_step(state: TrainState, batch, rng):
             grads, loss_sum = self._scan_micro_grads(state, batch, rng)
             grads, overflow, norm = self._unscale_epilogue(grads, state.scaler)
+            # host optimizer consumes grads in the MASTER layout: each
+            # process updates exactly the master shards it owns (multi-host
+            # offload partitioning; single-host this is a no-op reshard)
+            grads = jax.tree_util.tree_map(
+                lambda g, s: jax.lax.with_sharding_constraint(
+                    g, NamedSharding(self.mesh, s)), grads, self.master_specs)
             metrics = {"loss": loss_sum / self.gas, "overflow": overflow,
                        "grad_norm": norm, "loss_scale": state.scaler.cur_scale}
             return grads, metrics
@@ -475,17 +481,17 @@ class DeepSpeedEngine:
             return
         clip = self.config.gradient_clipping
         factor = min(1.0, clip / (norm + 1e-6)) if clip and clip > 0 else 1.0
-        flat, _ = jax.tree_util.tree_flatten_with_path(grads)
-        host_leaves = jax.device_get([leaf for _, leaf in flat])  # one batched D2H
-        grads_host = {jax.tree_util.keystr(path): leaf
-                      for (path, _), leaf in zip(flat, host_leaves)}
+        # align grads to the MASTER layout (no-op when already aligned; the
+        # fused grad_step constrains in-program, but the manual
+        # forward/backward/step path reaches here with grad-spec placement)
+        grads = jax.device_put(grads, self.master_shardings)
+        grads_host = self._host_opt.grads_to_host(grads)
         out = self._host_opt.step(grads_host, lr=float(np.asarray(lr)),
                                   grad_scale=factor)
-        new_params = jax.tree_util.tree_unflatten(
-            self._params_treedef, [out[n] for n in self._host_opt._names])
+        new_params = self._host_opt.images_to_device(
+            out, self._params_treedef, self.master_shardings)
         self.state = TrainState(
-            params=jax.device_put(new_params, self.master_shardings),
-            opt_state={}, scaler=new_scaler,
+            params=new_params, opt_state={}, scaler=new_scaler,
             global_step=self.state.global_step + 1)
 
     # -------------------------------------------------------- fused train step

@@ -257,7 +257,7 @@ class PipelineEngine(DeepSpeedEngine):
             f"tied_groups={list(adapter.tie_owner)}", ranks=[0])
 
     # ------------------------------------------------- fused pipelined step
-    def _build_train_step(self):
+    def _build_train_step(self, batch=None):
         def train_step(state: TrainState, batch, lr, rng):
             scale = state.scaler.cur_scale
 

@@ -14,10 +14,16 @@ moments); with ``device="nvme"`` the moments+master per-leaf "sub-groups"
 live on disk and are swapped in/out around each leaf's update with
 read/step/writeback overlap (PipelinedOptimizerSwapper).
 
-Single-host semantics: grads arrive as fully-addressable JAX arrays
-(device_get gathers the global value).  Multi-host sharding of the host
-state follows the same design with per-process shard slicing — tracked as a
-TODO at the engine level, not here.
+Multi-host semantics: when a param is NOT fully addressable from this
+process (a true multi-host mesh), its host master is the concatenation of
+this process's UNIQUE addressable shards (dedup by shard index — replicas
+are stored once), gradients are pulled shard-wise in the same layout, and
+the refreshed compute-dtype images are reassembled into global arrays via
+``jax.make_array_from_single_device_arrays``.  Each host therefore holds
+only ~1/process_count of the 12 bytes/param state, the way the reference
+partitions cpu-offloaded optimizer state across ranks
+(stage_1_and_2.py:1031).  The same shard path can be forced on one host
+with ``DSTPU_FORCE_SHARD_OFFLOAD=1`` (that is how it is unit-tested).
 """
 
 from __future__ import annotations
@@ -30,6 +36,58 @@ import jax
 import numpy as np
 
 from deepspeed_tpu.utils.logging import logger
+
+
+def _index_key(index) -> Tuple:
+    """Hashable key for a shard's global index (tuple of slices)."""
+    return tuple((s.start, s.stop, s.step) for s in index)
+
+
+class _ShardMeta:
+    """Layout of one param's process-local host state: ordered unique
+    shards (index, local shape, owning devices) + the global shape."""
+
+    def __init__(self, global_shape, parts):
+        self.global_shape = tuple(global_shape)
+        self.parts = parts     # [(key, index, shape, [devices])]
+
+    def collect(self, arr: "jax.Array", sink: List) -> List[int]:
+        """Append ``arr``'s unique local shard buffers to ``sink`` (in this
+        meta's order) and return their slot indices — the caller batches
+        ONE device_get over all params' shards."""
+        by_key = {}
+        for s in arr.addressable_shards:
+            by_key.setdefault(_index_key(s.index), s.data)
+        missing = [k for (k, *_rest) in self.parts if k not in by_key]
+        if missing:
+            raise ValueError(
+                "gradient shard layout does not match the master layout "
+                f"(missing indices {missing[:2]}...); the engine must "
+                "constrain grads to the master sharding before offload")
+        slots = []
+        for (k, *_r) in self.parts:
+            slots.append(len(sink))
+            sink.append(by_key[k])
+        return slots
+
+
+def _leaf_to_host(leaf, force_sharded: bool):
+    """leaf → (flat-or-dense host np array, _ShardMeta | None)."""
+    if isinstance(leaf, jax.Array) and (force_sharded or
+                                        not leaf.is_fully_addressable):
+        uniq: Dict[Tuple, Any] = {}
+        devices: Dict[Tuple, List] = {}
+        for s in leaf.addressable_shards:
+            k = _index_key(s.index)
+            devices.setdefault(k, []).append(s.device)
+            if k not in uniq:
+                uniq[k] = (s.index, np.asarray(s.data))
+        parts = [(k, idx, data.shape, devices[k])
+                 for k, (idx, data) in uniq.items()]
+        flat = np.concatenate([np.asarray(uniq[k][1]).reshape(-1)
+                               for (k, *_r) in parts])
+        return flat, _ShardMeta(leaf.shape, parts)
+    return np.asarray(jax.device_get(leaf)), None
 
 
 class HostOffloadOptimizer:
@@ -69,10 +127,15 @@ class HostOffloadOptimizer:
     def init(self, params_device) -> None:
         """Pull fp32 masters to host; zero moments; optionally spill to NVMe.
         (Re-)initialising resets the Adam step so bias correction restarts
-        with the fresh moments."""
+        with the fresh moments.  Non-fully-addressable params keep only
+        this process's unique shards (flat layout, see _ShardMeta)."""
         self.step_count = 0
+        force = os.environ.get("DSTPU_FORCE_SHARD_OFFLOAD") == "1"
         flat = _flatten_with_paths(params_device)
-        host = jax.device_get(flat)
+        self._shard_meta: Dict[str, Optional[_ShardMeta]] = {}
+        host = {}
+        for name, leaf in flat.items():
+            host[name], self._shard_meta[name] = _leaf_to_host(leaf, force)
         for i, (name, arr) in enumerate(host.items()):
             master = np.asarray(arr, np.float32)
             moments = self._zero_moments(master)
@@ -118,6 +181,55 @@ class HostOffloadOptimizer:
                 self._kernel(self.master[name], grad, state, lr)
                 out[name] = self._to_compute(self.master[name])
         return out
+
+    def grads_to_host(self, grads_tree) -> Dict[str, np.ndarray]:
+        """Device grads → host arrays in the masters' layout (global dense
+        for fully-addressable params, ordered local shards otherwise).
+        All transfers ride ONE batched device_get."""
+        flat = _flatten_with_paths(grads_tree)
+        dense = {n: leaf for n, leaf in flat.items()
+                 if self._shard_meta.get(n) is None}
+        shard_bufs: List[Any] = []
+        slots: Dict[str, List[int]] = {}
+        for name, leaf in flat.items():
+            meta = self._shard_meta.get(name)
+            if meta is not None:
+                slots[name] = meta.collect(leaf, shard_bufs)
+        host_dense, host_bufs = jax.device_get((dense, shard_bufs))
+        out: Dict[str, np.ndarray] = {}
+        for name in flat:
+            if name in slots:
+                out[name] = np.concatenate(
+                    [np.asarray(host_bufs[i]).reshape(-1)
+                     for i in slots[name]])
+            else:
+                out[name] = host_dense[name]
+        return out
+
+    def images_to_device(self, images: Dict[str, np.ndarray], treedef,
+                         shardings):
+        """Updated compute-dtype images → device param tree.  Sharded
+        entries are rebuilt as global arrays from per-device buffers."""
+        shard_leaves = treedef.flatten_up_to(shardings)
+        arrs = []
+        for name, sh in zip(self._names, shard_leaves):
+            meta = self._shard_meta.get(name)
+            img = images[name]
+            if meta is None:
+                arrs.append(jax.device_put(img, sh))
+                continue
+            bufs = []
+            off = 0
+            for (_k, _idx, shape, devices) in meta.parts:
+                n = int(np.prod(shape))
+                part = np.ascontiguousarray(
+                    np.asarray(img)[off:off + n].reshape(shape))
+                off += n
+                for d in devices:
+                    bufs.append(jax.device_put(part, d))
+            arrs.append(jax.make_array_from_single_device_arrays(
+                meta.global_shape, sh, bufs))
+        return jax.tree_util.tree_unflatten(treedef, arrs)
 
     def _prep_grad(self, grad: np.ndarray, grad_scale: float) -> np.ndarray:
         g = np.asarray(grad, np.float32).reshape(-1)
