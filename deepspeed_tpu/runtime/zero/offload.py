@@ -71,8 +71,9 @@ class _ShardMeta:
         return slots
 
 
-def _leaf_to_host(leaf, force_sharded: bool):
-    """leaf → (flat-or-dense host np array, _ShardMeta | None)."""
+def _leaf_meta(leaf, force_sharded: bool):
+    """leaf → _ShardMeta for shard-local storage, or None for dense.
+    Reads only shard metadata (shapes/indices/devices) — no transfers."""
     if isinstance(leaf, jax.Array) and (force_sharded or
                                         not leaf.is_fully_addressable):
         uniq: Dict[Tuple, Any] = {}
@@ -81,13 +82,11 @@ def _leaf_to_host(leaf, force_sharded: bool):
             k = _index_key(s.index)
             devices.setdefault(k, []).append(s.device)
             if k not in uniq:
-                uniq[k] = (s.index, np.asarray(s.data))
-        parts = [(k, idx, data.shape, devices[k])
-                 for k, (idx, data) in uniq.items()]
-        flat = np.concatenate([np.asarray(uniq[k][1]).reshape(-1)
-                               for (k, *_r) in parts])
-        return flat, _ShardMeta(leaf.shape, parts)
-    return np.asarray(jax.device_get(leaf)), None
+                uniq[k] = (s.index, tuple(s.data.shape))
+        parts = [(k, idx, shape, devices[k])
+                 for k, (idx, shape) in uniq.items()]
+        return _ShardMeta(leaf.shape, parts)
+    return None
 
 
 class HostOffloadOptimizer:
@@ -133,9 +132,23 @@ class HostOffloadOptimizer:
         force = os.environ.get("DSTPU_FORCE_SHARD_OFFLOAD") == "1"
         flat = _flatten_with_paths(params_device)
         self._shard_meta: Dict[str, Optional[_ShardMeta]] = {}
-        host = {}
+        sink: List[Any] = []           # ONE batched D2H over all leaves
+        slots: Dict[str, Any] = {}
         for name, leaf in flat.items():
-            host[name], self._shard_meta[name] = _leaf_to_host(leaf, force)
+            meta = _leaf_meta(leaf, force)
+            self._shard_meta[name] = meta
+            if meta is None:
+                slots[name] = len(sink)
+                sink.append(leaf)
+            else:
+                slots[name] = meta.collect(leaf, sink)
+        host_bufs = jax.device_get(sink)
+        host = {}
+        for name in flat:
+            s = slots[name]
+            host[name] = np.asarray(host_bufs[s]) if isinstance(s, int) \
+                else np.concatenate([np.asarray(host_bufs[i]).reshape(-1)
+                                     for i in s])
         for i, (name, arr) in enumerate(host.items()):
             master = np.asarray(arr, np.float32)
             moments = self._zero_moments(master)
@@ -334,6 +347,18 @@ class HostOffloadOptimizer:
         self.step_count = int(sd["step"])
         for i, name in enumerate(self._names):
             entry = sd["state"][name]
+            cur_shape = None
+            if self._swapper is None:
+                cur_shape = self.master[name].shape
+            if cur_shape is not None and \
+                    tuple(np.shape(entry["master"])) != tuple(cur_shape):
+                raise ValueError(
+                    f"offload checkpoint layout mismatch for {name!r}: "
+                    f"saved master shape {np.shape(entry['master'])} vs "
+                    f"current {tuple(cur_shape)} — the checkpoint was "
+                    "written under a different shard layout (dense vs "
+                    "shard-local); re-init with the matching "
+                    "process topology / DSTPU_FORCE_SHARD_OFFLOAD setting")
             if self._swapper is not None:
                 self._swapper.swap_out_group(i, {k: np.asarray(v)
                                                  for k, v in entry.items()})
