@@ -43,6 +43,21 @@ class InferenceEngine:
             config = DeepSpeedInferenceConfig(**(config or {}))
         self._config = config
         self.dtype = config.jax_dtype()
+        # int8 = weight-only quantization (reference GroupQuantizer path,
+        # module_inject/replace_module.py:140): HBM holds int8 weights +
+        # per-column scales, compute runs in bf16 on per-layer dequantized
+        # tiles (see models/base.dequant_block)
+        self.weight_quant = bool(config.quant.enabled)
+        if self.dtype == jnp.int8:
+            self.weight_quant = True
+            self.dtype = jnp.bfloat16
+        if self.weight_quant:
+            if config.quant.bits != 8:
+                raise ValueError(
+                    f"weight quantization supports bits=8 only "
+                    f"(got {config.quant.bits})")
+            log_dist("weight quantization uses per-layer per-output-column "
+                     "scales; quant.group_size is ignored", ranks=[0])
 
         # HF torch module → (ModelSpec, params) via policy (module_inject analog)
         if _is_torch_module(model):
@@ -70,6 +85,17 @@ class InferenceEngine:
         if params is None:
             params = jax.jit(model.init)(jax.random.PRNGKey(config.seed))
         self.params = self._shard_and_cast(params)
+        if self.weight_quant and not getattr(self.module,
+                                             "supports_weight_quant", False):
+            log_dist("int8 weight quantization requested but "
+                     f"{type(self.module).__name__} does not support "
+                     "dequant blocks; serving unquantized", ranks=[0])
+            self.weight_quant = False
+        if self.weight_quant:
+            self.params, n_q = self._quantize_block_weights(self.params)
+            log_dist(f"weight-only int8: quantized {n_q} block weight "
+                     "tensors (per-layer, per-output-column scales)",
+                     ranks=[0])
 
         self._compiled: Dict[Tuple, Any] = {}
         self._gen_rng = jax.random.PRNGKey(config.seed)
@@ -89,6 +115,37 @@ class InferenceEngine:
             return jax.device_put(arr, NamedSharding(self.mesh, spec))
 
         return jax.tree_util.tree_map(put, params, specs)
+
+    def _quantize_block_weights(self, params):
+        """Quantize scanned-block matmul weights ([L, in, out] float leaves
+        under a 'blocks' subtree) to int8 with [L, 1, out] fp32 scales."""
+        from deepspeed_tpu.compression.quantize import quantize_int8
+
+        count = 0
+
+        @jax.jit
+        def q(leaf):
+            # per-layer (vmap over L), per-output-column scales
+            qv, scale = jax.vmap(
+                lambda w: quantize_int8(w, per_channel_axis=1))(leaf)
+            return {"__q__": qv, "__scale__": scale}
+
+        def walk(tree, in_blocks=False):
+            nonlocal count
+            if isinstance(tree, dict):
+                out = {}
+                for k, v in tree.items():
+                    if in_blocks and hasattr(v, "ndim") and v.ndim == 3 and \
+                            v.dtype in (jnp.float32, jnp.bfloat16,
+                                        jnp.float16) and min(v.shape[1:]) >= 16:
+                        out[k] = q(v)
+                        count += 1
+                    else:
+                        out[k] = walk(v, in_blocks or k == "blocks")
+                return out
+            return tree
+
+        return walk(params), count
 
     def _load_checkpoint_params(self, checkpoint):
         """Load from this framework's sharding-agnostic engine checkpoint

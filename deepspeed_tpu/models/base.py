@@ -71,6 +71,25 @@ def gelu(x):
     return jax.nn.gelu(x, approximate=True)
 
 
+def dequant_block(blk, dtype):
+    """Weight-only-quantized serving support (reference GroupQuantizer int8
+    path, module_inject/replace_module.py:140): the inference engine may
+    replace a block weight with ``{"__q__": int8, "__scale__": fp32}``;
+    inside the layer scan this dequantizes the CURRENT layer's slice only,
+    so HBM holds int8 while compute sees a transient dtype tile."""
+    if not isinstance(blk, dict):
+        return blk
+    from deepspeed_tpu.compression.quantize import dequantize_int8
+
+    out = {}
+    for k, v in blk.items():
+        if isinstance(v, dict) and "__q__" in v:
+            out[k] = dequantize_int8(v["__q__"], v["__scale__"], dtype)
+        else:
+            out[k] = v
+    return out
+
+
 def cross_entropy_loss(logits, labels, ignore_index: int = -100):
     """Token-level CE in fp32 with masking; returns (mean_loss, n_valid)."""
     logits = logits.astype(jnp.float32)

@@ -21,7 +21,7 @@ from typing import Any, Dict, Optional
 import jax
 import jax.numpy as jnp
 
-from deepspeed_tpu.models.base import cross_entropy_loss, layer_norm
+from deepspeed_tpu.models.base import cross_entropy_loss, dequant_block, layer_norm
 from deepspeed_tpu.ops.attention import multihead_attention
 
 _ACTS = {
@@ -76,6 +76,8 @@ class BertConfig:
 
 class BertModel:
     """Encoder ModelSpec with MLM ("mlm") or classification ("cls") head."""
+
+    supports_weight_quant = True   # blocks call dequant_block
 
     def __init__(self, config: BertConfig, compute_dtype=jnp.bfloat16,
                  head: str = "mlm", remat: bool = False):
@@ -164,6 +166,7 @@ class BertModel:
 
     # ------------------------------------------------------------------ block
     def _block(self, x, blk, mask_bias):
+        blk = dequant_block(blk, x.dtype)
         c = self.config
         b, t, d = x.shape
         h, dh = c.num_heads, c.head_dim

@@ -20,7 +20,7 @@ from typing import Any, Dict, Optional
 import jax
 import jax.numpy as jnp
 
-from deepspeed_tpu.models.base import ATTN_IMPLS, cross_entropy_loss, gelu, layer_norm, sp_attention
+from deepspeed_tpu.models.base import ATTN_IMPLS, cross_entropy_loss, dequant_block, gelu, layer_norm, sp_attention
 from deepspeed_tpu.ops.attention import attention_with_kv_cache, multihead_attention
 
 
@@ -65,6 +65,8 @@ class GPT2Config:
 
 class GPT2Model:
     """Causal-LM ModelSpec. batch = {"input_ids": [B,T] int32, "labels": [B,T]}."""
+
+    supports_weight_quant = True   # blocks call dequant_block
 
     def __init__(self, config: GPT2Config, compute_dtype=jnp.bfloat16,
                  remat: bool = False, remat_policy: Optional[str] = None,
@@ -136,6 +138,7 @@ class GPT2Model:
         """One transformer block; with ``cache=(kc, vc, idx)`` the attention
         runs against the KV cache (one shared implementation so training and
         serving can never diverge numerically)."""
+        blk = dequant_block(blk, x.dtype)
         c = self.config
         b, t, d = x.shape
         h, dh = c.num_heads, c.head_dim

@@ -14,7 +14,7 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 
-from deepspeed_tpu.models.base import ATTN_IMPLS, cross_entropy_loss, rms_norm, sp_attention
+from deepspeed_tpu.models.base import ATTN_IMPLS, cross_entropy_loss, dequant_block, rms_norm, sp_attention
 from deepspeed_tpu.ops.attention import attention_with_kv_cache, multihead_attention
 from deepspeed_tpu.ops.rotary import apply_rotary_pos_emb, rope_frequencies
 
@@ -62,6 +62,8 @@ class LlamaConfig:
 
 class LlamaModel:
     """Causal-LM ModelSpec: batch = {"input_ids": [B,T], "labels": [B,T]}."""
+
+    supports_weight_quant = True   # blocks call dequant_block
 
     def __init__(self, config: LlamaConfig, compute_dtype=jnp.bfloat16,
                  remat: bool = False, remat_policy: Optional[str] = None,
@@ -118,6 +120,7 @@ class LlamaModel:
     def _block_impl(self, x, blk, cos, sin, train: bool, cache):
         """One LLaMA block; with ``cache=(kc, vc, idx)`` attention runs against
         the GQA KV cache (shared implementation for train + serving)."""
+        blk = dequant_block(blk, x.dtype)
         c = self.config
         b, t, d = x.shape
         hq, hkv, dh = c.num_heads, c.num_kv_heads, c.head_dim

@@ -28,7 +28,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from deepspeed_tpu.models.base import cross_entropy_loss, gelu, layer_norm
+from deepspeed_tpu.models.base import cross_entropy_loss, dequant_block, gelu, layer_norm
 from deepspeed_tpu.ops.attention import attention_with_kv_cache, multihead_attention
 from deepspeed_tpu.ops.rotary import apply_rotary_pos_emb, rope_frequencies
 
@@ -130,6 +130,8 @@ class DecoderConfig:
 
 class DecoderModel:
     """Causal-LM ModelSpec. batch = {"input_ids": [B,T], "labels": [B,T]}."""
+
+    supports_weight_quant = True   # blocks call dequant_block
 
     def __init__(self, config: DecoderConfig, compute_dtype=jnp.bfloat16,
                  remat: bool = False, remat_policy: Optional[str] = None):
@@ -266,6 +268,7 @@ class DecoderModel:
         return q, k_, v_
 
     def _block_impl(self, x, blk, cache, local_flag=None):
+        blk = dequant_block(blk, x.dtype)
         c = self.config
         b, t, d = x.shape
         idx = cache[2] if cache is not None else 0

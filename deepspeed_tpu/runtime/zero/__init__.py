@@ -5,6 +5,7 @@ from .config import (
     ZeroStageEnum,
 )
 from .partition import PartitionPlan
+from .tiling import chunked_cross_entropy, tiled_linear
 
 
 class Init:
@@ -19,6 +20,23 @@ class Init:
 
     def __enter__(self):
         return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+class GatheredParameters:
+    """API-parity shim for ``deepspeed.zero.GatheredParameters``
+    (reference partition_parameters.py:1500). ZeRO-3 sharded params here
+    are ordinary global ``jax.Array``s — any read already sees the full
+    logical value and writes happen functionally through the engine — so
+    gathering is a no-op; the context exists for source compatibility."""
+
+    def __init__(self, params=None, modifier_rank=None, *args, **kwargs):
+        self.params = params
+
+    def __enter__(self):
+        return self.params
 
     def __exit__(self, *exc):
         return False

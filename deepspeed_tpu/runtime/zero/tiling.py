@@ -1,0 +1,102 @@
+"""Memory-tiled linear algebra (ZeRO tiling analog).
+
+Reference: ``runtime/zero/tiling.py TiledLinear`` (296 LoC) splits a big
+Linear into a tile grid so no full-size activation/weight intermediate
+ever exists, and ``runtime/zero/linear.py`` re-implements Linear's autograd
+to save memory.  On TPU the second is simply ``jax.checkpoint``; the first
+maps to ``lax.scan`` over weight tiles — XLA then allocates tile-sized
+intermediates instead of the full output/weight, trading FLOP-pipeline
+efficiency for peak-memory, exactly the reference's trade.
+
+The highest-value instance is the LM head: ``chunked_cross_entropy``
+computes softmax-CE against a [V, D] embedding without materializing the
+[B, T, V] logits (the dominant activation for 50k+ vocabularies) by
+scanning sequence chunks.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def tiled_linear(x: jax.Array, w: jax.Array, b: Optional[jax.Array] = None,
+                 *, out_tiles: int = 1, in_tiles: int = 1) -> jax.Array:
+    """y = x @ w (+ b) with the contraction and/or output dim processed in
+    tiles (reference TiledLinear's tile grid, as scans).
+
+    x: [..., K], w: [K, N] → [..., N].  ``in_tiles`` must divide K,
+    ``out_tiles`` must divide N.
+    """
+    k, n = w.shape
+    assert k % in_tiles == 0, (k, in_tiles)
+    assert n % out_tiles == 0, (n, out_tiles)
+    kt, nt = k // in_tiles, n // out_tiles
+
+    def out_tile(j):
+        wj = jax.lax.dynamic_slice_in_dim(w, j * nt, nt, axis=1)
+        if in_tiles == 1:
+            return x @ wj.astype(x.dtype)
+
+        def in_step(acc, i):
+            xi = jax.lax.dynamic_slice_in_dim(x, i * kt, kt, axis=-1)
+            wij = jax.lax.dynamic_slice_in_dim(wj, i * kt, kt, axis=0)
+            part = jnp.matmul(xi, wij.astype(x.dtype),
+                              preferred_element_type=jnp.float32)
+            return acc + part, None
+
+        # accumulate across tiles in fp32 — a dense matmul accumulates in
+        # fp32 on the MXU, and per-tile bf16 rounding would drift
+        acc0 = jnp.zeros(x.shape[:-1] + (nt,), jnp.float32)
+        acc, _ = jax.lax.scan(in_step, acc0, jnp.arange(in_tiles))
+        return acc.astype(x.dtype)
+
+    if out_tiles == 1:
+        y = out_tile(0)
+    else:
+        _, tiles = jax.lax.scan(lambda c, j: (c, out_tile(j)), None,
+                                jnp.arange(out_tiles))
+        # [out_tiles, ..., nt] → [..., n]
+        y = jnp.moveaxis(tiles, 0, -2).reshape(x.shape[:-1] + (n,))
+    if b is not None:
+        y = y + b.astype(y.dtype)
+    return y
+
+
+def chunked_cross_entropy(hidden: jax.Array, embed: jax.Array,
+                          labels: jax.Array, *, chunk: int = 128,
+                          ignore_index: int = -100
+                          ) -> Tuple[jax.Array, jax.Array]:
+    """Tied-LM-head softmax cross-entropy without [B, T, V] logits.
+
+    hidden: [B, T, D]; embed: [V, D] (tied embedding); labels: [B, T].
+    Scans T in ``chunk``-sized slices: peak logit memory is B*chunk*V.
+    Returns (mean loss over scored tokens, scored-token count) matching
+    models/base.cross_entropy_loss semantics (label==ignore_index skipped).
+    """
+    b, t, d = hidden.shape
+    assert t % chunk == 0, (t, chunk)
+    steps = t // chunk
+    hs = hidden.reshape(b, steps, chunk, d).swapaxes(0, 1)   # [S, B, c, D]
+    ls = labels.reshape(b, steps, chunk).swapaxes(0, 1)      # [S, B, c]
+
+    def step(carry, sl):
+        loss_sum, count = carry
+        h, lab = sl
+        logits = jnp.einsum("bcd,vd->bcv", h,
+                            embed.astype(h.dtype)).astype(jnp.float32)
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        valid = lab != ignore_index
+        safe = jnp.where(valid, lab, 0)
+        nll = -jnp.take_along_axis(logp, safe[..., None], axis=-1)[..., 0]
+        loss_sum = loss_sum + jnp.sum(jnp.where(valid, nll, 0.0))
+        count = count + jnp.sum(valid)
+        return (loss_sum, count), None
+
+    (loss_sum, count), _ = jax.lax.scan(
+        step, (jnp.zeros((), jnp.float32), jnp.zeros((), jnp.int32)),
+        (hs, ls))
+    count = jnp.maximum(count, 1)   # match base.cross_entropy_loss exactly
+    return loss_sum / count, count

@@ -201,3 +201,36 @@ def test_unknown_hf_arch_raises():
 
     with pytest.raises(ValueError, match="no inference policy"):
         deepspeed_tpu.init_inference(Mystery(), dtype="fp32")
+
+
+def test_int8_weight_only_serving():
+    """dtype='int8' = weight-only quantization (reference GroupQuantizer):
+    int8 block weights + per-column scales in HBM, bf16 compute, logits
+    close to the full-precision model."""
+    torch = pytest.importorskip("torch")
+    transformers = pytest.importorskip("transformers")
+
+    hf_cfg = transformers.GPT2Config(
+        vocab_size=128, n_positions=64, n_embd=32, n_layer=2, n_head=4)
+    torch.manual_seed(0)   # absolute tolerances below need fixed weights
+    with torch.no_grad():
+        hf = transformers.GPT2LMHeadModel(hf_cfg).eval()
+    ids = np.random.RandomState(0).randint(0, 128, (2, 10))
+
+    ref_engine = deepspeed_tpu.init_inference(hf, dtype="fp32")
+    ref = np.asarray(ref_engine.forward(ids.astype(np.int32))
+                     .astype(jnp.float32))
+    from deepspeed_tpu.utils import groups
+    groups.reset()
+    engine = deepspeed_tpu.init_inference(hf, dtype="int8")
+    assert engine.weight_quant and engine.dtype == jnp.bfloat16
+    qkv = engine.params["blocks"]["qkv_w"]
+    assert isinstance(qkv, dict) and qkv["__q__"].dtype == jnp.int8
+    assert qkv["__scale__"].shape == (2, 1, 96)
+    ours = np.asarray(engine.forward(ids.astype(np.int32))
+                      .astype(jnp.float32))
+    # int8 weights + bf16 compute: loose but meaningful tolerance
+    assert np.abs(ours - ref).max() < 0.15, np.abs(ours - ref).max()
+    # greedy argmax should be stable under weight-only quantization
+    agree = (ours.argmax(-1) == ref.argmax(-1)).mean()
+    assert agree > 0.9, agree

@@ -1,0 +1,88 @@
+"""ZeRO tiling analogs (reference runtime/zero/tiling.py TiledLinear,
+runtime/zero/linear.py): tile-scanned matmul and the chunked LM-head loss.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepspeed_tpu.runtime.zero import (
+    GatheredParameters,
+    chunked_cross_entropy,
+    tiled_linear,
+)
+from deepspeed_tpu.models.base import cross_entropy_loss
+
+
+class TestTiledLinear:
+    @pytest.mark.parametrize("out_tiles,in_tiles",
+                             [(1, 1), (4, 1), (1, 4), (2, 8)])
+    def test_matches_dense(self, out_tiles, in_tiles):
+        rng = np.random.RandomState(0)
+        x = rng.randn(3, 5, 16).astype(np.float32)
+        w = rng.randn(16, 24).astype(np.float32)
+        b = rng.randn(24).astype(np.float32)
+        ref = x @ w + b
+        out = jax.jit(lambda x, w, b: tiled_linear(
+            x, w, b, out_tiles=out_tiles, in_tiles=in_tiles))(x, w, b)
+        np.testing.assert_allclose(np.asarray(out), ref, atol=1e-4,
+                                   rtol=1e-4)
+
+    def test_grad_flows(self):
+        rng = np.random.RandomState(1)
+        x = jnp.asarray(rng.randn(2, 8).astype(np.float32))
+        w = jnp.asarray(rng.randn(8, 12).astype(np.float32))
+
+        def loss_tiled(w):
+            return tiled_linear(x, w, out_tiles=3, in_tiles=2).sum()
+
+        def loss_dense(w):
+            return (x @ w).sum()
+
+        gt = jax.grad(loss_tiled)(w)
+        gd = jax.grad(loss_dense)(w)
+        np.testing.assert_allclose(np.asarray(gt), np.asarray(gd),
+                                   atol=1e-4, rtol=1e-4)
+
+
+class TestChunkedCrossEntropy:
+    def test_matches_dense_ce(self):
+        rng = np.random.RandomState(2)
+        b, t, d, v = 2, 16, 8, 32
+        hidden = jnp.asarray(rng.randn(b, t, d).astype(np.float32))
+        embed = jnp.asarray(rng.randn(v, d).astype(np.float32))
+        labels = rng.randint(0, v, size=(b, t))
+        labels[0, :3] = -100                       # ignore_index holes
+        labels = jnp.asarray(labels)
+        logits = jnp.einsum("btd,vd->btv", hidden, embed)
+        ref_loss, ref_n = cross_entropy_loss(logits, labels)
+        loss, n = jax.jit(
+            lambda h, e, l: chunked_cross_entropy(h, e, l, chunk=4))(
+            hidden, embed, labels)
+        assert int(n) == int(ref_n)
+        np.testing.assert_allclose(float(loss), float(ref_loss), rtol=1e-5)
+
+    def test_grad_matches_dense(self):
+        rng = np.random.RandomState(3)
+        b, t, d, v = 2, 8, 4, 16
+        hidden = jnp.asarray(rng.randn(b, t, d).astype(np.float32))
+        embed = jnp.asarray(rng.randn(v, d).astype(np.float32))
+        labels = jnp.asarray(rng.randint(0, v, size=(b, t)))
+
+        def dense(e):
+            return cross_entropy_loss(
+                jnp.einsum("btd,vd->btv", hidden, e), labels)[0]
+
+        def chunked(e):
+            return chunked_cross_entropy(hidden, e, labels, chunk=2)[0]
+
+        np.testing.assert_allclose(np.asarray(jax.grad(chunked)(embed)),
+                                   np.asarray(jax.grad(dense)(embed)),
+                                   atol=1e-5, rtol=1e-4)
+
+
+def test_gathered_parameters_shim():
+    p = {"w": jnp.ones((2, 2))}
+    with GatheredParameters(p, modifier_rank=0) as g:
+        assert g is p
