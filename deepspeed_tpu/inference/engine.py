@@ -87,10 +87,14 @@ class InferenceEngine:
         self.params = self._shard_and_cast(params)
         if self.weight_quant and not getattr(self.module,
                                              "supports_weight_quant", False):
-            log_dist("int8 weight quantization requested but "
-                     f"{type(self.module).__name__} does not support "
-                     "dequant blocks; serving unquantized", ranks=[0])
-            self.weight_quant = False
+            # an explicit int8 request that cannot be honored must fail
+            # loudly — silently serving bf16 would use ~4x the HBM the
+            # deployment was sized for
+            raise ValueError(
+                f"int8 weight quantization requested but "
+                f"{type(self.module).__name__} does not support dequant "
+                "blocks (models must call models/base.dequant_block in "
+                "their block scan and set supports_weight_quant = True)")
         if self.weight_quant:
             self.params, n_q = self._quantize_block_weights(self.params)
             log_dist(f"weight-only int8: quantized {n_q} block weight "

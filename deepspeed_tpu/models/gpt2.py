@@ -35,6 +35,10 @@ class GPT2Config:
     dropout: float = 0.0
     tie_embeddings: bool = True
     eps: float = 1e-5
+    # >0: compute the LM loss in sequence chunks of this size without ever
+    # materializing [B, T, V] logits (runtime/zero/tiling.py — the memory
+    # win matters from ~50k vocab; requires tie_embeddings)
+    loss_chunk: int = 0
 
     @property
     def head_dim(self) -> int:
@@ -80,6 +84,9 @@ class GPT2Model:
             raise ValueError(
                 f"attn_impl={attn_impl!r} does not implement attention dropout; "
                 f"set dropout=0.0 or use attn_impl='dense'")
+        if config.loss_chunk and not config.tie_embeddings:
+            raise ValueError("loss_chunk requires tie_embeddings (the "
+                             "chunked LM loss projects through wte)")
         self.attn_impl = attn_impl
 
     # ------------------------------------------------------------------- init
@@ -231,8 +238,17 @@ class GPT2Model:
               pld_theta=None):
         hidden = self.forward_hidden(params, batch["input_ids"], rngs=rngs,
                                      train=train, pld_theta=pld_theta)
-        logits = self.logits(params, hidden)
-        loss, n = cross_entropy_loss(logits, batch["labels"])
+        c = self.config
+        if c.loss_chunk:
+            from deepspeed_tpu.runtime.zero.tiling import (
+                chunked_cross_entropy)
+
+            loss, n = chunked_cross_entropy(hidden, params["wte"],
+                                            batch["labels"],
+                                            chunk=c.loss_chunk)
+        else:
+            loss, n = cross_entropy_loss(self.logits(params, hidden),
+                                         batch["labels"])
         return loss, {"loss": loss, "ntokens": n}
 
     # --------------------------------------------------------- inference path

@@ -255,6 +255,12 @@ class DeepSpeedConfig:
         self.aio_config = AIOConfig(**d.get("aio", {}))
         self.hybrid_engine = HybridEngineConfig(**d.get("hybrid_engine", {}))
         self.pld_config = PLDConfig(**d.get("progressive_layer_drop", {}))
+        # legacy curriculum learning (reference config.py
+        # curriculum_enabled_legacy; engine.py:1653 injects curriculum_seqlen)
+        cl = d.get("curriculum_learning", {})
+        self.curriculum_enabled_legacy = bool(cl.get("enabled", False))
+        self.curriculum_params_legacy = {k: v for k, v in cl.items()
+                                         if k != "enabled"}
         self.dataloader_drop_last = d.get(C.DATALOADER_DROP_LAST, C.DATALOADER_DROP_LAST_DEFAULT)
 
         # ---------------- misc ------------------------------------------------

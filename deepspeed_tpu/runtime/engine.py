@@ -204,6 +204,19 @@ class DeepSpeedEngine:
         self._sync_each_step = (self.accelerator.name() == "cpu" and
                                 os.environ.get("DSTPU_SYNC_EACH_STEP") != "0")
 
+        # ---- legacy curriculum learning (engine.py:1653 curriculum_seqlen
+        # injection): batches are truncated host-side to the scheduled
+        # seqlen. Each DISTINCT seqlen compiles once, so the difficulty
+        # step should be a multiple of a reasonable tile (reference tells
+        # users the same for attention kernels).
+        self.curriculum_scheduler = None
+        if config.curriculum_enabled_legacy:
+            from deepspeed_tpu.runtime.data_pipeline import (
+                CurriculumScheduler)
+
+            self.curriculum_scheduler = CurriculumScheduler(
+                config.curriculum_params_legacy)
+
         # ---- counters (reference engine attrs)
         self.micro_steps = 0
         self.global_steps = 0
@@ -643,6 +656,7 @@ class DeepSpeedEngine:
         self.timers(TRAIN_BATCH_TIMER).start()
         lr = jnp.asarray(self.get_lr()[0], jnp.float32)
         rng = jax.random.fold_in(self._dropout_rng, self.global_steps)
+        batch = self._apply_curriculum(batch)
         batch = jax.device_put(batch, self._gas_batch_shardings(batch))
         if self._use_pld:
             theta = jnp.asarray(self.progressive_layer_drop.get_theta(),
@@ -671,6 +685,7 @@ class DeepSpeedEngine:
         self.timers(TRAIN_BATCH_TIMER).start()
         lr = self.get_lr()[0]
         rng = jax.random.fold_in(self._dropout_rng, self.global_steps)
+        batch = self._apply_curriculum(batch)
         batch = jax.device_put(batch, self._gas_batch_shardings(batch))
         grads, metrics = self._compiled_grad_step(self.state, batch, rng)
         overflow = bool(jax.device_get(metrics["overflow"]))
@@ -687,6 +702,27 @@ class DeepSpeedEngine:
         if self._sync_each_step:
             jax.block_until_ready(self.state.params)
         return metrics["loss"]
+
+    def _apply_curriculum(self, batch):
+        """Legacy curriculum: truncate sequences to the scheduled difficulty
+        (reference engine.py:1653-1656 curriculum_seqlen). Host-side slicing
+        — each distinct seqlen is one compile."""
+        if self.curriculum_scheduler is None:
+            return batch
+        seqlen = self.curriculum_scheduler.update_difficulty(
+            self.global_steps + 1)
+        seq_keys = {"input_ids", "labels", "attention_mask",
+                    "token_type_ids", "position_ids"}
+
+        def trunc(node):
+            if isinstance(node, dict):
+                return {k: (v[..., :seqlen]
+                            if k in seq_keys and hasattr(v, "ndim") and
+                            v.ndim >= 2 else trunc(v))
+                        for k, v in node.items()}
+            return node
+
+        return trunc(batch)
 
     def _after_step(self, metrics):
         if self.progressive_layer_drop is not None:

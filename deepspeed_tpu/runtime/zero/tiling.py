@@ -73,30 +73,38 @@ def chunked_cross_entropy(hidden: jax.Array, embed: jax.Array,
 
     hidden: [B, T, D]; embed: [V, D] (tied embedding); labels: [B, T].
     Scans T in ``chunk``-sized slices: peak logit memory is B*chunk*V.
+    A non-divisible tail (e.g. under curriculum-truncated seqlens) is
+    processed as one smaller chunk — the memory bound still holds.
     Returns (mean loss over scored tokens, scored-token count) matching
     models/base.cross_entropy_loss semantics (label==ignore_index skipped).
     """
     b, t, d = hidden.shape
-    assert t % chunk == 0, (t, chunk)
-    steps = t // chunk
-    hs = hidden.reshape(b, steps, chunk, d).swapaxes(0, 1)   # [S, B, c, D]
-    ls = labels.reshape(b, steps, chunk).swapaxes(0, 1)      # [S, B, c]
+    chunk = min(chunk, t)
 
-    def step(carry, sl):
-        loss_sum, count = carry
-        h, lab = sl
+    def piece(h, lab):
         logits = jnp.einsum("bcd,vd->bcv", h,
                             embed.astype(h.dtype)).astype(jnp.float32)
         logp = jax.nn.log_softmax(logits, axis=-1)
         valid = lab != ignore_index
         safe = jnp.where(valid, lab, 0)
         nll = -jnp.take_along_axis(logp, safe[..., None], axis=-1)[..., 0]
-        loss_sum = loss_sum + jnp.sum(jnp.where(valid, nll, 0.0))
-        count = count + jnp.sum(valid)
-        return (loss_sum, count), None
+        return jnp.sum(jnp.where(valid, nll, 0.0)), jnp.sum(valid)
+
+    steps = t // chunk
+    main_t = steps * chunk
+    hs = hidden[:, :main_t].reshape(b, steps, chunk, d).swapaxes(0, 1)
+    ls = labels[:, :main_t].reshape(b, steps, chunk).swapaxes(0, 1)
+
+    def step(carry, sl):
+        loss_sum, count = carry
+        ps, pc = piece(*sl)
+        return (loss_sum + ps, count + pc), None
 
     (loss_sum, count), _ = jax.lax.scan(
         step, (jnp.zeros((), jnp.float32), jnp.zeros((), jnp.int32)),
         (hs, ls))
+    if main_t < t:                                    # tail chunk
+        ps, pc = piece(hidden[:, main_t:], labels[:, main_t:])
+        loss_sum, count = loss_sum + ps, count + pc
     count = jnp.maximum(count, 1)   # match base.cross_entropy_loss exactly
     return loss_sum / count, count

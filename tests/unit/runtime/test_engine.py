@@ -174,3 +174,28 @@ class TestOnebitEnginePath:
         assert not engine.optimizer.with_compression
         losses = losses_decrease(engine, steps=4)
         assert losses[-1] < losses[0]
+
+
+def test_legacy_curriculum_seqlen():
+    """curriculum_learning config truncates input_ids/labels to the
+    scheduled seqlen (reference engine.py:1653 curriculum_seqlen)."""
+    import deepspeed_tpu
+    from deepspeed_tpu.models.gpt2 import GPT2Config, GPT2Model
+
+    cfg = GPT2Config(vocab_size=64, max_seq_len=16, num_layers=2,
+                     hidden_size=32, num_heads=2)
+    engine, *_ = deepspeed_tpu.initialize(
+        model=GPT2Model(cfg), config=base_config(curriculum_learning={
+            "enabled": True, "curriculum_type": "seqlen",
+            "schedule_type": "fixed_linear",
+            "schedule_config": {"min_difficulty": 4, "max_difficulty": 16,
+                                "total_curriculum_step": 4,
+                                "difficulty_step": 4}}))
+    assert engine.curriculum_scheduler is not None
+    rng = np.random.RandomState(0)
+    for i in range(5):
+        ids = rng.randint(0, 64, size=(1, 16, 17)).astype(np.int32)
+        batch = {"input_ids": ids[:, :, :-1], "labels": ids[:, :, 1:]}
+        loss = engine.train_batch_from_stacked(batch)
+        assert np.isfinite(float(np.asarray(loss)))
+    assert engine.curriculum_scheduler.get_current_difficulty() == 16

@@ -86,3 +86,36 @@ def test_gathered_parameters_shim():
     p = {"w": jnp.ones((2, 2))}
     with GatheredParameters(p, modifier_rank=0) as g:
         assert g is p
+
+
+def test_gpt2_loss_chunk_matches_dense():
+    """GPT2Config.loss_chunk routes the LM loss through
+    chunked_cross_entropy — same loss as the dense path."""
+    from deepspeed_tpu.models.gpt2 import GPT2Config, GPT2Model
+
+    rng = jax.random.PRNGKey(0)
+    dense_m = GPT2Model(GPT2Config.tiny(), compute_dtype=jnp.float32)
+    chunk_m = GPT2Model(GPT2Config.tiny(loss_chunk=8),
+                        compute_dtype=jnp.float32)
+    params = dense_m.init(rng)
+    ids = np.random.RandomState(0).randint(
+        0, dense_m.config.vocab_size, size=(2, 33)).astype(np.int32)
+    batch = {"input_ids": jnp.asarray(ids[:, :-1]),
+             "labels": jnp.asarray(ids[:, 1:])}
+    l_dense, _ = dense_m.apply(params, batch)
+    l_chunk, _ = chunk_m.apply(params, batch)
+    np.testing.assert_allclose(float(l_chunk), float(l_dense), rtol=1e-5)
+
+
+def test_chunked_ce_non_divisible_tail():
+    """Tail shorter than the chunk is processed as one smaller chunk."""
+    rng = np.random.RandomState(4)
+    b, t, d, v = 2, 13, 4, 16
+    hidden = jnp.asarray(rng.randn(b, t, d).astype(np.float32))
+    embed = jnp.asarray(rng.randn(v, d).astype(np.float32))
+    labels = jnp.asarray(rng.randint(0, v, size=(b, t)))
+    logits = jnp.einsum("btd,vd->btv", hidden, embed)
+    ref_loss, ref_n = cross_entropy_loss(logits, labels)
+    loss, n = chunked_cross_entropy(hidden, embed, labels, chunk=4)
+    assert int(n) == int(ref_n)
+    np.testing.assert_allclose(float(loss), float(ref_loss), rtol=1e-5)
