@@ -53,7 +53,8 @@ import pytest  # noqa: E402
 # process aborts (observed: tests/unit/model_parallelism after
 # tests/unit/inference). Ordering all jax-collective tests before the first
 # torch import sidesteps the interaction deterministically.
-_TORCH_MODULES = ("test_policies", "test_bert", "test_inference")
+_TORCH_MODULES = ("test_policies", "test_bert", "test_inference",
+                  "test_diffusion")
 
 
 def pytest_collection_modifyitems(config, items):
@@ -62,11 +63,23 @@ def pytest_collection_modifyitems(config, items):
 
 @pytest.fixture(autouse=True)
 def _reset_groups():
-    """Each test starts with fresh global topology state."""
+    """Each test starts with fresh global topology state, and no async
+    device work survives past its test: per-device queues are FIFO, so a
+    tiny blocked computation per device guarantees every straggler
+    dispatched by this test has completed before the next test's
+    collectives launch (cross-test stragglers have deadlocked
+    tests/unit/model_parallelism mid-suite on this 1-core host)."""
     from deepspeed_tpu.utils import groups
 
     groups.reset()
     yield
+    try:
+        import jax.numpy as jnp
+
+        arrs = [jax.device_put(jnp.zeros(()), d) for d in jax.devices()]
+        jax.block_until_ready([a + 1 for a in arrs])
+    except Exception:
+        pass
     groups.reset()
 
 
