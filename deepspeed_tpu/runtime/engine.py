@@ -179,6 +179,16 @@ class DeepSpeedEngine:
         self.state = self._init_state()
         self._dropout_rng = jax.random.fold_in(self._init_rng, 0x5eed)
 
+        # ---- debug/safe mode (SURVEY §5.2: the functional design makes
+        # distributed invariants checkable as placements — DSTPU_DEBUG=1)
+        from deepspeed_tpu.utils.debug import (
+            check_sharding_invariants, debug_mode_enabled)
+
+        self._debug_mode = debug_mode_enabled()
+        if self._debug_mode:
+            for p in check_sharding_invariants(self):
+                logger.warning("sharding invariant (post-init): %s", p)
+
         # ---- progressive layer drop (reference engine.py pld wiring)
         self.progressive_layer_drop = None
         self._use_pld = False
@@ -731,6 +741,13 @@ class DeepSpeedEngine:
 
     def _after_step_impl(self, metrics):
         cfg = self.config
+        if self._debug_mode and cfg.steps_per_print and \
+                self.global_steps % cfg.steps_per_print == 0:
+            from deepspeed_tpu.utils.debug import check_sharding_invariants
+
+            for p in check_sharding_invariants(self):
+                logger.warning("sharding invariant (step %d): %s",
+                               self.global_steps, p)
         # autotuning experiment: report throughput after warmup then exit
         # (reference exits inside engine.forward:1687-1691 once profiled)
         result_path = os.environ.get("DSTPU_AUTOTUNING_RESULT")

@@ -1,0 +1,43 @@
+"""Profiler range instrumentation — reference ``deepspeed/utils/nvtx.py:9
+instrument_w_nvtx`` (NVTX range push/pop around hot functions).
+
+On TPU the ranges are ``jax.profiler.TraceAnnotation`` scopes: they appear
+in Perfetto/XPlane traces captured with ``jax.profiler.start_trace`` the
+way NVTX ranges appear in Nsight.  The decorator name is kept for source
+compatibility; ``instrument_w_scope`` is the native-flavored alias.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+
+
+def instrument_w_nvtx(func):
+    """Wrap ``func`` in a named profiler trace annotation."""
+    name = getattr(func, "__qualname__", getattr(func, "__name__", "fn"))
+
+    @functools.wraps(func)
+    def wrapped(*args, **kwargs):
+        with jax.profiler.TraceAnnotation(name):
+            return func(*args, **kwargs)
+
+    return wrapped
+
+
+instrument_w_scope = instrument_w_nvtx
+
+
+def range_push(msg: str):
+    """Imperative form (reference accelerator range_push); prefer the
+    decorator or ``jax.profiler.TraceAnnotation`` as a context manager."""
+    from deepspeed_tpu.accelerator import get_accelerator
+
+    return get_accelerator().range_push(msg)
+
+
+def range_pop():
+    from deepspeed_tpu.accelerator import get_accelerator
+
+    return get_accelerator().range_pop()

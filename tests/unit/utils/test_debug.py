@@ -1,0 +1,108 @@
+"""Debug/safe-mode helpers (reference utils/debug.py + runtime/utils.py
+see_memory_usage; SURVEY §5.2 sharding-invariant checking the reference
+lacks)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import deepspeed_tpu
+from deepspeed_tpu.utils.debug import (
+    assert_sharding_invariants,
+    check_sharding_invariants,
+    see_memory_usage,
+)
+from deepspeed_tpu.utils.nvtx import instrument_w_nvtx
+
+
+def _engine(stage=2):
+    from deepspeed_tpu.models.gpt2 import GPT2Config, GPT2Model
+
+    cfg = GPT2Config(vocab_size=64, max_seq_len=16, num_layers=2,
+                     hidden_size=32, num_heads=2)
+    engine, *_ = deepspeed_tpu.initialize(model=GPT2Model(cfg), config={
+        "train_batch_size": 16,
+        "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+        "zero_optimization": {"stage": stage}, "steps_per_print": 0})
+    return engine
+
+
+def test_healthy_engine_has_no_violations():
+    engine = _engine(stage=2)
+    assert check_sharding_invariants(engine) == []
+    assert_sharding_invariants(engine)      # must not raise
+
+
+def test_misplacement_detected():
+    """Replicating a plan-sharded param must be flagged."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    engine = _engine(stage=2)
+    params = dict(engine.state.params)
+    # force a replicated copy of a sharded master
+    key = next(k for k, v in params.items()
+               if hasattr(v, "sharding") and any(
+                   e is not None for e in (v.sharding.spec or ())))
+    params[key] = jax.device_put(
+        np.asarray(params[key]), NamedSharding(engine.mesh, P()))
+    engine.state = engine.state._replace(params=params)
+    problems = check_sharding_invariants(engine)
+    assert problems and key in problems[0]
+    with pytest.raises(AssertionError, match="sharding invariants"):
+        assert_sharding_invariants(engine)
+
+
+def test_instrument_w_nvtx_preserves_semantics():
+    @instrument_w_nvtx
+    def f(x, y=2):
+        return x * y
+
+    assert f(3) == 6 and f(3, y=4) == 12
+    assert f.__name__ == "f"
+
+
+def test_see_memory_usage_runs(monkeypatch):
+    from deepspeed_tpu.utils import debug as dbg
+
+    seen = []
+    monkeypatch.setattr(dbg.logger, "info", lambda msg, *a: seen.append(msg))
+    see_memory_usage("mem check", force=True)
+    assert seen and "mem check" in seen[0]
+    assert "RSS" in seen[0]          # host memory always reported
+    seen.clear()
+    see_memory_usage("quiet", force=False)   # no DSTPU_DEBUG → no output
+    assert not seen
+
+
+def test_single_device_escape_detected():
+    """An array that escaped the mesh entirely (SingleDeviceSharding) is
+    the canonical misplacement and must be flagged."""
+    engine = _engine(stage=0)
+    params = dict(engine.state.params)
+    key = next(k for k, v in params.items() if hasattr(v, "sharding"))
+    params[key] = jax.device_put(np.asarray(params[key]), jax.devices()[0])
+    engine.state = engine.state._replace(params=params)
+    problems = check_sharding_invariants(engine)
+    assert any(key in p and "non-mesh" in p for p in problems), problems
+
+
+def test_transposed_sharding_detected():
+    """P(axis, None) vs P(None, axis) differ — interior Nones pin WHICH
+    dim is sharded, so a transposed placement must be flagged."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    engine = _engine(stage=2)
+    params = dict(engine.state.params)
+    key, arr0 = next(
+        (k, v) for k, v in params.items()
+        if hasattr(v, "ndim") and v.ndim == 2 and
+        (v.sharding.spec or (None, None))[0] is not None and
+        v.sharding.spec[1] is None)
+    axis = arr0.sharding.spec[0]
+    params[key] = jax.device_put(np.asarray(arr0),
+                                 NamedSharding(engine.mesh, P(None, axis)))
+    engine.state = engine.state._replace(params=params)
+    problems = check_sharding_invariants(engine)
+    assert any(key in p for p in problems), problems
