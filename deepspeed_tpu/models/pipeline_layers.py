@@ -40,6 +40,9 @@ class GPT2EmbedLayer:
         x = params["wte"].astype(self.compute_dtype)[input_ids]
         return x + params["wpe"].astype(self.compute_dtype)[:t][None]
 
+    def logical_axes(self):
+        return {"wte": ("vocab_in", "hidden"), "wpe": ("seq", "hidden")}
+
 
 def tied_lm_head(params, hidden):
     """Tied-head forward_fn: project through the embedding table
@@ -74,6 +77,22 @@ class GPT2BlockLayer:
             "mlp_out_b": jnp.zeros((d,)),
         }
 
+    def logical_axes(self):
+        """Per-param TP axes (unstacked; the pipeline adapter prepends the
+        stage/layer dims). Mirrors GPT2Model.logical_axes' 'blocks' entry."""
+        return {
+            "ln1_scale": ("norm",), "ln1_bias": ("norm",),
+            "qkv_w": ("hidden", "heads"),
+            "qkv_b": ("heads",),
+            "attn_out_w": ("heads", "hidden"),
+            "attn_out_b": ("hidden",),
+            "ln2_scale": ("norm",), "ln2_bias": ("norm",),
+            "mlp_fc_w": ("hidden", "mlp"),
+            "mlp_fc_b": ("mlp",),
+            "mlp_out_w": ("mlp", "hidden"),
+            "mlp_out_b": ("hidden",),
+        }
+
     def apply(self, blk, x, *, rngs=None, train: bool = False):
         c = self.config
         b, t, d = x.shape
@@ -106,6 +125,9 @@ class GPT2FinalNorm:
     def apply(self, params, x, *, rngs=None, train: bool = False):
         return layer_norm(x, params["scale"], params["bias"], self.config.eps)
 
+    def logical_axes(self):
+        return {"scale": ("norm",), "bias": ("norm",)}
+
 
 class GPT2LMHead:
     """Untied output projection (when tie_embeddings=False)."""
@@ -120,6 +142,9 @@ class GPT2LMHead:
 
     def apply(self, params, x, *, rngs=None, train: bool = False):
         return jnp.einsum("btd,dv->btv", x, params["w"].astype(x.dtype))
+
+    def logical_axes(self):
+        return {"w": ("hidden", "vocab")}
 
 
 def lm_loss(logits, labels):

@@ -143,16 +143,39 @@ class PipelinedModelAdapter:
         return {"pre": pre, "body": body, "post": post, "tied": tied}
 
     def logical_axes(self):
+        """TP/pipe logical names per param. Body leaves get
+        ('pipe_stage', 'layer') + the block layer's own per-param axes, so
+        tensor parallelism composes with the pipe sharding (closes the
+        pipe>1 × tp>1 composition gap; ref runtime/pipe/topology.py:244
+        PipeModelDataParallelTopology)."""
         shapes = jax.eval_shape(self.init, jax.random.PRNGKey(0))
+        layers = self.module.layers
 
-        def body_axes(leaf):
-            return ("pipe_stage",) + (None,) * (leaf.ndim - 1)
+        def layer_axes(i, leaf_tree):
+            layer = layers[i]
+            if hasattr(layer, "logical_axes"):
+                return layer.logical_axes()
+            return jax.tree_util.tree_map(lambda l: (None,) * l.ndim, leaf_tree)
 
+        if hasattr(self.body_layer, "logical_axes"):
+            blk = self.body_layer.logical_axes()
+            _is_axes = lambda x: isinstance(x, tuple) and all(  # noqa: E731
+                isinstance(e, (str, type(None))) for e in x)
+            body = jax.tree_util.tree_map(
+                lambda ax: ("pipe_stage", "layer") + tuple(ax), blk,
+                is_leaf=_is_axes)
+        else:
+            body = jax.tree_util.tree_map(
+                lambda l: ("pipe_stage",) + (None,) * (l.ndim - 1), shapes["body"])
+
+        tied_axes = {}
+        for key, owner in self.tie_owner.items():
+            tied_axes[key] = layer_axes(owner, shapes["tied"][key])
         return {
-            "pre": jax.tree_util.tree_map(lambda l: (None,) * l.ndim, shapes["pre"]),
-            "body": jax.tree_util.tree_map(body_axes, shapes["body"]),
-            "post": jax.tree_util.tree_map(lambda l: (None,) * l.ndim, shapes["post"]),
-            "tied": jax.tree_util.tree_map(lambda l: (None,) * l.ndim, shapes["tied"]),
+            "pre": {k: layer_axes(int(k), v) for k, v in shapes["pre"].items()},
+            "body": body,
+            "post": {k: layer_axes(int(k), v) for k, v in shapes["post"].items()},
+            "tied": tied_axes,
         }
 
     # ------------------------------------------------------------------ apply

@@ -83,9 +83,10 @@ def lm_stream(gas, b=8, t=32, vocab=512, seed=0, n=3):
     return out
 
 
-def run_pipe_training(pp, gas=4, steps=3, stage=0, tie=True, seed=0, num_layers=None):
+def run_pipe_training(pp, gas=4, steps=3, stage=0, tie=True, seed=0, num_layers=None,
+                      tp=1):
     groups.reset()
-    topo = build_topology(pp=pp)
+    topo = build_topology(pp=pp, tp=tp)
     if num_layers is None:
         cfg = GPT2Config.tiny(tie_embeddings=tie)
     else:
@@ -100,6 +101,7 @@ def run_pipe_training(pp, gas=4, steps=3, stage=0, tie=True, seed=0, num_layers=
             "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
             "zero_optimization": {"stage": stage},
             "pipeline": {"stages": pp},
+            "tensor_parallel": {"tp_size": tp},
             "steps_per_print": 0,
         })
     assert isinstance(engine, PipelineEngine)
@@ -124,6 +126,18 @@ def test_pipeline_four_stages_tied():
     _, l1 = run_pipe_training(pp=1, tie=True, num_layers=4)
     _, l4 = run_pipe_training(pp=4, tie=True, num_layers=4)
     np.testing.assert_allclose(l1, l4, rtol=2e-4)
+
+
+def test_pipeline_with_tensor_parallel():
+    """3D composition: pipe=2 × tp=2 × data=2 matches pipe-only numerics
+    (closes the PipeModelDataParallelTopology composition gap, reference
+    runtime/pipe/topology.py:244)."""
+    _, l_ref = run_pipe_training(pp=2, tp=1, stage=1)
+    engine, l_tp = run_pipe_training(pp=2, tp=2, stage=1)
+    np.testing.assert_allclose(l_ref, l_tp, rtol=3e-4)
+    # TP really sharded: qkv fused dim carries the 'model' axis
+    spec = str(engine.state.params["body"]["qkv_w"].sharding.spec)
+    assert "model" in spec, spec
 
 
 def test_pipeline_with_zero1():
