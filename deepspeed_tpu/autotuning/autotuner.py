@@ -30,6 +30,7 @@ from deepspeed_tpu.utils.logging import log_dist, logger
 DEFAULT_PEAK_FLOPS = 275e12     # bf16 matmul per chip
 DEFAULT_HBM_BW = 1.2e12         # bytes/sec
 DEFAULT_HBM_BYTES = 32e9        # per-chip HBM
+DEFAULT_HOST_BW = 5e10          # host<->device for offloaded optimizer state
 
 
 class AutotuningConfig(DeepSpeedConfigModel):
@@ -57,6 +58,10 @@ class TrialResult:
     tokens_per_sec: float
     fits: bool
     error: Optional[str] = None
+    gas: int = 1
+    offload: bool = False
+    remat: Optional[str] = None
+    pruned: bool = False  # rejected by the model-info pass, never compiled
 
 
 class Autotuner:
@@ -65,7 +70,8 @@ class Autotuner:
     def __init__(self, model, base_config: Dict, *, seq_len: int,
                  vocab_size: int, hbm_bytes: float = DEFAULT_HBM_BYTES,
                  peak_flops: float = DEFAULT_PEAK_FLOPS,
-                 hbm_bw: float = DEFAULT_HBM_BW):
+                 hbm_bw: float = DEFAULT_HBM_BW,
+                 host_bw: float = DEFAULT_HOST_BW):
         self.model = model
         self.base_config = dict(base_config)
         self.seq_len = seq_len
@@ -73,10 +79,88 @@ class Autotuner:
         self.hbm_bytes = hbm_bytes
         self.peak_flops = peak_flops
         self.hbm_bw = hbm_bw
+        self.host_bw = host_bw
         self.results: List[TrialResult] = []
+        self._model_info: Optional[Dict[str, float]] = None
+
+    # ------------------------------------------------------- model-info pass
+    def model_info(self) -> Dict[str, float]:
+        """Profile-run analog (reference autotuner.py:664 model_info /
+        ``--model_info_path``): parameter count + flops/token, computed from
+        shapes — no throwaway training job needed. Cached."""
+        if getattr(self, "_model_info", None) is not None:
+            return self._model_info
+        import jax
+
+        shapes = jax.eval_shape(self.model.init, jax.random.PRNGKey(0))
+        n_params = sum(int(np.prod(l.shape))
+                       for l in jax.tree_util.tree_leaves(shapes))
+        mcfg = getattr(self.model, "config", None)
+        hidden = getattr(mcfg, "hidden_size", 0)
+        layers = getattr(mcfg, "num_layers", 0)
+        attn = 12 * layers * hidden * self.seq_len if hidden else 0
+        self._model_info = {
+            "num_params": float(n_params),
+            "flops_per_token": 6.0 * n_params + attn,
+            "hidden_size": float(hidden),
+            "num_layers": float(layers),
+        }
+        return self._model_info
+
+    def _estimate_device_bytes(self, zero_stage: int, micro_batch: int,
+                               offload: bool, remat: Optional[str],
+                               dp: int) -> float:
+        """Analytic lower bound on per-chip HBM for (stage, mb, offload,
+        remat) — used to PRUNE infeasible points before paying a compile
+        (the reference prunes with its model-info profiling run the same
+        way, autotuner.py:664 → _get_min_gpus)."""
+        info = self.model_info()
+        n = info["num_params"]
+        shard = dp if zero_stage >= 1 else 1
+        compute_shard = dp if zero_stage >= 3 else 1
+        # fp32 master + 2 Adam moments (sharded from stage 1, host if offload)
+        opt_bytes = 0.0 if offload else 12.0 * n / shard
+        param_bytes = 2.0 * n / compute_shard          # bf16 compute copy
+        grad_bytes = 4.0 * n / (dp if zero_stage >= 2 else 1)
+        act = 0.0
+        if info["hidden_size"]:
+            h, L = info["hidden_size"], info["num_layers"]
+            # bf16 residual-stream activations the backward must see; saved
+            # tensors per layer: ~14x the [mb, seq, hidden] stream without
+            # remat (qkv, probs excluded — attention T^2 dominates separately),
+            # ~2x with a remat policy
+            per_layer = (2.0 if remat else 14.0) * micro_batch * self.seq_len * h * 2
+            act = per_layer * L
+            if not remat and getattr(self.model, "attn_impl", "dense") == "dense":
+                # T x T attention weights saved for backward, all layers
+                # (flash/ring/ulysses never materialize them)
+                act += L * micro_batch * self.seq_len ** 2 * \
+                    getattr(getattr(self.model, "config", None), "num_heads", 1) * 2
+        return opt_bytes + param_bytes + grad_bytes + act
+
+    def _apply_remat(self, remat: Optional[str]):
+        """Rebuild the model with the candidate remat policy when its
+        constructor supports it; None return = the knob cannot be expressed
+        for this model (the caller must SKIP the point, not silently compile
+        a program that doesn't match the candidate)."""
+        if not hasattr(self.model, "remat"):
+            return self.model if remat is None else None
+        if bool(remat) == bool(self.model.remat) and \
+                remat == getattr(self.model, "remat_policy", None):
+            return self.model
+        try:
+            return type(self.model)(
+                self.model.config,
+                compute_dtype=getattr(self.model, "compute_dtype", None),
+                remat=bool(remat), remat_policy=remat,
+                attn_impl=getattr(self.model, "attn_impl", "dense"))
+        except TypeError:
+            return None
 
     # ------------------------------------------------------------------ trial
-    def _trial(self, zero_stage: int, micro_batch: int) -> TrialResult:
+    def _trial(self, zero_stage: int, micro_batch: int, gas: int = 1,
+               offload: bool = False,
+               remat: Optional[str] = None) -> TrialResult:
         import jax
 
         import deepspeed_tpu
@@ -84,27 +168,47 @@ class Autotuner:
 
         groups.reset()
         cfg = dict(self.base_config)
-        dp = None
         try:
             from deepspeed_tpu.parallel.topology import build_topology
 
             topo = build_topology()
             dp = topo.data_parallel_size
+
+            est_bytes = self._estimate_device_bytes(
+                zero_stage, micro_batch, offload, remat, dp)
+            if est_bytes > self.hbm_bytes:
+                return TrialResult(
+                    zero_stage, micro_batch, est_bytes, 0, 0, float("inf"),
+                    0.0, fits=False, gas=gas, offload=offload, remat=remat,
+                    pruned=True,
+                    error=f"pruned: analytic estimate {est_bytes/1e9:.1f}GB "
+                          f"> HBM {self.hbm_bytes/1e9:.1f}GB")
+
+            model = self._apply_remat(remat)
+            if model is None:
+                return TrialResult(
+                    zero_stage, micro_batch, 0, 0, 0, float("inf"), 0.0,
+                    fits=False, gas=gas, offload=offload, remat=remat,
+                    pruned=True,
+                    error="pruned: model cannot express this remat policy")
+            zero_cfg: Dict[str, Any] = {"stage": zero_stage}
+            if offload:
+                zero_cfg["offload_optimizer"] = {"device": "cpu"}
             cfg.update({
-                "train_batch_size": micro_batch * dp,
+                "train_batch_size": micro_batch * gas * dp,
                 "train_micro_batch_size_per_gpu": micro_batch,
-                "gradient_accumulation_steps": 1,
-                "zero_optimization": {"stage": zero_stage},
+                "gradient_accumulation_steps": gas,
+                "zero_optimization": zero_cfg,
                 "steps_per_print": 0,
             })
-            engine, *_ = deepspeed_tpu.initialize(model=self.model, config=cfg,
+            engine, *_ = deepspeed_tpu.initialize(model=model, config=cfg,
                                                   topology=topo)
             step_fn = engine._build_train_step()
             batch = {
                 "input_ids": jax.ShapeDtypeStruct(
-                    (1, micro_batch * dp, self.seq_len), np.int32),
+                    (gas, micro_batch * dp, self.seq_len), np.int32),
                 "labels": jax.ShapeDtypeStruct(
-                    (1, micro_batch * dp, self.seq_len), np.int32),
+                    (gas, micro_batch * dp, self.seq_len), np.int32),
             }
             lr = jax.ShapeDtypeStruct((), np.float32)
             rng = jax.eval_shape(lambda: jax.random.PRNGKey(0))
@@ -113,14 +217,21 @@ class Autotuner:
             per_chip_peak = peak / max(topo.world_size, 1)
             est = max(flops / self.peak_flops / max(topo.world_size, 1),
                       bytes_ / self.hbm_bw / max(topo.world_size, 1))
+            if offload:
+                # optimizer shard round-trips the host each step
+                est += 12.0 * self.model_info()["num_params"] / max(
+                    topo.world_size, 1) / self.host_bw
             est = max(est, 1e-9)
-            tokens = micro_batch * dp * self.seq_len
+            tokens = micro_batch * gas * dp * self.seq_len
             return TrialResult(zero_stage, micro_batch, per_chip_peak, flops,
                                bytes_, est, tokens / est,
-                               fits=per_chip_peak <= self.hbm_bytes)
+                               fits=per_chip_peak <= self.hbm_bytes,
+                               gas=gas, offload=offload, remat=remat)
         except Exception as e:  # OOM at compile, bad divisibility, ...
             return TrialResult(zero_stage, micro_batch, float("inf"), 0, 0,
-                               float("inf"), 0.0, fits=False, error=str(e)[:200])
+                               float("inf"), 0.0, fits=False, gas=gas,
+                               offload=offload, remat=remat,
+                               error=str(e)[:200])
 
     @staticmethod
     def _read_compiled(compiled) -> Tuple[float, float, float]:
@@ -147,34 +258,63 @@ class Autotuner:
     # ------------------------------------------------------------------- tune
     def tune(self, micro_batch_candidates: Sequence[int] = (1, 2, 4, 8),
              zero_stages: Sequence[int] = (0, 1, 2, 3),
-             fast: bool = False) -> Dict[str, Any]:
+             fast: bool = False,
+             space: Optional[Dict[str, Sequence]] = None) -> Dict[str, Any]:
         """Search → best config dict (reference tune:404 returns the best
-        exp dir; here the resolved DS config section is returned directly)."""
+        exp dir; here the resolved DS config section is returned directly).
+
+        ``space`` widens the per-stage search beyond (stage x micro_batch)
+        with the template dimensions (config_templates.py — the reference's
+        config_templates/ analog): gas, offload on/off, remat policy.
+        Omitted → the legacy 2-D sweep. Analytically infeasible points are
+        pruned by the model-info pass without compiling."""
+        from deepspeed_tpu.autotuning.config_templates import enumerate_space
+
         self.results = []
         best: Optional[TrialResult] = None
         for stage in zero_stages:
+            if space is not None:
+                overrides = dict(space)
+                overrides.setdefault("micro_batch", list(micro_batch_candidates))
+                candidates = enumerate_space(stage, overrides)
+            else:
+                candidates = [{"micro_batch": mb, "gas": 1, "offload": False,
+                               "remat": None} for mb in micro_batch_candidates]
             stage_ok = False
-            for mb in micro_batch_candidates:
-                r = self._trial(stage, mb)
+            for cand in candidates:
+                r = self._trial(stage, cand["micro_batch"], cand.get("gas", 1),
+                                cand.get("offload", False), cand.get("remat"))
                 self.results.append(r)
                 log_dist(
-                    f"autotune z{r.zero_stage} mb{r.micro_batch}: "
-                    f"peak={r.peak_bytes/1e9:.2f}GB fits={r.fits} "
+                    f"autotune z{r.zero_stage} mb{r.micro_batch} gas{r.gas}"
+                    f"{' offload' if r.offload else ''}"
+                    f"{f' remat={r.remat}' if r.remat else ''}: "
+                    f"peak={r.peak_bytes/1e9:.2f}GB fits={r.fits}"
+                    f"{' PRUNED' if r.pruned else ''} "
                     f"est_tok/s={r.tokens_per_sec:.0f}"
                     + (f" err={r.error}" if r.error else ""), ranks=[0])
                 if r.fits:
                     stage_ok = True
                     if best is None or r.tokens_per_sec > best.tokens_per_sec:
                         best = r
-                elif r.error is None and stage_ok and fast:
-                    break  # monotone memory growth: larger mb won't fit either
+                elif r.error is None and stage_ok and fast and space is None:
+                    # legacy 1-D sweep only: memory grows monotonically in mb,
+                    # so larger mb can't fit either. The multi-dim template
+                    # walk is NOT monotone in iteration order — never break.
+                    break
         if best is None:
             raise RuntimeError(
                 "autotuning found no (zero_stage, micro_batch) that fits; "
                 f"tried stages {list(zero_stages)} x mb {list(micro_batch_candidates)}")
-        return {
+        out = {
             "zero_optimization": {"stage": best.zero_stage},
             "train_micro_batch_size_per_gpu": best.micro_batch,
+            "gradient_accumulation_steps": best.gas,
             "estimated_tokens_per_sec": best.tokens_per_sec,
             "peak_bytes_per_chip": best.peak_bytes,
         }
+        if best.offload:
+            out["zero_optimization"]["offload_optimizer"] = {"device": "cpu"}
+        if best.remat is not None:
+            out["activation_checkpointing"] = {"policy": best.remat}
+        return out

@@ -1,0 +1,48 @@
+"""Per-ZeRO-stage tuning-space templates — analog of the reference's
+``autotuning/config_templates/template_zero{0..3}.json`` (consumed by
+``Autotuner._generate_experiments``, reference autotuning/autotuner.py:664).
+
+The reference templates sweep CUDA-side knobs (reduce_bucket_size,
+allgather_bucket_size, overlap_comm, ...) that XLA makes moot — the compiler
+schedules collectives. The knobs that matter on TPU are the ones that change
+the compiled program: micro-batch, gradient accumulation, host offload of
+the optimizer shard, and the remat (activation-checkpoint) policy.
+"""
+
+from __future__ import annotations
+
+import itertools
+from typing import Any, Dict, List, Optional
+
+# remat candidates: None = save-all (fastest when it fits), "dots_no_batch" =
+# recompute matmul outputs (the usual HBM/compute sweet spot), "nothing" =
+# save only layer boundaries (tightest memory)
+DEFAULT_TUNING_SPACES: Dict[int, Dict[str, List[Any]]] = {
+    0: {"micro_batch": [1, 2, 4, 8, 16], "gas": [1, 2, 4],
+        "offload": [False], "remat": [None, "dots_no_batch"]},
+    1: {"micro_batch": [1, 2, 4, 8, 16], "gas": [1, 2, 4],
+        "offload": [False], "remat": [None, "dots_no_batch"]},
+    2: {"micro_batch": [1, 2, 4, 8, 16], "gas": [1, 2, 4],
+        "offload": [False, True], "remat": [None, "dots_no_batch", "nothing"]},
+    3: {"micro_batch": [1, 2, 4, 8, 16], "gas": [1, 2, 4],
+        "offload": [False, True], "remat": [None, "dots_no_batch", "nothing"]},
+}
+
+
+def tuning_space_for_stage(stage: int,
+                           overrides: Optional[Dict[str, List[Any]]] = None
+                           ) -> Dict[str, List[Any]]:
+    space = {k: list(v) for k, v in DEFAULT_TUNING_SPACES[stage].items()}
+    if overrides:
+        space.update({k: list(v) for k, v in overrides.items()})
+    return space
+
+
+def enumerate_space(stage: int,
+                    overrides: Optional[Dict[str, List[Any]]] = None
+                    ) -> List[Dict[str, Any]]:
+    """Cartesian candidate list for one stage, reference-template style."""
+    space = tuning_space_for_stage(stage, overrides)
+    keys = sorted(space)
+    return [dict(zip(keys, combo))
+            for combo in itertools.product(*(space[k] for k in keys))]

@@ -42,6 +42,11 @@ def _match(path: str, patterns: List[str]) -> bool:
                for pat in patterns)
 
 
+def _path_key(path) -> str:
+    """jax key-path → 'a/b/c' (shared by transforms + layer reduction)."""
+    return "/".join(str(getattr(p, "key", getattr(p, "idx", p))) for p in path)
+
+
 class CompressionScheduler:
     """Step-gated activation (reference compression/scheduler.py): each
     method has schedule_offset; the transform is identity before it."""
@@ -63,6 +68,8 @@ class CompressedModel:
 
     def __init__(self, model, config: Dict):
         self.model = model
+        self._teacher_params = None        # set by init_compression for KD
+        self._layer_reduction_cfg = None
         cc = config.get("compression_training", config)
         self._transforms: List[Tuple[str, List[str], Callable]] = []
         offsets: Dict[str, int] = {}
@@ -114,8 +121,7 @@ class CompressedModel:
         leaves, treedef = jax.tree_util.tree_flatten_with_path(params)
         out = []
         for path, leaf in leaves:
-            key = "/".join(str(getattr(p, "key", getattr(p, "idx", p)))
-                           for p in path)
+            key = _path_key(path)
             for method, patterns, fn in self._transforms:
                 if getattr(leaf, "ndim", 0) >= 2 and \
                         self.scheduler.active(method) and _match(key, patterns):
@@ -124,7 +130,11 @@ class CompressedModel:
         return jax.tree_util.tree_unflatten(treedef, [l for l in out])
 
     def init(self, rng):
-        return self.model.init(rng)
+        params = self.model.init(rng)
+        if self._teacher_params is not None:
+            params = student_initialization(
+                params, self._teacher_params, self._layer_reduction_cfg)
+        return params
 
     def apply(self, params, batch, *, rngs=None, train: bool = False):
         return self.model.apply(self.compress_params(params), batch,
@@ -137,8 +147,108 @@ class CompressedModel:
         return getattr(self.model, name)
 
 
+def _flatten_with_keys(params) -> Dict[str, Any]:
+    leaves = jax.tree_util.tree_flatten_with_path(params)[0]
+    return {_path_key(path): leaf for path, leaf in leaves}
+
+
+def student_initialization(student_params, teacher_params, deepspeed_config: Dict):
+    """Knowledge-distillation student init via layer reduction (reference
+    compress.py:167 student_initialization + helper.py): copy a chosen subset
+    of teacher layers — plus named non-layer modules — into a shallower
+    student.
+
+    The reference walks ``module_name_prefix.{i}`` torch submodules; here the
+    layer stack is the scanned ``blocks`` subtree with a leading layer dim,
+    so layer selection is one gather: ``student_blocks = teacher_blocks[idx]``.
+
+    Config (reference-shaped)::
+
+        {"compression_training": {"layer_reduction": {
+            "enabled": true,
+            "keep_number_layer": 2,            # student depth (checked)
+            "module_name_prefix": "blocks",    # stacked-layer subtree key
+            "teacher_layer": [1, 3],           # teacher layers to inherit
+            "other_module_name": ["wte", "wpe", "ln_f*"]  # copied verbatim
+        }}}
+    """
+    cc = deepspeed_config.get("compression_training", deepspeed_config)
+    lr = cc.get("layer_reduction", {})
+    if not lr.get("enabled", False):
+        return student_params
+    teacher_layer = list(lr["teacher_layer"])
+    keep = lr.get("keep_number_layer", len(teacher_layer))
+    if keep != len(teacher_layer):
+        raise ValueError(
+            f"layer_reduction: keep_number_layer={keep} but teacher_layer has "
+            f"{len(teacher_layer)} entries — they must match")
+    prefix = lr.get("module_name_prefix", "blocks")
+    other = lr.get("other_module_name", [])
+    idx = jnp.asarray(teacher_layer, jnp.int32)
+
+    t_flat = _flatten_with_keys(teacher_params)
+    s_leaves, treedef = jax.tree_util.tree_flatten_with_path(student_params)
+    out = []
+    copied_layers = copied_other = 0
+    for path, leaf in s_leaves:
+        key = _path_key(path)
+        if (key.startswith(prefix + "/") or key == prefix) and key in t_flat:
+            t_leaf = t_flat[key]
+            if leaf.shape[0] != len(teacher_layer):
+                raise ValueError(
+                    f"layer_reduction: student '{key}' has {leaf.shape[0]} "
+                    f"layers but teacher_layer selects {len(teacher_layer)}")
+            if max(teacher_layer) >= t_leaf.shape[0]:
+                raise ValueError(
+                    f"layer_reduction: teacher_layer {teacher_layer} out of "
+                    f"range for teacher '{key}' with {t_leaf.shape[0]} layers")
+            sel = jnp.take(jnp.asarray(t_leaf), idx, axis=0).astype(leaf.dtype)
+            if sel.shape != leaf.shape:
+                raise ValueError(
+                    f"layer_reduction: '{key}' teacher slice {sel.shape} != "
+                    f"student {leaf.shape} (hidden sizes must match)")
+            out.append(sel)
+            copied_layers += 1
+        elif other and _match(key, other) and key in t_flat:
+            t_leaf = jnp.asarray(t_flat[key])
+            if t_leaf.shape != leaf.shape:
+                raise ValueError(
+                    f"layer_reduction: other module '{key}' teacher shape "
+                    f"{t_leaf.shape} != student {leaf.shape}")
+            out.append(t_leaf.astype(leaf.dtype))
+            copied_other += 1
+        else:
+            out.append(leaf)
+    if copied_layers == 0:
+        raise ValueError(
+            f"layer_reduction: no student param under prefix '{prefix}' "
+            f"matched the teacher — check module_name_prefix and that "
+            f"teacher_model carries a params pytree (got teacher keys "
+            f"{sorted(t_flat)[:5]}...)")
+    logger.info(f"student_initialization: inherited {copied_layers} layer "
+                f"params (teacher layers {teacher_layer}) + {copied_other} "
+                f"other params")
+    return jax.tree_util.tree_unflatten(treedef, out)
+
+
 def init_compression(model, deepspeed_config: Dict, teacher_model=None, mpu=None):
-    """reference compress.py:95 — returns the compression-wrapped model."""
+    """reference compress.py:95 — returns the compression-wrapped model.
+
+    With ``layer_reduction`` enabled, ``teacher_model`` is required (reference
+    :112 asserts the same) and must carry the teacher's *params*: pass the
+    params pytree itself, or an object with ``.params`` (e.g. a training
+    engine's state view). The student's ``init()`` then inherits the selected
+    teacher layers (student_initialization)."""
+    cc = deepspeed_config.get("compression_training", deepspeed_config)
+    if cc.get("layer_reduction", {}).get("enabled", False):
+        if teacher_model is None:
+            raise ValueError(
+                "Teacher model is required for layer reduction")  # ref :112
+        teacher_params = getattr(teacher_model, "params", teacher_model)
+        wrapped = CompressedModel(model, deepspeed_config)
+        wrapped._teacher_params = teacher_params
+        wrapped._layer_reduction_cfg = deepspeed_config
+        return wrapped
     return CompressedModel(model, deepspeed_config)
 
 

@@ -33,6 +33,28 @@ from deepspeed_tpu.utils import groups as groups_mod
 from deepspeed_tpu.utils.logging import log_dist, logger
 
 
+def filter_logits(logits, *, top_k: int = 0, top_p: float = 1.0):
+    """Sampling-filter parity with HF's TopKLogitsWarper + TopPLogitsWarper
+    (the path the reference's serving takes through HF ``generate``,
+    reference inference/engine.py:588): top-k first, then nucleus — keep the
+    smallest prefix of the descending-sorted distribution whose cumulative
+    probability reaches ``top_p`` (always >= 1 token), mask the rest to
+    -inf. Value-ties at the nucleus boundary are all kept (HF cuts by
+    sorted position; with distinct logits the support sets are identical).
+    """
+    if top_k > 0:
+        kth = jax.lax.top_k(logits, top_k)[0][..., -1:]
+        logits = jnp.where(logits < kth, -jnp.inf, logits)
+    if top_p < 1.0:
+        sort = jnp.sort(logits, axis=-1)[..., ::-1]  # descending
+        probs = jax.nn.softmax(sort, axis=-1)
+        cum = jnp.cumsum(probs, axis=-1)
+        keep = (cum - probs) < top_p  # exclusive cumsum: keeps the crosser
+        kth = jnp.min(jnp.where(keep, sort, jnp.inf), axis=-1, keepdims=True)
+        logits = jnp.where(logits < kth, -jnp.inf, logits)
+    return logits
+
+
 class InferenceEngine:
     """Serve a ModelSpec (or a converted HF torch model) with a compiled
     prefill + decode loop (reference InferenceEngine:89)."""
@@ -186,11 +208,16 @@ class InferenceEngine:
     # --------------------------------------------------------------- generate
     def generate(self, input_ids, max_new_tokens: int = 32, *,
                  do_sample: bool = False, temperature: float = 1.0,
-                 top_k: int = 0, eos_token_id: Optional[int] = None,
+                 top_k: int = 0, top_p: float = 1.0,
+                 eos_token_id: Optional[int] = None,
                  pad_token_id: int = 0, seed: Optional[int] = None):
         """Autoregressive generation: one jitted prefill + one jitted decode
-        step scanned ``max_new_tokens`` times (reference _generate:588 via HF
-        model.generate over injected modules).
+        step iterated ``max_new_tokens`` times (reference _generate:588 via HF
+        model.generate over injected modules). Sampling supports greedy,
+        top-k, and top-p/nucleus (HF TopPLogitsWarper semantics); with
+        ``eos_token_id`` set, the decode loop is a ``while_loop`` that exits
+        as soon as every batch row has emitted EOS (HF early-stopping analog)
+        — remaining positions are ``pad_token_id``.
 
         input_ids: [B, T] — uniform prompt length per call (static shapes).
         Returns np.ndarray [B, T + max_new_tokens].
@@ -224,13 +251,16 @@ class InferenceEngine:
         vocab = getattr(getattr(self.module, "config", None), "vocab_size", None)
         if top_k and vocab is not None and top_k > vocab:
             raise ValueError(f"generate: top_k {top_k} > vocab_size {vocab}")
+        if not (0.0 < top_p <= 1.0):
+            raise ValueError(f"generate: top_p must be in (0, 1], got {top_p}")
 
-        key = ("gen", b, t, max_new_tokens, do_sample, top_k,
+        key = ("gen", b, t, max_new_tokens, do_sample, top_k, float(top_p),
                eos_token_id, pad_token_id)
         if key not in self._compiled:
             self._compiled[key] = self._build_generate(
                 b, t, max_new_tokens, do_sample=do_sample, top_k=top_k,
-                eos_token_id=eos_token_id, pad_token_id=pad_token_id)
+                top_p=float(top_p), eos_token_id=eos_token_id,
+                pad_token_id=pad_token_id)
         if seed is not None:
             rng = jax.random.PRNGKey(seed)
         else:
@@ -239,7 +269,7 @@ class InferenceEngine:
         out_tokens = self._compiled[key](self.params, jnp.asarray(input_ids), temp, rng)
         return np.concatenate([input_ids, np.asarray(jax.device_get(out_tokens))], axis=1)
 
-    def _build_generate(self, b, t, max_new, *, do_sample, top_k,
+    def _build_generate(self, b, t, max_new, *, do_sample, top_k, top_p,
                         eos_token_id, pad_token_id):
         model = self.module
 
@@ -247,10 +277,7 @@ class InferenceEngine:
             logits = logits.astype(jnp.float32)
             if not do_sample:
                 return jnp.argmax(logits, axis=-1).astype(jnp.int32)
-            logits = logits / temp
-            if top_k > 0:
-                kth = jax.lax.top_k(logits, top_k)[0][:, -1][:, None]
-                logits = jnp.where(logits < kth, -jnp.inf, logits)
+            logits = filter_logits(logits / temp, top_k=top_k, top_p=top_p)
             return jax.random.categorical(rng, logits, axis=-1).astype(jnp.int32)
 
         def gen(params, ids, temp, rng):
@@ -258,23 +285,44 @@ class InferenceEngine:
             logits, cache = model.forward_with_cache(params, ids, cache)
             rng, sub = jax.random.split(rng)
             tok = pick(logits[:, -1], temp, sub)
-            done = jnp.zeros((b,), bool)
-            if eos_token_id is not None:
-                done = tok == eos_token_id
 
-            def step(carry, _):
-                tok, cache, rng, done = carry
+            if eos_token_id is None:
+                def step(carry, _):
+                    tok, cache, rng = carry
+                    logits, cache = model.forward_with_cache(
+                        params, tok[:, None], cache)
+                    rng, sub = jax.random.split(rng)
+                    nxt = pick(logits[:, -1], temp, sub)
+                    return (nxt, cache, rng), tok
+
+                (last, _, _), toks = jax.lax.scan(
+                    step, (tok, cache, rng), None, length=max_new - 1)
+                return jnp.concatenate([toks.T, last[:, None]], axis=1)
+
+            # EOS path: while_loop exits once every row has EMITTED its eos
+            # (prev_done); pending-but-unwritten eos keeps the loop alive one
+            # more tick so it lands in the buffer.
+            done = tok == eos_token_id
+            buf = jnp.full((max_new, b), pad_token_id, jnp.int32)
+
+            def cond(carry):
+                i, *_rest, prev_done, _buf = carry
+                return (i < max_new) & ~jnp.all(prev_done)
+
+            def body(carry):
+                i, tok, cache, rng, done, prev_done, buf = carry
+                buf = buf.at[i].set(tok)
                 logits, cache = model.forward_with_cache(params, tok[:, None], cache)
                 rng, sub = jax.random.split(rng)
                 nxt = pick(logits[:, -1], temp, sub)
-                if eos_token_id is not None:
-                    nxt = jnp.where(done, pad_token_id, nxt)
-                    done = done | (nxt == eos_token_id)
-                return (nxt, cache, rng, done), tok
+                nxt = jnp.where(done, pad_token_id, nxt)
+                return (i + 1, nxt, cache, rng,
+                        done | (nxt == eos_token_id), done, buf)
 
-            (last, _, _, _), toks = jax.lax.scan(
-                step, (tok, cache, rng, done), None, length=max_new - 1)
-            return jnp.concatenate([toks.T, last[:, None]], axis=1)
+            prev_done = jnp.zeros((b,), bool)
+            *_state, buf = jax.lax.while_loop(
+                cond, body, (0, tok, cache, rng, done, prev_done, buf))
+            return buf.T
 
         return jax.jit(gen)
 

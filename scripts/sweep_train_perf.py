@@ -1,0 +1,98 @@
+"""Training-throughput sweep on the real chip: (attn_impl, remat, mb x gas).
+
+Dogfoods the bench methodology (best-of-windows, see bench.py) across the
+knobs VERDICT r1 called out: whether the Pallas FA2 kernel beats XLA dense
+attention, whether remat is needed at all at 125M, and the microbatch split.
+Prints one JSON line per config; run me on the tunnel chip.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def run_config(attn_impl, remat, remat_policy, batch, gas, loss_chunk=0,
+               steps=8, windows=3):
+    import dataclasses
+
+    import jax
+
+    import deepspeed_tpu
+    from deepspeed_tpu.models.gpt2 import GPT2Config, GPT2Model
+    from deepspeed_tpu.utils import groups
+
+    groups.reset()
+    seq = 1024
+    cfg = GPT2Config.gpt2_125m()
+    if loss_chunk:
+        cfg = dataclasses.replace(cfg, loss_chunk=loss_chunk)
+    model = GPT2Model(cfg, remat=remat, remat_policy=remat_policy,
+                      attn_impl=attn_impl)
+    engine, *_ = deepspeed_tpu.initialize(model=model, config={
+        "train_batch_size": batch * gas,
+        "gradient_accumulation_steps": gas,
+        "bf16": {"enabled": True},
+        "optimizer": {"type": "AdamW", "params": {"lr": 1e-4, "weight_decay": 0.01}},
+        "gradient_clipping": 1.0,
+        "steps_per_print": 0,
+        "zero_optimization": {"stage": 0},
+    })
+    rng = np.random.RandomState(0)
+
+    def make_batch():
+        ids = rng.randint(0, cfg.vocab_size, size=(gas, batch, seq + 1)).astype(np.int32)
+        return {"input_ids": ids[:, :, :-1], "labels": ids[:, :, 1:]}
+
+    for _ in range(2):
+        loss = engine.train_batch_from_stacked(make_batch())
+    float(jax.device_get(loss))
+    best_dt = float("inf")
+    for _ in range(windows):
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            loss = engine.train_batch_from_stacked(make_batch())
+        float(jax.device_get(loss))
+        best_dt = min(best_dt, time.perf_counter() - t0)
+    toks = batch * gas * seq * steps / best_dt
+    return toks
+
+
+def main():
+    import sys
+
+    grid = [
+        # (attn_impl, remat, policy, mb, gas[, loss_chunk])
+        ("dense", True, "dots_no_batch", 8, 8),
+        ("dense", True, "dots_no_batch", 16, 4),
+        ("dense", True, "dots_no_batch", 4, 16),   # r1 champion re-measure
+        ("flash", True, "dots_no_batch", 8, 8),
+        ("dense", True, "nothing", 8, 8),
+        ("dense", True, "dots_no_batch", 32, 2),
+        ("dense", True, "dots_no_batch", 8, 8, 512),   # chunked LM loss
+        ("flash", False, None, 8, 8),                  # sweep-1 runner-up
+    ]
+    if len(sys.argv) > 1:  # allow running a subset: indices as args
+        grid = [grid[int(i)] for i in sys.argv[1:]]
+    results = []
+    for g in grid:
+        try:
+            toks = run_config(*g)
+            results.append((g, round(toks)))
+        except Exception as e:
+            results.append((g, f"ERROR {type(e).__name__}: {e}"))
+        print(json.dumps({"config": list(results[-1][0]), "tok_s": results[-1][1]}),
+              flush=True)
+    best = max((r for r in results if isinstance(r[1], (int, float))),
+               key=lambda r: r[1], default=None)
+    print("BEST:", best)
+
+
+if __name__ == "__main__":
+    main()

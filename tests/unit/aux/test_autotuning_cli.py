@@ -97,3 +97,69 @@ class TestEndToEndSweep:
         metric = run_experiment([sys.executable, str(script)], {},
                                 str(tmp_path / "exp"))
         assert metric is None
+
+
+class TestTemplateSpace:
+    """Template tuning spaces + model-info pruning (reference
+    autotuning/config_templates/ + autotuner.py:664 model-info pass)."""
+
+    def _model(self):
+        import jax.numpy as jnp
+
+        from deepspeed_tpu.models.gpt2 import GPT2Config, GPT2Model
+
+        return GPT2Model(GPT2Config.tiny(max_seq_len=256),
+                         compute_dtype=jnp.float32)
+
+    def test_templates_enumerate(self):
+        from deepspeed_tpu.autotuning.config_templates import enumerate_space
+
+        cands = enumerate_space(3, {"micro_batch": [1, 2]})
+        assert all(set(c) == {"micro_batch", "gas", "offload", "remat"}
+                   for c in cands)
+        assert any(c["offload"] for c in cands)       # z3 sweeps offload
+        cands0 = enumerate_space(0)
+        assert not any(c["offload"] for c in cands0)  # z0 never offloads
+
+    def test_model_info(self):
+        from deepspeed_tpu.autotuning import Autotuner
+
+        tuner = Autotuner(self._model(), {}, seq_len=256, vocab_size=512)
+        info = tuner.model_info()
+        assert info["num_params"] > 1e5
+        assert info["flops_per_token"] > 6 * info["num_params"]
+        assert tuner.model_info() is info  # cached
+
+    def test_three_dim_space_prunes_infeasible(self):
+        """3-dim (micro_batch x remat x stage-fixed) sweep: the model-info
+        pass must prune the no-remat large-batch point analytically (its
+        saved T^2 attention weights blow the budget) without compiling it,
+        while the sweep still finds a best config."""
+        from deepspeed_tpu.autotuning import Autotuner
+
+        tuner = Autotuner(self._model(), {
+            "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+        }, seq_len=256, vocab_size=512, hbm_bytes=60e6)
+        best = tuner.tune(zero_stages=(0,), space={
+            "micro_batch": [4, 32], "gas": [1],
+            "offload": [False], "remat": [None, "dots_no_batch"]})
+        pruned = [r for r in tuner.results if r.pruned]
+        assert pruned, "expected the mb=32 no-remat point to be pruned"
+        assert all(r.micro_batch == 32 and r.remat is None for r in pruned)
+        assert best["train_micro_batch_size_per_gpu"] in (4, 32)
+        assert "gradient_accumulation_steps" in best
+
+    def test_offload_and_gas_dimensions(self):
+        from deepspeed_tpu.autotuning import Autotuner
+
+        tuner = Autotuner(self._model(), {
+            "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+        }, seq_len=64, vocab_size=512)
+        best = tuner.tune(zero_stages=(2,), space={
+            "micro_batch": [2], "gas": [1, 2],
+            "offload": [False, True], "remat": [None]})
+        assert any(r.offload for r in tuner.results)
+        assert any(r.gas == 2 for r in tuner.results)
+        # offload pays a host round-trip penalty, so with everything fitting
+        # the non-offload config must win
+        assert "offload_optimizer" not in best["zero_optimization"]

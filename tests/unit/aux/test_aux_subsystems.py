@@ -2,6 +2,8 @@
 (curriculum / sampler / random-LTD), compression, autotuning — analogs of
 reference tests/unit/{profiling,elasticity,compression,autotuning} suites."""
 
+import dataclasses
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -259,6 +261,66 @@ class TestCompression:
             losses.append(float(jax.device_get(engine.train_batch_from_stacked(
                 {"input_ids": ids[:, :, :-1], "labels": ids[:, :, 1:]}))))
         assert losses[-1] < losses[0]
+
+    def test_student_initialization_layer_reduction(self):
+        """2-layer student inherits the chosen teacher layers + embeddings
+        exactly (reference compress.py:167, helper.py student_initialization)."""
+        from deepspeed_tpu.compression.compress import student_initialization
+        from deepspeed_tpu.models.gpt2 import GPT2Config, GPT2Model
+
+        t_cfg = GPT2Config(vocab_size=512, max_seq_len=64, num_layers=4,
+                           hidden_size=64, num_heads=4)
+        s_cfg = dataclasses.replace(t_cfg, num_layers=2)
+        teacher = GPT2Model(t_cfg, compute_dtype=jnp.float32)
+        student = GPT2Model(s_cfg, compute_dtype=jnp.float32)
+        t_params = teacher.init(jax.random.PRNGKey(0))
+        s_params = student.init(jax.random.PRNGKey(1))
+        cfg = {"compression_training": {"layer_reduction": {
+            "enabled": True, "keep_number_layer": 2,
+            "module_name_prefix": "blocks", "teacher_layer": [1, 3],
+            "other_module_name": ["wte", "wpe", "ln_f*"]}}}
+        out = student_initialization(s_params, t_params, cfg)
+        for k in t_params["blocks"]:
+            np.testing.assert_array_equal(
+                np.asarray(out["blocks"][k]),
+                np.asarray(t_params["blocks"][k][np.array([1, 3])]), err_msg=k)
+        np.testing.assert_array_equal(np.asarray(out["wte"]),
+                                      np.asarray(t_params["wte"]))
+        np.testing.assert_array_equal(np.asarray(out["ln_f_scale"]),
+                                      np.asarray(t_params["ln_f_scale"]))
+        # untouched leaves stay the student's own
+        assert not np.array_equal(np.asarray(out["blocks"]["qkv_w"]),
+                                  np.asarray(s_params["blocks"]["qkv_w"]))
+
+    def test_init_compression_requires_teacher_and_inits_student(self):
+        from deepspeed_tpu.compression import init_compression
+        from deepspeed_tpu.models.gpt2 import GPT2Config, GPT2Model
+
+        t_cfg = GPT2Config(vocab_size=512, max_seq_len=64, num_layers=4,
+                           hidden_size=64, num_heads=4)
+        s_cfg = dataclasses.replace(t_cfg, num_layers=2)
+        cfg = {"compression_training": {"layer_reduction": {
+            "enabled": True, "keep_number_layer": 2,
+            "module_name_prefix": "blocks", "teacher_layer": [0, 2],
+            "other_module_name": ["wte"]}}}
+        with pytest.raises(ValueError, match="[Tt]eacher"):
+            init_compression(GPT2Model(s_cfg, compute_dtype=jnp.float32), cfg)
+        teacher_params = GPT2Model(t_cfg, compute_dtype=jnp.float32).init(
+            jax.random.PRNGKey(0))
+        model = init_compression(GPT2Model(s_cfg, compute_dtype=jnp.float32),
+                                 cfg, teacher_model=teacher_params)
+        s_params = model.init(jax.random.PRNGKey(1))
+        np.testing.assert_array_equal(
+            np.asarray(s_params["blocks"]["mlp_fc_w"]),
+            np.asarray(teacher_params["blocks"]["mlp_fc_w"][np.array([0, 2])]))
+        # mismatched depth fails loudly
+        bad = {"compression_training": {"layer_reduction": {
+            "enabled": True, "keep_number_layer": 3,
+            "teacher_layer": [0, 1], "module_name_prefix": "blocks"}}}
+        with pytest.raises(ValueError, match="keep_number_layer"):
+            init_compression(GPT2Model(s_cfg, compute_dtype=jnp.float32), bad,
+                             teacher_model=teacher_params).init(
+                                 jax.random.PRNGKey(2))
 
     def test_redundancy_clean_bakes_quant(self):
         from deepspeed_tpu.compression import redundancy_clean

@@ -123,6 +123,62 @@ def test_eos_stops_and_pads():
     assert (out[0, 6 + pos + 1:] == 0).all()
 
 
+def test_top_p_filter_matches_hf_warper():
+    """Support-set parity with transformers' TopPLogitsWarper (the filter the
+    reference's serving path applies inside HF generate)."""
+    import torch
+    from transformers.generation.logits_process import TopPLogitsWarper
+
+    from deepspeed_tpu.inference.engine import filter_logits
+
+    rng = np.random.RandomState(0)
+    logits = rng.randn(4, 64).astype(np.float32) * 3.0
+    for top_p in (0.1, 0.5, 0.9, 0.999):
+        ours = np.asarray(filter_logits(jnp.asarray(logits), top_p=top_p))
+        theirs = TopPLogitsWarper(top_p=top_p)(
+            None, torch.from_numpy(logits)).numpy()
+        np.testing.assert_array_equal(np.isfinite(ours), np.isfinite(theirs),
+                                      err_msg=f"top_p={top_p}")
+        kept = np.isfinite(ours)
+        np.testing.assert_allclose(ours[kept], logits[kept], rtol=1e-6)
+
+
+def test_top_p_generate_reproducible():
+    cfg = GPT2Config.tiny()
+    engine = make_engine(GPT2Model(cfg, compute_dtype=jnp.float32))
+    prompt = np.zeros((2, 4), np.int32)
+    a = engine.generate(prompt, max_new_tokens=8, do_sample=True, top_p=0.9, seed=7)
+    b = engine.generate(prompt, max_new_tokens=8, do_sample=True, top_p=0.9, seed=7)
+    np.testing.assert_array_equal(a, b)
+    assert a.shape == (2, 12)
+    assert (a >= 0).all() and (a < cfg.vocab_size).all()
+    with pytest.raises(ValueError, match="top_p"):
+        engine.generate(prompt, max_new_tokens=4, do_sample=True, top_p=0.0)
+
+
+def test_eos_early_exit_matches_scan_path():
+    """The while_loop EOS path must emit exactly what the scan path emits up
+    to (and including) EOS, padding after — and stop early when every row is
+    done (behavioral check: outputs agree with the no-eos rollout prefix)."""
+    cfg = GPT2Config.tiny()
+    model = GPT2Model(cfg, compute_dtype=jnp.float32)
+    engine = make_engine(model)
+    prompt = np.random.RandomState(5).randint(0, cfg.vocab_size, (2, 6)).astype(np.int32)
+    free = engine.generate(prompt, max_new_tokens=10)  # no eos: scan path
+    # pick an eos that appears in row 0's continuation; row 1 may not hit it
+    gen0 = free[0, 6:]
+    eos = int(gen0[2])
+    out = engine.generate(prompt, max_new_tokens=10, eos_token_id=eos,
+                          pad_token_id=0)
+    for row in range(2):
+        gen_free = free[row, 6:]
+        gen_eos = out[row, 6:]
+        hits = np.where(gen_free == eos)[0]
+        stop = hits[0] if len(hits) else len(gen_free) - 1
+        np.testing.assert_array_equal(gen_eos[:stop + 1], gen_free[:stop + 1])
+        assert (gen_eos[stop + 1:] == 0).all()
+
+
 def test_checkpoint_roundtrip_to_inference(tmp_path):
     """Train briefly → save_checkpoint → serve from the checkpoint
     (the reference's checkpoint-sharing between engine and InferenceEngine)."""
