@@ -30,6 +30,43 @@ import numpy as np
 REFERENCE_TFLOPS_PER_DEVICE = 50.0  # DeepSpeed ZeRO-3 published per-V100 claim
 
 
+def _attainable_tflops():
+    """Calibrate what this (time-shared, tunneled) chip can actually deliver:
+    best-window rate of a chained 8192^3 bf16 matmul, with the ~67ms tunnel
+    RTT cancelled by differencing two chain lengths. MFU against this number
+    is the honest utilization figure; against nominal peak it mostly measures
+    co-tenant load."""
+    import time
+
+    import jax
+    import jax.numpy as jnp
+
+    n = 8192
+    rng = np.random.RandomState(0)
+    a = jnp.asarray(rng.randn(n, n), jnp.bfloat16)
+    b = jnp.asarray(rng.randn(n, n), jnp.bfloat16)
+
+    def chain(k):
+        @jax.jit
+        def f(a, b):
+            x = a
+            for _ in range(k):
+                x = x @ b
+            return jnp.sum(x.astype(jnp.float32))
+
+        float(jax.device_get(f(a, b)))  # compile
+        best = float("inf")
+        for _ in range(8):
+            t0 = time.perf_counter()
+            float(jax.device_get(f(a, b)))
+            best = min(best, time.perf_counter() - t0)
+        return best
+
+    t8, t40 = chain(8), chain(40)
+    per_mm = max((t40 - t8) / 32, 1e-9)
+    return 2 * n ** 3 / per_mm / 1e12
+
+
 def _bench_serving(on_tpu: bool):
     """Batch-1 latency serving bench: prefill p50, per-token decode latency,
     decode tokens/sec — bf16 and int8 weight-only."""
@@ -152,6 +189,12 @@ def main():
         serving = _bench_serving(on_tpu)
     except Exception as e:  # serving must never mask the training line
         serving = {"error": f"{type(e).__name__}: {e}"}
+    attainable = None
+    if on_tpu:
+        try:
+            attainable = round(_attainable_tflops(), 1)
+        except Exception:
+            pass
 
     print(json.dumps({
         "metric": "gpt2_125m_train_tokens_per_sec_per_chip" if on_tpu
@@ -163,6 +206,11 @@ def main():
         # 1:1 with pre-2026-07-30 single-window numbers
         "method": f"best_of_{windows}x{steps}step_windows",
         "achieved_tflops_per_chip": round(achieved_tflops, 1),
+        # what a pure bf16 matmul chain sustains on this chip right now —
+        # the honest MFU denominator on a time-shared tunnel chip
+        "attainable_tflops_per_chip": attainable,
+        "mfu_vs_attainable": (round(achieved_tflops / attainable, 3)
+                              if attainable else None),
         "serving": serving,
     }))
 

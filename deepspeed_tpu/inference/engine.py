@@ -312,10 +312,20 @@ class InferenceEngine:
             def body(carry):
                 i, tok, cache, rng, done, prev_done, buf = carry
                 buf = buf.at[i].set(tok)
-                logits, cache = model.forward_with_cache(params, tok[:, None], cache)
-                rng, sub = jax.random.split(rng)
-                nxt = pick(logits[:, -1], temp, sub)
-                nxt = jnp.where(done, pad_token_id, nxt)
+
+                def do_step(args):
+                    tok, cache, rng = args
+                    logits, cache = model.forward_with_cache(
+                        params, tok[:, None], cache)
+                    rng, sub = jax.random.split(rng)
+                    nxt = pick(logits[:, -1], temp, sub)
+                    return jnp.where(done, pad_token_id, nxt), cache, rng
+
+                # skip the decode forward when this was the last token to
+                # emit (parity with the scan path's max_new - 1 forwards)
+                need = (i + 1 < max_new) & ~jnp.all(done)
+                nxt, cache, rng = jax.lax.cond(
+                    need, do_step, lambda args: args, (tok, cache, rng))
                 return (i + 1, nxt, cache, rng,
                         done | (nxt == eos_token_id), done, buf)
 

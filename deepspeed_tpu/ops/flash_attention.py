@@ -32,10 +32,17 @@ DEFAULT_BLOCK_K = 128
 _NEG_INF = -1e30
 
 
+def _dot_f32(a, b, dims):
+    """MXU-native matmul: inputs stay in their storage dtype (bf16 on the
+    training path — full MXU rate), accumulation in f32."""
+    return jax.lax.dot_general(a, b, (dims, ((), ())),
+                               preferred_element_type=jnp.float32)
+
+
 def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_k: int,
                 causal: bool, scale: float, seq_len: int, block_q: int):
     qi = pl.program_id(1)
-    q = q_ref[...].astype(jnp.float32) * scale          # [BQ, Dh]
+    q = q_ref[...]                                      # [BQ, Dh] storage dtype
     bq, dh = q.shape
     q_pos = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, (bq, block_k), 0)
 
@@ -43,9 +50,9 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_k: int,
 
     def body(kj, carry):
         m, l, acc = carry
-        k = k_ref[pl.ds(kj * block_k, block_k), :].astype(jnp.float32)  # [BK, Dh]
-        v = v_ref[pl.ds(kj * block_k, block_k), :].astype(jnp.float32)
-        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())))  # [BQ, BK]
+        k = k_ref[pl.ds(kj * block_k, block_k), :]      # [BK, Dh]
+        v = v_ref[pl.ds(kj * block_k, block_k), :]
+        s = _dot_f32(q, k, ((1,), (1,))) * scale        # [BQ, BK] f32
         if causal:
             k_pos = kj * block_k + jax.lax.broadcasted_iota(
                 jnp.int32, (bq, block_k), 1)
@@ -54,7 +61,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_k: int,
         p = jnp.exp(s - m_new[:, None])
         corr = jnp.exp(m - m_new)
         l = l * corr + p.sum(axis=-1)
-        acc = acc * corr[:, None] + jax.lax.dot(p, v)
+        acc = acc * corr[:, None] + _dot_f32(p.astype(v.dtype), v, ((1,), (0,)))
         return m_new, l, acc
 
     m0 = jnp.full((bq,), _NEG_INF, jnp.float32)
@@ -77,8 +84,8 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *,
                    block_k: int, causal: bool, scale: float, seq_len: int,
                    block_q: int):
     qi = pl.program_id(1)
-    q = q_ref[...].astype(jnp.float32) * scale
-    do = do_ref[...].astype(jnp.float32)
+    q = q_ref[...]
+    do = do_ref[...]
     lse = lse_ref[...][:, 0]
     delta = delta_ref[...][:, 0]
     bq, dh = q.shape
@@ -86,17 +93,17 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *,
     nk = seq_len // block_k
 
     def body(kj, dq):
-        k = k_ref[pl.ds(kj * block_k, block_k), :].astype(jnp.float32)
-        v = v_ref[pl.ds(kj * block_k, block_k), :].astype(jnp.float32)
-        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())))
+        k = k_ref[pl.ds(kj * block_k, block_k), :]
+        v = v_ref[pl.ds(kj * block_k, block_k), :]
+        s = _dot_f32(q, k, ((1,), (1,))) * scale
         if causal:
             k_pos = kj * block_k + jax.lax.broadcasted_iota(
                 jnp.int32, (bq, block_k), 1)
             s = jnp.where(k_pos <= q_pos, s, _NEG_INF)
         p = jnp.exp(s - lse[:, None])
-        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())))
+        dp = _dot_f32(do, v, ((1,), (1,)))
         ds = p * (dp - delta[:, None])
-        return dq + jax.lax.dot(ds, k)
+        return dq + _dot_f32(ds.astype(k.dtype), k, ((1,), (0,)))
 
     if causal:
         nk_eff = jnp.minimum(nk, (qi * block_q + block_q + block_k - 1) // block_k)
@@ -110,28 +117,29 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                     dk_ref, dv_ref, *, block_q: int, causal: bool, scale: float,
                     seq_len: int, block_k: int):
     kj = pl.program_id(1)
-    k = k_ref[...].astype(jnp.float32)
-    v = v_ref[...].astype(jnp.float32)
+    k = k_ref[...]
+    v = v_ref[...]
     bk, dh = k.shape
     k_pos = kj * block_k + jax.lax.broadcasted_iota(jnp.int32, (block_q, bk), 1)
     nq = seq_len // block_q
 
     def body(qi, carry):
         dk, dv = carry
-        q = q_ref[pl.ds(qi * block_q, block_q), :].astype(jnp.float32) * scale
-        do = do_ref[pl.ds(qi * block_q, block_q), :].astype(jnp.float32)
+        q = q_ref[pl.ds(qi * block_q, block_q), :]
+        do = do_ref[pl.ds(qi * block_q, block_q), :]
         lse = lse_ref[pl.ds(qi * block_q, block_q), 0]
         delta = delta_ref[pl.ds(qi * block_q, block_q), 0]
-        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())))  # [BQ, BK]
+        s = _dot_f32(q, k, ((1,), (1,))) * scale  # [BQ, BK]
         if causal:
             q_pos = qi * block_q + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, bk), 0)
             s = jnp.where(k_pos <= q_pos, s, _NEG_INF)
         p = jnp.exp(s - lse[:, None])
-        dv = dv + jax.lax.dot_general(p, do, (((0,), (0,)), ((), ())))
-        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())))
+        pb = p.astype(do.dtype)
+        dv = dv + _dot_f32(pb, do, ((0,), (0,)))
+        dp = _dot_f32(do, v, ((1,), (1,)))
         ds = p * (dp - delta[:, None])
-        dk = dk + jax.lax.dot_general(ds, q, (((0,), (0,)), ((), ())))
+        dk = dk + _dot_f32(ds.astype(q.dtype), q, ((0,), (0,)))
         return dk, dv
 
     if causal:
@@ -141,9 +149,8 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     dk0 = jnp.zeros((bk, dh), jnp.float32)
     dv0 = jnp.zeros((bk, dh), jnp.float32)
     dk, dv = jax.lax.fori_loop(q_start, nq, body, (dk0, dv0))
-    # q was loaded pre-scaled, so dk = ds^T @ (q*scale) already carries the
-    # softmax scale — no extra factor here (dq DOES need it: k is unscaled)
-    dk_ref[...] = dk.astype(dk_ref.dtype)
+    # s was computed from UNSCALED q, so dk needs the softmax scale (like dq)
+    dk_ref[...] = (dk * scale).astype(dk_ref.dtype)
     dv_ref[...] = dv.astype(dv_ref.dtype)
 
 
@@ -205,7 +212,14 @@ def _flash_fwd(q, k, v, causal, scale, block_q, block_k, interpret):
         ],
         interpret=interp,
     )(qf, kf, vf)
-    return _unshape_bh(out, b, h), (qf, kf, vf, out, lse, (b, h))
+    # Residuals tagged for remat: the "flash_res" checkpoint-name lets the
+    # save_attn policy (runtime/activation_checkpointing.py) SAVE them, so a
+    # rematted transformer block never re-runs this kernel in backward —
+    # flash residuals are O(T) (out + lse), unlike dense attention's O(T^2).
+    from jax.ad_checkpoint import checkpoint_name
+
+    res = tuple(checkpoint_name(x, "flash_res") for x in (qf, kf, vf, out, lse))
+    return _unshape_bh(out, b, h), res + ((b, h),)
 
 
 def _flash_fwd_vjp(q, k, v, causal, scale, block_q, block_k, interpret):

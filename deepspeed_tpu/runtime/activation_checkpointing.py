@@ -74,6 +74,16 @@ def _build_policies():
     if hasattr(cp, "save_anything_except_these_names"):
         _POLICIES["offload_dots"] = getattr(
             cp, "offload_dot_with_no_batch_dims", cp.dots_with_no_batch_dims_saveable)
+    if hasattr(cp, "save_only_these_names") and \
+            hasattr(cp, "save_from_both_policies"):
+        # save weight-matmul outputs AND the flash-attention residuals
+        # (tagged "flash_res" in ops/flash_attention.py) — backward replays
+        # only cheap elementwise work, never the attention kernel. The
+        # TPU-native answer to the reference's selective activation
+        # checkpointing (runtime/activation_checkpointing.py:474).
+        _POLICIES["save_attn"] = cp.save_from_both_policies(
+            cp.dots_with_no_batch_dims_saveable,
+            cp.save_only_these_names("flash_res"))
     return _POLICIES
 
 

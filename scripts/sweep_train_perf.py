@@ -65,8 +65,6 @@ def run_config(attn_impl, remat, remat_policy, batch, gas, loss_chunk=0,
 
 
 def main():
-    import sys
-
     grid = [
         # (attn_impl, remat, policy, mb, gas[, loss_chunk])
         ("dense", True, "dots_no_batch", 8, 8),
@@ -77,6 +75,9 @@ def main():
         ("dense", True, "dots_no_batch", 32, 2),
         ("dense", True, "dots_no_batch", 8, 8, 512),   # chunked LM loss
         ("flash", False, None, 8, 8),                  # sweep-1 runner-up
+        ("flash", True, "save_attn", 4, 16),           # idx 8: selective remat
+        ("flash", True, "save_attn", 8, 8),            # idx 9
+        ("flash", True, "save_attn", 16, 4),           # idx 10
     ]
     if len(sys.argv) > 1:  # allow running a subset: indices as args
         grid = [grid[int(i)] for i in sys.argv[1:]]

@@ -126,7 +126,8 @@ def test_eos_stops_and_pads():
 def test_top_p_filter_matches_hf_warper():
     """Support-set parity with transformers' TopPLogitsWarper (the filter the
     reference's serving path applies inside HF generate)."""
-    import torch
+    torch = pytest.importorskip("torch")
+    transformers = pytest.importorskip("transformers")
     from transformers.generation.logits_process import TopPLogitsWarper
 
     from deepspeed_tpu.inference.engine import filter_logits
