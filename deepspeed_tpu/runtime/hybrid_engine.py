@@ -95,10 +95,25 @@ class DeepSpeedHybridEngine(DeepSpeedEngine):
             else False
 
     # ---------------------------------------------------------------- engine
+    def _generation_topology(self):
+        """Per-generation TP resize (reference hybrid_engine.py:168
+        inference_tp_size): when the configured generation TP differs from
+        the training mesh's, build a second mesh over the SAME devices with
+        model-axis = inference_tp_size (remaining ways go to data). Params
+        are resharded into it on every weight refresh."""
+        tp = self._he_cfg.inference_tp_size
+        if tp == self.topology.model_parallel_size:
+            return self.topology
+        from deepspeed_tpu.parallel.topology import build_topology
+
+        devices = list(self.topology.mesh.devices.flat)
+        return build_topology(world_size=len(devices), tp=tp, devices=devices)
+
     def _inference(self):
         if self._inference_engine is None:
             from deepspeed_tpu.inference.config import DeepSpeedInferenceConfig
             from deepspeed_tpu.inference.engine import InferenceEngine
+            from deepspeed_tpu.utils import groups as groups_mod
 
             dtype = {"float16": "fp16", "bfloat16": "bf16"}.get(
                 self.compute_dtype.__name__, "fp32")
@@ -109,16 +124,24 @@ class DeepSpeedHybridEngine(DeepSpeedEngine):
             )
             self._inference_engine = InferenceEngine(
                 self.module, cfg, params=self._eval_params(),
-                topology=self.topology)
+                topology=self._generation_topology())
+            # InferenceEngine.__init__ re-points the global topology at the
+            # generation mesh; training collectives must keep seeing theirs
+            groups_mod.initialize(self.topology)
         return self._inference_engine
 
-    def _eval_params(self):
-        """Current weights for generation: compute dtype + LoRA fused."""
+    def _cast_params(self):
+        """Current weights in compute dtype, LoRA adapters still separate."""
         params = self.state.params
         if getattr(self, "_host_opt", None) is None:
             params = jax.tree_util.tree_map(
                 lambda p: p.astype(self.compute_dtype)
                 if p.dtype == jnp.float32 else p, params)
+        return params
+
+    def _eval_params(self):
+        """Current weights for generation: compute dtype + LoRA fused."""
+        params = self._cast_params()
         if self._has_lora:
             params = fuse_lora(params)
         return params
@@ -129,7 +152,13 @@ class DeepSpeedHybridEngine(DeepSpeedEngine):
         (reference generate:168)."""
         t0 = time.perf_counter()
         inf = self._inference()
-        inf.params = self._eval_params()  # refresh weights; compiled fn reused
+        # refresh weights; the compiled decode fn is reused (values change,
+        # not shapes). Only a resized generation mesh needs an explicit
+        # reshard — same-topology refreshes assign directly and let the
+        # compiled program place them at dispatch.
+        params = self._eval_params()
+        inf.params = params if inf.topology is self.topology \
+            else inf._shard_and_cast(params)
         out = inf.generate(input_ids, **kwargs)
         self.generate_calls += 1
         self.generate_latency_s += time.perf_counter() - t0

@@ -71,11 +71,17 @@ class _ShardMeta:
         return slots
 
 
+def _is_shardable(leaf) -> bool:
+    """Only real jax Arrays enter shard-local storage (tests monkeypatch
+    this to inject fake partial-ownership shard views)."""
+    return isinstance(leaf, jax.Array)
+
+
 def _leaf_meta(leaf, force_sharded: bool):
     """leaf → _ShardMeta for shard-local storage, or None for dense.
     Reads only shard metadata (shapes/indices/devices) — no transfers."""
-    if isinstance(leaf, jax.Array) and (force_sharded or
-                                        not leaf.is_fully_addressable):
+    if _is_shardable(leaf) and (force_sharded or
+                                not leaf.is_fully_addressable):
         uniq: Dict[Tuple, Any] = {}
         devices: Dict[Tuple, List] = {}
         for s in leaf.addressable_shards:

@@ -21,7 +21,7 @@ def _seq_batch(rng, gas=2, batch=8, seq=16, vocab=64):
             "labels": s[:, :, 1:].astype(np.int32)}
 
 
-def _engine(**over):
+def _engine(compute_dtype=jnp.bfloat16, **over):
     from deepspeed_tpu.models.gpt2 import GPT2Config, GPT2Model
 
     cfg = GPT2Config(vocab_size=64, max_seq_len=32, num_layers=2,
@@ -32,7 +32,8 @@ def _engine(**over):
               "hybrid_engine": {"enabled": True, "max_out_tokens": 64},
               "steps_per_print": 0}
     config.update(over)
-    engine, *_ = deepspeed_tpu.initialize(model=GPT2Model(cfg), config=config)
+    engine, *_ = deepspeed_tpu.initialize(
+        model=GPT2Model(cfg, compute_dtype=compute_dtype), config=config)
     return engine
 
 
@@ -72,6 +73,176 @@ class TestHybridEngine:
         engine.generate(prompt, max_new_tokens=4)
         # same shapes → same compiled entry (no retrace on weight update)
         assert list(engine._inference()._compiled) == list(compiled)
+
+
+class _LoraBigramLM:
+    """Tiny causal bigram LM with a LoRA adapter on its projection — enough
+    structure for the RLHF loop: trainable LoRA node, decode interface
+    (init_cache/forward_with_cache) that consumes FUSED weights only."""
+
+    import types as _types
+
+    def __init__(self, vocab=64, dim=32, r=4):
+        self.vocab, self.dim, self.r = vocab, dim, r
+        self.config = self._types.SimpleNamespace(
+            vocab_size=vocab, max_seq_len=10 ** 6, has_position_table=False)
+
+    def init(self, rng):
+        k = jax.random.split(rng, 4)
+        init = jax.nn.initializers.normal(0.2)
+        return {
+            "emb": init(k[0], (self.vocab, self.dim), jnp.float32),
+            "proj": {"w": init(k[1], (self.dim, self.dim), jnp.float32),
+                     "lora_a": init(k[2], (self.dim, self.r), jnp.float32),
+                     "lora_b": jnp.zeros((self.r, self.dim), jnp.float32),
+                     "lora_alpha": jnp.asarray(float(self.r))},
+            "head": init(k[3], (self.dim, self.vocab), jnp.float32),
+        }
+
+    def _hidden(self, params, ids, w_eff):
+        h = params["emb"].astype(jnp.float32)[ids]
+        return jnp.tanh(h @ w_eff)
+
+    def apply(self, params, batch, *, rngs=None, train=False):
+        p = params["proj"]
+        w_eff = p["w"] + (p["lora_alpha"] / self.r) * (p["lora_a"] @ p["lora_b"])
+        h = self._hidden(params, batch["input_ids"], w_eff)
+        logits = h @ params["head"].astype(jnp.float32)
+        labels = batch["labels"]
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        loss = -jnp.mean(jnp.take_along_axis(logp, labels[..., None], -1))
+        return loss, {"loss": loss}
+
+    # decode interface: fused weights only (the hybrid engine fuses LoRA
+    # before handing params over — reference fuse_lora_weight semantics)
+    def init_cache(self, b, total, dtype=None):
+        return jnp.zeros((b,), jnp.int32)
+
+    def forward_with_cache(self, params, ids, cache):
+        h = self._hidden(params, ids, params["proj"]["w"])
+        return h @ params["head"].astype(jnp.float32), cache
+
+
+class TestRLHFLoop:
+    """RLHF-shaped e2e (reference hybrid_engine.py:168 generate /
+    :333 _zero3_forward): actor with LoRA trains under ZeRO-3 on the
+    8-device mesh, alternating generate -> reward -> train; decode must see
+    post-step weights and LoRA fusion must round-trip."""
+
+    VOCAB = 64
+
+    def _reward(self, rows):
+        # +1 arithmetic continuation quality in [0, 1]
+        diffs = (np.diff(rows, axis=1) % self.VOCAB) == 1
+        return diffs.mean(axis=1)
+
+    def _experience_batch(self, rng, gas=2, batch=8, seq=12):
+        start = rng.randint(0, self.VOCAB // 2, size=(gas, batch, 1))
+        s = (start + np.arange(seq + 1)) % self.VOCAB
+        return {"input_ids": s[:, :, :-1].astype(np.int32),
+                "labels": s[:, :, 1:].astype(np.int32)}
+
+    def test_generate_reward_train_alternation_zero3(self):
+        from deepspeed_tpu.runtime.hybrid_engine import fuse_lora
+
+        model = _LoraBigramLM(vocab=self.VOCAB)
+        engine, *_ = deepspeed_tpu.initialize(model=model, config={
+            "train_batch_size": 16, "gradient_accumulation_steps": 2,
+            "optimizer": {"type": "AdamW", "params": {"lr": 5e-2}},
+            "zero_optimization": {"stage": 3,
+                                  "stage3_param_persistence_threshold": 0},
+            "hybrid_engine": {"enabled": True, "max_out_tokens": 64},
+            "steps_per_print": 0})
+        assert isinstance(engine, DeepSpeedHybridEngine)
+        assert engine._has_lora
+        rng = np.random.RandomState(0)
+        prompt = np.array([[3, 4, 5, 6], [10, 11, 12, 13]], dtype=np.int32)
+
+        rewards, gens = [], []
+        for _round in range(2):                      # >= 2 alternations
+            out = engine.generate(prompt, max_new_tokens=6)
+            gens.append(out)
+            rewards.append(self._reward(out).mean())
+            for _ in range(25):
+                engine.train_batch_from_stacked(self._experience_batch(rng))
+        final = engine.generate(prompt, max_new_tokens=6)
+
+        # decode sees post-step weights: trained actor continues +1 runs
+        np.testing.assert_array_equal(final[:, 4:],
+                                      (prompt[:, -1:] + np.arange(1, 7)) % self.VOCAB)
+        assert self._reward(final).mean() > rewards[0]
+        assert not np.array_equal(gens[0], final)
+
+        # LoRA round-trip: generation fused lora into the decode weights...
+        inf_w = np.asarray(jax.device_get(
+            engine._inference().params["proj"]["w"]))
+        expect_w = np.asarray(jax.device_get(
+            fuse_lora(engine._cast_params())["proj"]["w"]))
+        np.testing.assert_allclose(inf_w, expect_w, rtol=1e-5, atol=1e-6)
+        # ...while the training masters keep base + adapters SEPARATE
+        p = engine.state.params["proj"]
+        assert float(jnp.abs(p["lora_b"]).sum()) > 0  # adapters trained
+        assert not np.allclose(np.asarray(jax.device_get(p["w"])), inf_w)
+
+    def test_zero3_params_sharded_during_rlhf(self):
+        model = _LoraBigramLM(vocab=self.VOCAB)
+        engine, *_ = deepspeed_tpu.initialize(model=model, config={
+            "train_batch_size": 16, "gradient_accumulation_steps": 2,
+            "optimizer": {"type": "AdamW", "params": {"lr": 1e-2}},
+            "zero_optimization": {"stage": 3,
+                                  "stage3_param_persistence_threshold": 0},
+            "hybrid_engine": {"enabled": True},
+            "steps_per_print": 0})
+        rng = np.random.RandomState(1)
+        engine.train_batch_from_stacked(self._experience_batch(rng))
+        spec = str(engine.state.params["emb"].sharding.spec)
+        assert "data" in spec, spec
+        out = engine.generate(np.array([[1, 2]], np.int32), max_new_tokens=3)
+        assert out.shape == (1, 5)
+
+
+class TestGenerationTPResize:
+    """inference_tp_size analog (reference hybrid_engine.py:168): generation
+    runs on a model-axis mesh resized per config, training mesh untouched,
+    and outputs match the training-mesh generation exactly."""
+
+    def test_tp2_generation_matches_tp1(self):
+        from deepspeed_tpu.utils import groups
+
+        engine = _engine(compute_dtype=jnp.float32,
+                         **{"bf16": {"enabled": False},
+                            "hybrid_engine": {"enabled": True,
+                                              "max_out_tokens": 64,
+                                              "inference_tp_size": 2}})
+        rng = np.random.RandomState(0)
+        for _ in range(5):
+            engine.train_batch_from_stacked(_seq_batch(rng))
+        prompt = np.array([[5, 6, 7, 8]], dtype=np.int32)
+        out_tp2 = engine.generate(prompt, max_new_tokens=6)
+
+        inf = engine._inference()
+        assert inf.topology.model_parallel_size == 2
+        assert engine.topology.model_parallel_size == 1
+        # the training engine's global topology is restored after generation
+        assert groups.get_topology() is engine.topology
+        # params really live on the generation mesh's model axis
+        blk_spec = str(inf.params["blocks"]["qkv_w"].sharding.spec)
+        assert "model" in blk_spec, blk_spec
+
+        # reference: same weights served without TP resize
+        from deepspeed_tpu.inference.config import DeepSpeedInferenceConfig
+        from deepspeed_tpu.inference.engine import InferenceEngine
+
+        ref = InferenceEngine(engine.module, DeepSpeedInferenceConfig(
+            dtype="fp32", max_out_tokens=64), params=engine._eval_params(),
+            topology=engine.topology)
+        groups.initialize(engine.topology)
+        out_tp1 = ref.generate(prompt, max_new_tokens=6)
+        np.testing.assert_array_equal(out_tp2, out_tp1)
+        # training continues cleanly after the resized generation
+        loss = float(jax.device_get(
+            engine.train_batch_from_stacked(_seq_batch(rng))))
+        assert np.isfinite(loss)
 
 
 class TestLoraFusion:

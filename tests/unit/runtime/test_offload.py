@@ -189,3 +189,84 @@ class TestShardedHostState:
             losses.append(float(np.asarray(loss)))
         assert all(np.isfinite(losses)), losses
         assert losses[-1] < losses[0], losses
+
+
+class TestShardMetaPartialOwnership:
+    """The true multi-process branches of _ShardMeta (offload.py:44-73):
+    a process that addresses only a SUBSET of a param's shards must store
+    exactly its unique slice set, dedup replicas, and fail loudly when a
+    gradient's shard layout diverges from the master layout. Simulated with
+    faked shard views — a real >1-process mesh needs a pod (documented in
+    offload.py's module docstring)."""
+
+    class _FakeShard:
+        def __init__(self, index, data, device):
+            self.index, self.data, self.device = index, data, device
+
+    class _FakeArray:
+        is_fully_addressable = False
+
+        def __init__(self, shape, shards):
+            self.shape = shape
+            self.addressable_shards = shards
+
+    def _partial_array(self, rows=8, cols=4, owned=(0, 1), replicas=2):
+        """Global [rows, cols] sharded row-wise into 4; this process owns
+        `owned` shard indices, each replicated `replicas` times (distinct
+        devices) — like tp-replicated zero shards on a pod."""
+        import jax
+
+        full = np.arange(rows * cols, dtype=np.float32).reshape(rows, cols)
+        step = rows // 4
+        shards = []
+        for i in owned:
+            idx = (slice(i * step, (i + 1) * step, None), slice(None))
+            for r in range(replicas):
+                shards.append(self._FakeShard(
+                    idx, full[i * step:(i + 1) * step], device=f"d{i}_{r}"))
+        return self._FakeArray((rows, cols), shards), full
+
+    @staticmethod
+    def _patch_shardable(monkeypatch):
+        from deepspeed_tpu.runtime.zero import offload
+
+        monkeypatch.setattr(
+            offload, "_is_shardable",
+            lambda leaf: hasattr(leaf, "addressable_shards"))
+
+    def test_leaf_meta_dedups_replicas_and_keeps_only_owned(self, monkeypatch):
+        from deepspeed_tpu.runtime.zero.offload import _leaf_meta
+
+        self._patch_shardable(monkeypatch)
+        arr, _ = self._partial_array(owned=(0, 2), replicas=3)
+        meta = _leaf_meta(arr, force_sharded=False)
+        assert meta is not None          # not fully addressable -> sharded
+        assert len(meta.parts) == 2      # one entry per UNIQUE index
+        assert all(len(devs) == 3 for (_k, _i, _s, devs) in meta.parts)
+        owned_elems = sum(int(np.prod(s)) for (_k, _i, s, _d) in meta.parts)
+        assert owned_elems == np.prod(arr.shape) // 2  # half the global
+
+    def test_collect_orders_and_batches(self, monkeypatch):
+        from deepspeed_tpu.runtime.zero.offload import _leaf_meta
+
+        self._patch_shardable(monkeypatch)
+        arr, full = self._partial_array(owned=(1, 3), replicas=1)
+        meta = _leaf_meta(arr, force_sharded=False)
+        sink = ["sentinel"]
+        slots = meta.collect(arr, sink)
+        assert slots == [1, 2]           # appended after existing entries
+        got = np.concatenate([np.asarray(sink[i]).reshape(-1) for i in slots])
+        want = np.concatenate([full[2:4].reshape(-1), full[6:8].reshape(-1)])
+        np.testing.assert_array_equal(got, want)
+
+    def test_collect_rejects_mismatched_grad_layout(self, monkeypatch):
+        import pytest
+
+        from deepspeed_tpu.runtime.zero.offload import _leaf_meta
+
+        self._patch_shardable(monkeypatch)
+        master, _ = self._partial_array(owned=(0, 1))
+        grads, _ = self._partial_array(owned=(0, 2))  # different shard set
+        meta = _leaf_meta(master, force_sharded=False)
+        with pytest.raises(ValueError, match="shard layout"):
+            meta.collect(grads, [])
