@@ -10,7 +10,11 @@ XLA program, so these classes serve two roles:
   1. documentation/validation of the tick-level semantics (tested directly —
      the SPMD executor's microbatch/stage occupancy must agree with
      ``TrainSchedule``), and
-  2. the host-driven execution path for heterogeneous stages.
+  2. SPEC for a future host-driven inter-stage mode. No production code
+     interprets these streams today — that becomes necessary only for
+     multi-slice DCN pipelining, where stage boundaries cross slices and a
+     single SPMD program cannot span the job. Deliberate deferral, recorded
+     in COMPONENTS.md "Known gaps".
 """
 
 from __future__ import annotations
