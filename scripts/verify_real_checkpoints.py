@@ -67,8 +67,10 @@ def check_causal_lm(model_id: str, name: str, prompt_len: int = 16):
     # greedy rollouts must also agree token-for-token
     our_toks = engine.generate(ids[:1].astype(np.int32), max_new_tokens=8)
     with torch.no_grad():
+        # min_new_tokens keeps HF from stopping at EOS early — our side is
+        # not passed an eos_token_id, so the arrays must be length-equal
         hf_toks = hf.generate(torch.tensor(ids[:1]), max_new_tokens=8,
-                              do_sample=False).numpy()
+                              min_new_tokens=8, do_sample=False).numpy()
     if not np.array_equal(our_toks, hf_toks):
         return _record(name, "FAILED",
                        f"greedy rollouts diverge: {our_toks} vs {hf_toks}")
