@@ -181,18 +181,23 @@ def main():
 
     if on_tpu:
         cfg = GPT2Config.gpt2_125m()
-        # micro-batch 4 x gas 16: won repeated interleaved pairings vs
-        # 2x32 / 8x8 / 16x4 (best observed ~138k tok/s). NOTE: the tunnel
-        # chip is time-shared and identical configs swing 4x between
+        # Pallas flash attention (512-blocks, gridded K/V walk), NO remat,
+        # micro-batch 8 x gas 8: won the 2026-07-31 sweep at 92,960 tok/s vs
+        # 73.5k for the old dense+dots_no_batch mb4x16 champion (see
+        # scripts/sweep_train_perf.py; dense controls re-measured in the
+        # same windows). mb16 OOMs on no-remat saved activations. NOTE: the
+        # tunnel chip is time-shared and identical configs swing 4x between
         # minutes — the timing loop below takes the best of several short
         # windows to approximate uncontended capability.
-        batch, seq, steps, gas = 4, 1024, 8, 16
+        batch, seq, steps, gas = 8, 1024, 8, 8
+        attn_impl = "flash"
     else:  # CPU smoke fallback so the script always emits its JSON line
         cfg = GPT2Config(vocab_size=2048, max_seq_len=256, num_layers=4,
                          hidden_size=256, num_heads=8)
         batch, seq, steps, gas = 4, 256, 3, 1
+        attn_impl = "dense"
 
-    model = GPT2Model(cfg, remat=on_tpu, remat_policy="dots_no_batch" if on_tpu else None)
+    model = GPT2Model(cfg, attn_impl=attn_impl)
     config = {
         "train_batch_size": batch * gas,
         "gradient_accumulation_steps": gas,

@@ -3,15 +3,26 @@
 Reference counterpart: the fused attention CUDA kernels
 (``csrc/transformer/softmax_kernels.cu`` training softmax,
 ``csrc/transformer/inference/csrc/softmax.cu``) — on TPU the fused,
-memory-efficient form is a Pallas kernel tiled for the MXU: O(T) VMEM per
-query block instead of materializing the [T, T] score matrix in HBM.
+memory-efficient form is a Pallas kernel tiled for the MXU: O(block) VMEM
+per grid step instead of materializing the [T, T] score matrix in HBM.
 
-Layout: inputs [B, T, H, Dh] (framework-standard); kernels run per (b·h)
-with a grid over query blocks; K/V for the (b·h) live in VMEM and are
-scanned block-by-block with an online softmax. The backward pass is the
+Layout: inputs [B, T, H, Dh] (framework-standard). The key/value walk is a
+GRID dimension (not an in-kernel loop over a VMEM-resident K/V copy), so
+VMEM holds only (block_q x Dh) + (block_k x Dh) tiles at any sequence
+length — double-buffered full-T K/V residency OOM'd scoped VMEM at
+seq 8192. Online-softmax state (m, l, acc) lives in VMEM scratch carried
+across the innermost (sequential) grid dimension; causal skipping masks
+whole blocks above the diagonal via ``pl.when``. The backward pass is the
 standard two-kernel FA2 recomputation (dq; dk/dv) using the saved
-log-sum-exp rows. Composes with ring attention (ops/ring_attention.py) for
-sequence lengths beyond one chip's VMEM.
+log-sum-exp rows, with the same grid structure. Matmuls run in the storage
+dtype (bf16 on the training path — full MXU rate) with f32 accumulation.
+Known tradeoff: causally-masked grid steps skip COMPUTE via ``pl.when`` but
+still fetch their K/V tiles (Pallas grids are rectangular) — ~2x the K/V
+bandwidth of a bounded walk on the causal path; measured wins at seq
+1024-8192 absorb it (tiles are small vs the T^2 compute), revisit with a
+per-qi bounded inner loop if a profile ever shows fetch-bound behavior.
+Composes with ring attention (ops/ring_attention.py) for sequence lengths
+beyond one chip.
 
 Exposed as ``flash_attention(q, k, v, causal=...)`` with a custom_vjp;
 ``interpret=True`` (CPU tests) runs the same kernels in the Pallas
@@ -26,9 +37,13 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
 
-DEFAULT_BLOCK_Q = 128
-DEFAULT_BLOCK_K = 128
+# 512-blocks amortize per-grid-step overhead (measured 2026-07-31 on-chip:
+# (512,512) >> (256,256) > (128,128) for fwd+bwd at seq 2048; (1024,1024)
+# regresses — the [bq,bk] f32 score tile outgrows VMEM headroom)
+DEFAULT_BLOCK_Q = 512
+DEFAULT_BLOCK_K = 512
 _NEG_INF = -1e30
 
 
@@ -39,119 +54,123 @@ def _dot_f32(a, b, dims):
                                preferred_element_type=jnp.float32)
 
 
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_k: int,
-                causal: bool, scale: float, seq_len: int, block_q: int):
+def _causal_mask(s, qi, kj, block_q, block_k):
+    bq, bk = s.shape
+    q_pos = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+    k_pos = kj * block_k + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+    return jnp.where(k_pos <= q_pos, s, _NEG_INF)
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref, l_ref, acc_ref, *,
+                causal: bool, scale: float, block_q: int, block_k: int,
+                nk: int):
     qi = pl.program_id(1)
-    q = q_ref[...]                                      # [BQ, Dh] storage dtype
-    bq, dh = q.shape
-    q_pos = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, (bq, block_k), 0)
+    kj = pl.program_id(2)
 
-    nk = seq_len // block_k
+    @pl.when(kj == 0)
+    def _init():
+        m_ref[...] = jnp.full_like(m_ref, _NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+        acc_ref[...] = jnp.zeros_like(acc_ref)
 
-    def body(kj, carry):
-        m, l, acc = carry
-        k = k_ref[pl.ds(kj * block_k, block_k), :]      # [BK, Dh]
-        v = v_ref[pl.ds(kj * block_k, block_k), :]
+    # causal: key block strictly above the diagonal contributes nothing
+    live = (kj * block_k <= qi * block_q + block_q - 1) if causal else True
+
+    @pl.when(live)
+    def _step():
+        q = q_ref[...]                                  # [BQ, Dh]
+        k = k_ref[...]                                  # [BK, Dh]
+        v = v_ref[...]
         s = _dot_f32(q, k, ((1,), (1,))) * scale        # [BQ, BK] f32
         if causal:
-            k_pos = kj * block_k + jax.lax.broadcasted_iota(
-                jnp.int32, (bq, block_k), 1)
-            s = jnp.where(k_pos <= q_pos, s, _NEG_INF)
-        m_new = jnp.maximum(m, s.max(axis=-1))
+            s = _causal_mask(s, qi, kj, block_q, block_k)
+        m_prev = m_ref[...][:, 0]
+        m_new = jnp.maximum(m_prev, s.max(axis=-1))
         p = jnp.exp(s - m_new[:, None])
-        corr = jnp.exp(m - m_new)
-        l = l * corr + p.sum(axis=-1)
-        acc = acc * corr[:, None] + _dot_f32(p.astype(v.dtype), v, ((1,), (0,)))
-        return m_new, l, acc
+        corr = jnp.exp(m_prev - m_new)
+        l_ref[...] = (l_ref[...][:, 0] * corr + p.sum(axis=-1))[:, None]
+        acc_ref[...] = acc_ref[...] * corr[:, None] + \
+            _dot_f32(p.astype(v.dtype), v, ((1,), (0,)))
+        m_ref[...] = m_new[:, None]
 
-    m0 = jnp.full((bq,), _NEG_INF, jnp.float32)
-    l0 = jnp.zeros((bq,), jnp.float32)
-    acc0 = jnp.zeros((bq, dh), jnp.float32)
-    if causal:
-        # skip key blocks strictly after this query block
-        nk_eff = jnp.minimum(nk, (qi * block_q + block_q + block_k - 1) // block_k)
-    else:
-        nk_eff = nk
-    m, l, acc = jax.lax.fori_loop(0, nk_eff, body, (m0, l0, acc0))
-    l_safe = jnp.maximum(l, 1e-20)
-    o_ref[...] = (acc / l_safe[:, None]).astype(o_ref.dtype)
-    # trailing unit dim: rank-2 (bq, 1) tiles satisfy the TPU block-shape
-    # constraint (1-D tiles fail Mosaic lowering)
-    lse_ref[...] = (m + jnp.log(l_safe)).astype(jnp.float32)[:, None]
+    @pl.when(kj == nk - 1)
+    def _finish():
+        l_safe = jnp.maximum(l_ref[...][:, 0], 1e-20)
+        o_ref[...] = (acc_ref[...] / l_safe[:, None]).astype(o_ref.dtype)
+        # trailing unit dim: rank-2 (bq, 1) tiles satisfy the TPU block-shape
+        # constraint (1-D tiles fail Mosaic lowering)
+        lse_ref[...] = (m_ref[...][:, 0] + jnp.log(l_safe))[:, None]
 
 
-def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *,
-                   block_k: int, causal: bool, scale: float, seq_len: int,
-                   block_q: int):
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
+                   dq_acc_ref, *, causal: bool, scale: float, block_q: int,
+                   block_k: int, nk: int):
     qi = pl.program_id(1)
-    q = q_ref[...]
-    do = do_ref[...]
-    lse = lse_ref[...][:, 0]
-    delta = delta_ref[...][:, 0]
-    bq, dh = q.shape
-    q_pos = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, (bq, block_k), 0)
-    nk = seq_len // block_k
+    kj = pl.program_id(2)
 
-    def body(kj, dq):
-        k = k_ref[pl.ds(kj * block_k, block_k), :]
-        v = v_ref[pl.ds(kj * block_k, block_k), :]
+    @pl.when(kj == 0)
+    def _init():
+        dq_acc_ref[...] = jnp.zeros_like(dq_acc_ref)
+
+    live = (kj * block_k <= qi * block_q + block_q - 1) if causal else True
+
+    @pl.when(live)
+    def _step():
+        q = q_ref[...]
+        k = k_ref[...]
+        v = v_ref[...]
+        do = do_ref[...]
+        lse = lse_ref[...][:, 0]
+        delta = delta_ref[...][:, 0]
         s = _dot_f32(q, k, ((1,), (1,))) * scale
         if causal:
-            k_pos = kj * block_k + jax.lax.broadcasted_iota(
-                jnp.int32, (bq, block_k), 1)
-            s = jnp.where(k_pos <= q_pos, s, _NEG_INF)
+            s = _causal_mask(s, qi, kj, block_q, block_k)
         p = jnp.exp(s - lse[:, None])
         dp = _dot_f32(do, v, ((1,), (1,)))
         ds = p * (dp - delta[:, None])
-        return dq + _dot_f32(ds.astype(k.dtype), k, ((1,), (0,)))
+        dq_acc_ref[...] += _dot_f32(ds.astype(k.dtype), k, ((1,), (0,)))
 
-    if causal:
-        nk_eff = jnp.minimum(nk, (qi * block_q + block_q + block_k - 1) // block_k)
-    else:
-        nk_eff = nk
-    dq = jax.lax.fori_loop(0, nk_eff, body, jnp.zeros((bq, dh), jnp.float32))
-    dq_ref[...] = (dq * scale).astype(dq_ref.dtype)
+    @pl.when(kj == nk - 1)
+    def _finish():
+        dq_ref[...] = (dq_acc_ref[...] * scale).astype(dq_ref.dtype)
 
 
 def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                    dk_ref, dv_ref, *, block_q: int, causal: bool, scale: float,
-                    seq_len: int, block_k: int):
+                    dk_ref, dv_ref, dk_acc_ref, dv_acc_ref, *, causal: bool,
+                    scale: float, block_q: int, block_k: int, nq: int):
     kj = pl.program_id(1)
-    k = k_ref[...]
-    v = v_ref[...]
-    bk, dh = k.shape
-    k_pos = kj * block_k + jax.lax.broadcasted_iota(jnp.int32, (block_q, bk), 1)
-    nq = seq_len // block_q
+    qi = pl.program_id(2)
 
-    def body(qi, carry):
-        dk, dv = carry
-        q = q_ref[pl.ds(qi * block_q, block_q), :]
-        do = do_ref[pl.ds(qi * block_q, block_q), :]
-        lse = lse_ref[pl.ds(qi * block_q, block_q), 0]
-        delta = delta_ref[pl.ds(qi * block_q, block_q), 0]
-        s = _dot_f32(q, k, ((1,), (1,))) * scale  # [BQ, BK]
+    @pl.when(qi == 0)
+    def _init():
+        dk_acc_ref[...] = jnp.zeros_like(dk_acc_ref)
+        dv_acc_ref[...] = jnp.zeros_like(dv_acc_ref)
+
+    # causal: query block strictly before this key block sees none of it
+    live = (qi * block_q + block_q - 1 >= kj * block_k) if causal else True
+
+    @pl.when(live)
+    def _step():
+        q = q_ref[...]
+        k = k_ref[...]
+        v = v_ref[...]
+        do = do_ref[...]
+        lse = lse_ref[...][:, 0]
+        delta = delta_ref[...][:, 0]
+        s = _dot_f32(q, k, ((1,), (1,))) * scale        # [BQ, BK]
         if causal:
-            q_pos = qi * block_q + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, bk), 0)
-            s = jnp.where(k_pos <= q_pos, s, _NEG_INF)
+            s = _causal_mask(s, qi, kj, block_q, block_k)
         p = jnp.exp(s - lse[:, None])
-        pb = p.astype(do.dtype)
-        dv = dv + _dot_f32(pb, do, ((0,), (0,)))
+        dv_acc_ref[...] += _dot_f32(p.astype(do.dtype), do, ((0,), (0,)))
         dp = _dot_f32(do, v, ((1,), (1,)))
         ds = p * (dp - delta[:, None])
-        dk = dk + _dot_f32(ds.astype(q.dtype), q, ((0,), (0,)))
-        return dk, dv
+        dk_acc_ref[...] += _dot_f32(ds.astype(q.dtype), q, ((0,), (0,)))
 
-    if causal:
-        q_start = (kj * block_k) // block_q  # first query block that sees us
-    else:
-        q_start = 0
-    dk0 = jnp.zeros((bk, dh), jnp.float32)
-    dv0 = jnp.zeros((bk, dh), jnp.float32)
-    dk, dv = jax.lax.fori_loop(q_start, nq, body, (dk0, dv0))
-    # s was computed from UNSCALED q, so dk needs the softmax scale (like dq)
-    dk_ref[...] = (dk * scale).astype(dk_ref.dtype)
-    dv_ref[...] = dv.astype(dv_ref.dtype)
+    @pl.when(qi == nq - 1)
+    def _finish():
+        # s was computed from UNSCALED q, so dk carries the softmax scale
+        dk_ref[...] = (dk_acc_ref[...] * scale).astype(dk_ref.dtype)
+        dv_ref[...] = dv_acc_ref[...].astype(dv_ref.dtype)
 
 
 def _reshape_bh(x):
@@ -169,6 +188,13 @@ def _pick_block(t: int, pref: int) -> int:
     while t % blk:
         blk //= 2
     return max(blk, 1)
+
+
+def _grid_params(seq_semantics=("parallel", "parallel", "arbitrary")):
+    try:
+        return pltpu.CompilerParams(dimension_semantics=seq_semantics)
+    except Exception:  # older naming
+        return pltpu.TPUCompilerParams(dimension_semantics=seq_semantics)
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
@@ -189,28 +215,35 @@ def _flash_fwd(q, k, v, causal, scale, block_q, block_k, interpret):
     sc = scale if scale is not None else dh ** -0.5
     bq = _pick_block(t, block_q)
     bk = _pick_block(t, block_k)
+    nq, nk = t // bq, t // bk
     interp = _interpret_default() if interpret is None else interpret
     qf, kf, vf = _reshape_bh(q), _reshape_bh(k), _reshape_bh(v)
-    grid = (b * h, t // bq)
-    kernel = functools.partial(_fwd_kernel, block_k=bk, causal=causal,
-                               scale=sc, seq_len=t, block_q=bq)
+    kernel = functools.partial(_fwd_kernel, causal=causal, scale=sc,
+                               block_q=bq, block_k=bk, nk=nk)
+    kw = {} if interp else {"compiler_params": _grid_params()}
     out, lse = pl.pallas_call(
         kernel,
-        grid=grid,
+        grid=(b * h, nq, nk),
         in_specs=[
-            pl.BlockSpec((None, bq, dh), lambda bh, qi: (bh, qi, 0)),
-            pl.BlockSpec((None, t, dh), lambda bh, qi: (bh, 0, 0)),
-            pl.BlockSpec((None, t, dh), lambda bh, qi: (bh, 0, 0)),
+            pl.BlockSpec((None, bq, dh), lambda bh_, qi, kj: (bh_, qi, 0)),
+            pl.BlockSpec((None, bk, dh), lambda bh_, qi, kj: (bh_, kj, 0)),
+            pl.BlockSpec((None, bk, dh), lambda bh_, qi, kj: (bh_, kj, 0)),
         ],
         out_specs=[
-            pl.BlockSpec((None, bq, dh), lambda bh, qi: (bh, qi, 0)),
-            pl.BlockSpec((None, bq, 1), lambda bh, qi: (bh, qi, 0)),
+            pl.BlockSpec((None, bq, dh), lambda bh_, qi, kj: (bh_, qi, 0)),
+            pl.BlockSpec((None, bq, 1), lambda bh_, qi, kj: (bh_, qi, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((b * h, t, dh), q.dtype),
             jax.ShapeDtypeStruct((b * h, t, 1), jnp.float32),
         ],
+        scratch_shapes=[
+            pltpu.VMEM((bq, 1), jnp.float32),    # running max m
+            pltpu.VMEM((bq, 1), jnp.float32),    # running sum l
+            pltpu.VMEM((bq, dh), jnp.float32),   # output accumulator
+        ],
         interpret=interp,
+        **kw,
     )(qf, kf, vf)
     # Residuals tagged for remat: the "flash_res" checkpoint-name lets the
     # save_attn policy (runtime/activation_checkpointing.py) SAVE them, so a
@@ -233,51 +266,60 @@ def _flash_bwd_vjp(causal, scale, block_q, block_k, interpret, res, g):
     sc = scale if scale is not None else dh ** -0.5
     bq = _pick_block(t, block_q)
     bk = _pick_block(t, block_k)
+    nq, nk = t // bq, t // bk
     interp = _interpret_default() if interpret is None else interpret
     dof = _reshape_bh(g)
     delta = jnp.sum(dof.astype(jnp.float32) * outf.astype(jnp.float32),
                     axis=-1, keepdims=True)                 # [bh, t, 1]
+    kw = {} if interp else {"compiler_params": _grid_params()}
 
-    dq_kernel = functools.partial(_bwd_dq_kernel, block_k=bk, causal=causal,
-                                  scale=sc, seq_len=t, block_q=bq)
+    dq_kernel = functools.partial(_bwd_dq_kernel, causal=causal, scale=sc,
+                                  block_q=bq, block_k=bk, nk=nk)
     dq = pl.pallas_call(
         dq_kernel,
-        grid=(bh, t // bq),
+        grid=(bh, nq, nk),
         in_specs=[
-            pl.BlockSpec((None, bq, dh), lambda b_, qi: (b_, qi, 0)),
-            pl.BlockSpec((None, t, dh), lambda b_, qi: (b_, 0, 0)),
-            pl.BlockSpec((None, t, dh), lambda b_, qi: (b_, 0, 0)),
-            pl.BlockSpec((None, bq, dh), lambda b_, qi: (b_, qi, 0)),
-            pl.BlockSpec((None, bq, 1), lambda b_, qi: (b_, qi, 0)),
-            pl.BlockSpec((None, bq, 1), lambda b_, qi: (b_, qi, 0)),
+            pl.BlockSpec((None, bq, dh), lambda b_, qi, kj: (b_, qi, 0)),
+            pl.BlockSpec((None, bk, dh), lambda b_, qi, kj: (b_, kj, 0)),
+            pl.BlockSpec((None, bk, dh), lambda b_, qi, kj: (b_, kj, 0)),
+            pl.BlockSpec((None, bq, dh), lambda b_, qi, kj: (b_, qi, 0)),
+            pl.BlockSpec((None, bq, 1), lambda b_, qi, kj: (b_, qi, 0)),
+            pl.BlockSpec((None, bq, 1), lambda b_, qi, kj: (b_, qi, 0)),
         ],
-        out_specs=pl.BlockSpec((None, bq, dh), lambda b_, qi: (b_, qi, 0)),
+        out_specs=pl.BlockSpec((None, bq, dh), lambda b_, qi, kj: (b_, qi, 0)),
         out_shape=jax.ShapeDtypeStruct((bh, t, dh), qf.dtype),
+        scratch_shapes=[pltpu.VMEM((bq, dh), jnp.float32)],
         interpret=interp,
+        **kw,
     )(qf, kf, vf, dof, lse, delta)
 
-    dkv_kernel = functools.partial(_bwd_dkv_kernel, block_q=bq, causal=causal,
-                                   scale=sc, seq_len=t, block_k=bk)
+    dkv_kernel = functools.partial(_bwd_dkv_kernel, causal=causal, scale=sc,
+                                   block_q=bq, block_k=bk, nq=nq)
     dk, dv = pl.pallas_call(
         dkv_kernel,
-        grid=(bh, t // bk),
+        grid=(bh, nk, nq),
         in_specs=[
-            pl.BlockSpec((None, t, dh), lambda b_, kj: (b_, 0, 0)),
-            pl.BlockSpec((None, bk, dh), lambda b_, kj: (b_, kj, 0)),
-            pl.BlockSpec((None, bk, dh), lambda b_, kj: (b_, kj, 0)),
-            pl.BlockSpec((None, t, dh), lambda b_, kj: (b_, 0, 0)),
-            pl.BlockSpec((None, t, 1), lambda b_, kj: (b_, 0, 0)),
-            pl.BlockSpec((None, t, 1), lambda b_, kj: (b_, 0, 0)),
+            pl.BlockSpec((None, bq, dh), lambda b_, kj, qi: (b_, qi, 0)),
+            pl.BlockSpec((None, bk, dh), lambda b_, kj, qi: (b_, kj, 0)),
+            pl.BlockSpec((None, bk, dh), lambda b_, kj, qi: (b_, kj, 0)),
+            pl.BlockSpec((None, bq, dh), lambda b_, kj, qi: (b_, qi, 0)),
+            pl.BlockSpec((None, bq, 1), lambda b_, kj, qi: (b_, qi, 0)),
+            pl.BlockSpec((None, bq, 1), lambda b_, kj, qi: (b_, qi, 0)),
         ],
         out_specs=[
-            pl.BlockSpec((None, bk, dh), lambda b_, kj: (b_, kj, 0)),
-            pl.BlockSpec((None, bk, dh), lambda b_, kj: (b_, kj, 0)),
+            pl.BlockSpec((None, bk, dh), lambda b_, kj, qi: (b_, kj, 0)),
+            pl.BlockSpec((None, bk, dh), lambda b_, kj, qi: (b_, kj, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((bh, t, dh), kf.dtype),
             jax.ShapeDtypeStruct((bh, t, dh), vf.dtype),
         ],
+        scratch_shapes=[
+            pltpu.VMEM((bk, dh), jnp.float32),
+            pltpu.VMEM((bk, dh), jnp.float32),
+        ],
         interpret=interp,
+        **kw,
     )(qf, kf, vf, dof, lse, delta)
 
     return (_unshape_bh(dq, b, h), _unshape_bh(dk, b, h), _unshape_bh(dv, b, h))

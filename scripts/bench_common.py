@@ -1,0 +1,62 @@
+"""Shared single-chip training-throughput harness for the sweep scripts.
+
+One copy of the methodology (engine build → warmup/compile → best-of-N
+short windows, fenced by `jax.device_get` because `block_until_ready`
+under-synchronizes on the tunnel backend — see bench.py and the memory
+notes). bench.py intentionally keeps its own inline copy so the driver can
+run it with zero repo-internal imports beyond the package.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+
+import numpy as np
+
+
+def train_tokens_per_sec(*, attn_impl: str, remat: bool, remat_policy,
+                         batch: int, gas: int, seq: int = 1024,
+                         steps: int = 8, windows: int = 3,
+                         zero_stage: int = 0, loss_chunk: int = 0) -> float:
+    """GPT-2-125M bf16 training throughput for one knob setting."""
+    import jax
+
+    import deepspeed_tpu
+    from deepspeed_tpu.models.gpt2 import GPT2Config, GPT2Model
+    from deepspeed_tpu.utils import groups
+
+    groups.reset()
+    cfg = GPT2Config.gpt2_125m(max_seq_len=seq)
+    if loss_chunk:
+        cfg = dataclasses.replace(cfg, loss_chunk=loss_chunk)
+    model = GPT2Model(cfg, remat=remat, remat_policy=remat_policy,
+                      attn_impl=attn_impl)
+    engine, *_ = deepspeed_tpu.initialize(model=model, config={
+        "train_batch_size": batch * gas,
+        "gradient_accumulation_steps": gas,
+        "bf16": {"enabled": True},
+        "optimizer": {"type": "AdamW", "params": {"lr": 1e-4,
+                                                  "weight_decay": 0.01}},
+        "gradient_clipping": 1.0,
+        "steps_per_print": 0,
+        "zero_optimization": {"stage": zero_stage},
+    })
+    rng = np.random.RandomState(0)
+
+    def make_batch():
+        ids = rng.randint(0, cfg.vocab_size,
+                          size=(gas, batch, seq + 1)).astype(np.int32)
+        return {"input_ids": ids[:, :, :-1], "labels": ids[:, :, 1:]}
+
+    for _ in range(2):
+        loss = engine.train_batch_from_stacked(make_batch())
+    float(jax.device_get(loss))
+    best = float("inf")
+    for _ in range(windows):
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            loss = engine.train_batch_from_stacked(make_batch())
+        float(jax.device_get(loss))
+        best = min(best, time.perf_counter() - t0)
+    return batch * gas * seq * steps / best

@@ -1,0 +1,60 @@
+"""Long-context training sweep on one chip: flash attention vs dense.
+
+Long-context is first-class here (ring/Ulysses shard beyond one chip; this
+script shows the single-chip half): Pallas flash attention keeps activation
+memory linear in T, so training seq lengths where dense attention's T^2
+buffers OOM the 16 GB chip. GPT-2-125M, bf16, remat save_attn.
+Writes LONGSEQ.json at the repo root.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+
+def run(seq: int, attn: str, batch: int, gas: int, steps=4, windows=3):
+    from scripts.bench_common import train_tokens_per_sec
+
+    return round(train_tokens_per_sec(
+        attn_impl=attn, remat=(attn != "flash"),
+        remat_policy=None if attn == "flash" else "dots_no_batch",
+        batch=batch, gas=gas, seq=seq, steps=steps, windows=windows), 1)
+
+
+def main():
+    grid = [
+        # (seq, attn, micro_batch, gas) — tokens/step held at 32k
+        (2048, "flash", 2, 8),
+        (2048, "dense", 2, 8),
+        (4096, "flash", 1, 8),
+        (4096, "dense", 1, 8),
+        (8192, "flash", 1, 4),
+        (8192, "dense", 1, 4),
+    ]
+    out = {"metric": "gpt2_125m_longseq_train", "unit": "tokens/sec/chip",
+           "results": []}
+    for seq, attn, mb, gas in grid:
+        try:
+            toks = run(seq, attn, mb, gas)
+            rec = {"seq": seq, "attn": attn, "micro_batch": mb, "gas": gas,
+                   "tokens_per_sec": toks}
+        except Exception as e:
+            msg = str(e)
+            rec = {"seq": seq, "attn": attn, "micro_batch": mb, "gas": gas,
+                   "error": ("OOM" if "memory" in msg.lower() else
+                             f"{type(e).__name__}") ,
+                   "detail": msg[:160]}
+        out["results"].append(rec)
+        print(json.dumps(rec), flush=True)
+    with open(os.path.join(_REPO, "LONGSEQ.json"), "w") as f:
+        json.dump(out, f, indent=1)
+
+
+if __name__ == "__main__":
+    main()

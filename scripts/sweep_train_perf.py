@@ -11,57 +11,19 @@ from __future__ import annotations
 import json
 import os
 import sys
-import time
 
-import numpy as np
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
 def run_config(attn_impl, remat, remat_policy, batch, gas, loss_chunk=0,
                steps=8, windows=3):
-    import dataclasses
+    from scripts.bench_common import train_tokens_per_sec
 
-    import jax
-
-    import deepspeed_tpu
-    from deepspeed_tpu.models.gpt2 import GPT2Config, GPT2Model
-    from deepspeed_tpu.utils import groups
-
-    groups.reset()
-    seq = 1024
-    cfg = GPT2Config.gpt2_125m()
-    if loss_chunk:
-        cfg = dataclasses.replace(cfg, loss_chunk=loss_chunk)
-    model = GPT2Model(cfg, remat=remat, remat_policy=remat_policy,
-                      attn_impl=attn_impl)
-    engine, *_ = deepspeed_tpu.initialize(model=model, config={
-        "train_batch_size": batch * gas,
-        "gradient_accumulation_steps": gas,
-        "bf16": {"enabled": True},
-        "optimizer": {"type": "AdamW", "params": {"lr": 1e-4, "weight_decay": 0.01}},
-        "gradient_clipping": 1.0,
-        "steps_per_print": 0,
-        "zero_optimization": {"stage": 0},
-    })
-    rng = np.random.RandomState(0)
-
-    def make_batch():
-        ids = rng.randint(0, cfg.vocab_size, size=(gas, batch, seq + 1)).astype(np.int32)
-        return {"input_ids": ids[:, :, :-1], "labels": ids[:, :, 1:]}
-
-    for _ in range(2):
-        loss = engine.train_batch_from_stacked(make_batch())
-    float(jax.device_get(loss))
-    best_dt = float("inf")
-    for _ in range(windows):
-        t0 = time.perf_counter()
-        for _ in range(steps):
-            loss = engine.train_batch_from_stacked(make_batch())
-        float(jax.device_get(loss))
-        best_dt = min(best_dt, time.perf_counter() - t0)
-    toks = batch * gas * seq * steps / best_dt
-    return toks
+    return train_tokens_per_sec(
+        attn_impl=attn_impl, remat=remat, remat_policy=remat_policy,
+        batch=batch, gas=gas, loss_chunk=loss_chunk, steps=steps,
+        windows=windows)
 
 
 def main():
@@ -78,6 +40,10 @@ def main():
         ("flash", True, "save_attn", 4, 16),           # idx 8: selective remat
         ("flash", True, "save_attn", 8, 8),            # idx 9
         ("flash", True, "save_attn", 16, 4),           # idx 10
+        ("flash", False, None, 16, 4),                 # idx 11
+        ("flash", False, None, 16, 4, 512),            # idx 12: chunked CE
+        ("flash", False, None, 32, 2, 512),            # idx 13
+        ("flash", False, None, 8, 8, 512),             # idx 14
     ]
     if len(sys.argv) > 1:  # allow running a subset: indices as args
         grid = [grid[int(i)] for i in sys.argv[1:]]
