@@ -190,6 +190,20 @@ def _pick_block(t: int, pref: int) -> int:
     return max(blk, 1)
 
 
+def _sds(*operands_then_args):
+    """ShapeDtypeStruct factory that propagates shard_map varying-axes (vma)
+    typing from the kernel operands — pallas_call under `shard_map` with
+    check_vma requires outputs to declare how they vary over mesh axes
+    (e.g. the Ulysses head-scatter path)."""
+    *operands, shape, dtype = operands_then_args
+    vma = frozenset()
+    for op in operands:
+        vma |= frozenset(getattr(jax.typeof(op), "vma", ()) or ())
+    if vma:
+        return jax.ShapeDtypeStruct(shape, dtype, vma=vma)
+    return jax.ShapeDtypeStruct(shape, dtype)
+
+
 def _grid_params(seq_semantics=("parallel", "parallel", "arbitrary")):
     try:
         return pltpu.CompilerParams(dimension_semantics=seq_semantics)
@@ -221,6 +235,7 @@ def _flash_fwd(q, k, v, causal, scale, block_q, block_k, interpret):
     kernel = functools.partial(_fwd_kernel, causal=causal, scale=sc,
                                block_q=bq, block_k=bk, nk=nk)
     kw = {} if interp else {"compiler_params": _grid_params()}
+    shp = functools.partial(_sds, qf, kf, vf)
     out, lse = pl.pallas_call(
         kernel,
         grid=(b * h, nq, nk),
@@ -234,8 +249,8 @@ def _flash_fwd(q, k, v, causal, scale, block_q, block_k, interpret):
             pl.BlockSpec((None, bq, 1), lambda bh_, qi, kj: (bh_, qi, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((b * h, t, dh), q.dtype),
-            jax.ShapeDtypeStruct((b * h, t, 1), jnp.float32),
+            shp((b * h, t, dh), q.dtype),
+            shp((b * h, t, 1), jnp.float32),
         ],
         scratch_shapes=[
             pltpu.VMEM((bq, 1), jnp.float32),    # running max m
@@ -272,6 +287,7 @@ def _flash_bwd_vjp(causal, scale, block_q, block_k, interpret, res, g):
     delta = jnp.sum(dof.astype(jnp.float32) * outf.astype(jnp.float32),
                     axis=-1, keepdims=True)                 # [bh, t, 1]
     kw = {} if interp else {"compiler_params": _grid_params()}
+    shp = functools.partial(_sds, qf, kf, vf, dof)
 
     dq_kernel = functools.partial(_bwd_dq_kernel, causal=causal, scale=sc,
                                   block_q=bq, block_k=bk, nk=nk)
@@ -287,7 +303,7 @@ def _flash_bwd_vjp(causal, scale, block_q, block_k, interpret, res, g):
             pl.BlockSpec((None, bq, 1), lambda b_, qi, kj: (b_, qi, 0)),
         ],
         out_specs=pl.BlockSpec((None, bq, dh), lambda b_, qi, kj: (b_, qi, 0)),
-        out_shape=jax.ShapeDtypeStruct((bh, t, dh), qf.dtype),
+        out_shape=shp((bh, t, dh), qf.dtype),
         scratch_shapes=[pltpu.VMEM((bq, dh), jnp.float32)],
         interpret=interp,
         **kw,
@@ -311,8 +327,8 @@ def _flash_bwd_vjp(causal, scale, block_q, block_k, interpret, res, g):
             pl.BlockSpec((None, bk, dh), lambda b_, kj, qi: (b_, kj, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((bh, t, dh), kf.dtype),
-            jax.ShapeDtypeStruct((bh, t, dh), vf.dtype),
+            shp((bh, t, dh), kf.dtype),
+            shp((bh, t, dh), vf.dtype),
         ],
         scratch_shapes=[
             pltpu.VMEM((bk, dh), jnp.float32),
