@@ -30,7 +30,7 @@ class ModelSpec(Protocol):
     def logical_axes(self) -> Optional[Any]: ...
 
 
-ATTN_IMPLS = ("dense", "flash", "ring", "ulysses")
+ATTN_IMPLS = ("dense", "flash", "ring", "ring_flash", "ulysses")
 
 
 def sp_attention(attn_impl: str, q, k, v, *, causal: bool = True):
@@ -41,12 +41,15 @@ def sp_attention(attn_impl: str, q, k, v, *, causal: bool = True):
         from deepspeed_tpu.ops.flash_attention import flash_attention
 
         return flash_attention(q, k, v, causal)
-    from deepspeed_tpu.ops.ring_attention import ring_attention, ulysses_attention
+    from deepspeed_tpu.ops.ring_attention import (
+        ring_attention, ring_flash_attention, ulysses_attention)
     from deepspeed_tpu.utils import groups
 
     mesh = groups.get_mesh()
     if attn_impl == "ring":
         return ring_attention(q, k, v, mesh=mesh, causal=causal)
+    if attn_impl == "ring_flash":
+        return ring_flash_attention(q, k, v, mesh, causal)
     if attn_impl == "ulysses":
         return ulysses_attention(q, k, v, mesh=mesh, causal=causal)
     raise ValueError(f"unknown attn_impl {attn_impl!r}")

@@ -224,21 +224,27 @@ def _interpret_default() -> bool:
     return jax.default_backend() != "tpu"
 
 
-def _flash_fwd(q, k, v, causal, scale, block_q, block_k, interpret):
-    b, t, h, dh = q.shape
+def flash_fwd_parts(qf, kf, vf, *, causal, scale=None,
+                    block_q=DEFAULT_BLOCK_Q, block_k=DEFAULT_BLOCK_K,
+                    interpret=None):
+    """Kernel-level forward on FLAT [BH, T, Dh] operands → (out, lse).
+
+    Public building block for sequence-parallel composition (ring attention
+    merges per-hop (out, lse) pairs exactly); ``flash_attention`` wraps it
+    with the [B, T, H, Dh] layout and custom_vjp."""
+    bh, t, dh = qf.shape
     sc = scale if scale is not None else dh ** -0.5
     bq = _pick_block(t, block_q)
-    bk = _pick_block(t, block_k)
-    nq, nk = t // bq, t // bk
+    bk = _pick_block(kf.shape[1], block_k)
+    nq, nk = t // bq, kf.shape[1] // bk
     interp = _interpret_default() if interpret is None else interpret
-    qf, kf, vf = _reshape_bh(q), _reshape_bh(k), _reshape_bh(v)
     kernel = functools.partial(_fwd_kernel, causal=causal, scale=sc,
                                block_q=bq, block_k=bk, nk=nk)
     kw = {} if interp else {"compiler_params": _grid_params()}
     shp = functools.partial(_sds, qf, kf, vf)
-    out, lse = pl.pallas_call(
+    return pl.pallas_call(
         kernel,
-        grid=(b * h, nq, nk),
+        grid=(bh, nq, nk),
         in_specs=[
             pl.BlockSpec((None, bq, dh), lambda bh_, qi, kj: (bh_, qi, 0)),
             pl.BlockSpec((None, bk, dh), lambda bh_, qi, kj: (bh_, kj, 0)),
@@ -249,8 +255,8 @@ def _flash_fwd(q, k, v, causal, scale, block_q, block_k, interpret):
             pl.BlockSpec((None, bq, 1), lambda bh_, qi, kj: (bh_, qi, 0)),
         ],
         out_shape=[
-            shp((b * h, t, dh), q.dtype),
-            shp((b * h, t, 1), jnp.float32),
+            shp((bh, t, dh), qf.dtype),
+            shp((bh, t, 1), jnp.float32),
         ],
         scratch_shapes=[
             pltpu.VMEM((bq, 1), jnp.float32),    # running max m
@@ -260,6 +266,14 @@ def _flash_fwd(q, k, v, causal, scale, block_q, block_k, interpret):
         interpret=interp,
         **kw,
     )(qf, kf, vf)
+
+
+def _flash_fwd(q, k, v, causal, scale, block_q, block_k, interpret):
+    b, t, h, dh = q.shape
+    qf, kf, vf = _reshape_bh(q), _reshape_bh(k), _reshape_bh(v)
+    out, lse = flash_fwd_parts(qf, kf, vf, causal=causal, scale=scale,
+                               block_q=block_q, block_k=block_k,
+                               interpret=interpret)
     # Residuals tagged for remat: the "flash_res" checkpoint-name lets the
     # save_attn policy (runtime/activation_checkpointing.py) SAVE them, so a
     # rematted transformer block never re-runs this kernel in backward —
@@ -275,17 +289,20 @@ def _flash_fwd_vjp(q, k, v, causal, scale, block_q, block_k, interpret):
     return out, res
 
 
-def _flash_bwd_vjp(causal, scale, block_q, block_k, interpret, res, g):
-    qf, kf, vf, outf, lse, (b, h) = res
+def flash_bwd_parts(qf, kf, vf, dof, lse, delta, *, causal, scale=None,
+                    block_q=DEFAULT_BLOCK_Q, block_k=DEFAULT_BLOCK_K,
+                    interpret=None):
+    """Kernel-level backward on FLAT operands → (dq, dk, dv).
+
+    ``lse``/``delta`` are the GLOBAL log-sum-exp rows / do·out sums, so
+    sequence-parallel callers can run this per K/V hop and the per-hop
+    grads sum to the exact global gradient (p = exp(s - lse_global))."""
     bh, t, dh = qf.shape
     sc = scale if scale is not None else dh ** -0.5
     bq = _pick_block(t, block_q)
-    bk = _pick_block(t, block_k)
-    nq, nk = t // bq, t // bk
+    bk = _pick_block(kf.shape[1], block_k)
+    nq, nk = t // bq, kf.shape[1] // bk
     interp = _interpret_default() if interpret is None else interpret
-    dof = _reshape_bh(g)
-    delta = jnp.sum(dof.astype(jnp.float32) * outf.astype(jnp.float32),
-                    axis=-1, keepdims=True)                 # [bh, t, 1]
     kw = {} if interp else {"compiler_params": _grid_params()}
     shp = functools.partial(_sds, qf, kf, vf, dof)
 
@@ -327,8 +344,8 @@ def _flash_bwd_vjp(causal, scale, block_q, block_k, interpret, res, g):
             pl.BlockSpec((None, bk, dh), lambda b_, kj, qi: (b_, kj, 0)),
         ],
         out_shape=[
-            shp((bh, t, dh), kf.dtype),
-            shp((bh, t, dh), vf.dtype),
+            shp((kf.shape[0], kf.shape[1], dh), kf.dtype),
+            shp((kf.shape[0], kf.shape[1], dh), vf.dtype),
         ],
         scratch_shapes=[
             pltpu.VMEM((bk, dh), jnp.float32),
@@ -337,7 +354,17 @@ def _flash_bwd_vjp(causal, scale, block_q, block_k, interpret, res, g):
         interpret=interp,
         **kw,
     )(qf, kf, vf, dof, lse, delta)
+    return dq, dk, dv
 
+
+def _flash_bwd_vjp(causal, scale, block_q, block_k, interpret, res, g):
+    qf, kf, vf, outf, lse, (b, h) = res
+    dof = _reshape_bh(g)
+    delta = jnp.sum(dof.astype(jnp.float32) * outf.astype(jnp.float32),
+                    axis=-1, keepdims=True)                 # [bh, t, 1]
+    dq, dk, dv = flash_bwd_parts(qf, kf, vf, dof, lse, delta, causal=causal,
+                                 scale=scale, block_q=block_q,
+                                 block_k=block_k, interpret=interpret)
     return (_unshape_bh(dq, b, h), _unshape_bh(dk, b, h), _unshape_bh(dv, b, h))
 
 
