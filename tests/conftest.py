@@ -19,8 +19,12 @@ if "collective_call_terminate" not in _flags:
     # Give the rendezvous generous deadlines instead.
     # (warn_stuck_seconds is NOT registered in this jaxlib's flag parser and
     # would be a fatal XLA_FLAGS error)
-    _flags += (" --xla_cpu_collective_call_terminate_timeout_seconds=900"
-               " --xla_cpu_collective_timeout_seconds=900")
+    #
+    # 300s (not more): with the per-module subprocess isolation below, a
+    # genuinely wedged collective should abort the CHILD quickly so the
+    # parent can retry the module, rather than stall the suite for 15 min.
+    _flags += (" --xla_cpu_collective_call_terminate_timeout_seconds=300"
+               " --xla_cpu_collective_timeout_seconds=300")
 os.environ["XLA_FLAGS"] = _flags
 os.environ["DSTPU_ACCELERATOR"] = "cpu"
 
@@ -54,6 +58,179 @@ jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_cpu_enable_async_dispatch", False)
 
 import pytest  # noqa: E402
+
+# ---------------------------------------------------------------------------
+# Crash isolation: run each test module in a forked-off child process.
+#
+# Rationale (reference parity): the reference runs every distributed test in
+# a forked child (tests/unit/common.py:86 DistributedExec) precisely so one
+# hung NCCL rendezvous cannot kill the whole session.  The XLA:CPU virtual
+# 8-device mesh has an analogous hazard on this 1-core sandbox: a starved
+# collective rendezvous hard-aborts the process (SIGABRT) after the
+# terminate timeout — observed killing full-suite runs at
+# test_tp.py::test_llama_trains even with sync dispatch + per-test queue
+# drains.  The abort is a scheduler-starvation artifact, not a test bug, so
+# the harness owns it: the parent pytest process never touches a device;
+# each module's tests execute in a child `pytest` subprocess whose reports
+# stream back over a JSONL file.  If a child crashes or times out, the
+# module is retried (completed tests keep their first result); only after
+# the final attempt are un-run tests reported as failures.
+#
+# Escape hatch: DSTPU_NO_ISOLATE=1 runs everything in-process (useful for
+# pdb).  Children are marked with DSTPU_TEST_CHILD=1.
+# ---------------------------------------------------------------------------
+import json  # noqa: E402
+import subprocess  # noqa: E402
+import sys  # noqa: E402
+import tempfile  # noqa: E402
+
+_MODULE_TIMEOUT = int(os.environ.get("DSTPU_MODULE_TIMEOUT", "1800"))
+_MODULE_ATTEMPTS = int(os.environ.get("DSTPU_MODULE_ATTEMPTS", "3"))
+
+
+def pytest_runtest_logreport(report):
+    """In a child process, stream every report to the parent as JSONL."""
+    path = os.environ.get("DSTPU_CHILD_REPORT")
+    if not path:
+        return
+    lr = report.longrepr
+    if isinstance(lr, tuple):
+        lr = list(lr)
+    elif lr is not None:
+        lr = str(lr)
+    with open(path, "a") as f:
+        f.write(json.dumps({
+            "nodeid": report.nodeid, "when": report.when,
+            "outcome": report.outcome, "longrepr": lr,
+            "duration": report.duration,
+        }) + "\n")
+        f.flush()
+
+
+def _replay(session, item, reports):
+    """Re-emit a completed child test's reports through the parent's hooks
+    so counting, -x/maxfail, and the terminal summary behave natively."""
+    from _pytest.reports import TestReport
+
+    session.ihook.pytest_runtest_logstart(
+        nodeid=item.nodeid, location=item.location)
+    for r in reports:
+        lr = r["longrepr"]
+        if isinstance(lr, list):
+            lr = tuple(lr)
+        session.ihook.pytest_runtest_logreport(report=TestReport(
+            nodeid=item.nodeid, location=item.location, keywords={},
+            outcome=r["outcome"], longrepr=lr, when=r["when"],
+            sections=[], duration=r["duration"], user_properties=[]))
+    session.ihook.pytest_runtest_logfinish(
+        nodeid=item.nodeid, location=item.location)
+
+
+def _synthesize_failure(session, item, message):
+    from _pytest.reports import TestReport
+
+    session.ihook.pytest_runtest_logstart(
+        nodeid=item.nodeid, location=item.location)
+    session.ihook.pytest_runtest_logreport(report=TestReport(
+        nodeid=item.nodeid, location=item.location, keywords={},
+        outcome="failed", longrepr=message, when="call",
+        sections=[], duration=0.0, user_properties=[]))
+    session.ihook.pytest_runtest_logfinish(
+        nodeid=item.nodeid, location=item.location)
+
+
+def _run_module_child(session, items):
+    """Run `items` (all from one module) in child subprocesses, retrying on
+    crash/timeout.  Returns when every item has been reported."""
+    pending = list(items)
+    last_crash = None
+    for attempt in range(_MODULE_ATTEMPTS):
+        if not pending:
+            return
+        fd, report_path = tempfile.mkstemp(suffix=".jsonl")
+        os.close(fd)
+        env = dict(os.environ,
+                   DSTPU_TEST_CHILD="1", DSTPU_CHILD_REPORT=report_path)
+        cmd = [sys.executable, "-m", "pytest", "-q", "-p", "no:cacheprovider",
+               "--no-header", *(it.nodeid for it in pending)]
+        crashed = None
+        try:
+            proc = subprocess.run(
+                cmd, cwd=str(session.config.rootpath), env=env,
+                capture_output=True, text=True, timeout=_MODULE_TIMEOUT)
+            if proc.returncode not in (0, 1):  # 1 = ordinary test failures
+                crashed = (f"child exited rc={proc.returncode}\n"
+                           f"--- child tail ---\n{proc.stdout[-3000:]}\n"
+                           f"{proc.stderr[-2000:]}")
+        except subprocess.TimeoutExpired as e:
+            out = (e.stdout or b"")
+            out = out.decode("utf-8", "replace") if isinstance(out, bytes) else out
+            crashed = (f"child timed out after {_MODULE_TIMEOUT}s\n"
+                       f"--- child tail ---\n{out[-3000:]}")
+        # Collect per-test reports; a test is 'done' once its teardown
+        # report arrived (partial phases from a crashed attempt discarded).
+        by_node = {}
+        try:
+            with open(report_path) as f:
+                for line in f:
+                    try:
+                        r = json.loads(line)
+                    except ValueError:
+                        continue  # line truncated by a crash mid-write
+                    by_node.setdefault(r["nodeid"], []).append(r)
+        finally:
+            os.unlink(report_path)
+        still_pending = []
+        for it in pending:
+            if session.shouldfail or session.shouldstop:
+                return
+            reps = by_node.get(it.nodeid, [])
+            if any(r["when"] == "teardown" for r in reps):
+                _replay(session, it, reps)
+            elif crashed is None:
+                # child finished cleanly but never ran it (e.g. child -x);
+                # shouldn't happen since the child gets no -x — report it.
+                _synthesize_failure(
+                    session, it, "child pytest finished without running this "
+                    "test (no report received)")
+            else:
+                still_pending.append(it)
+        pending = still_pending
+        if crashed and pending and attempt + 1 < _MODULE_ATTEMPTS:
+            tr = session.config.pluginmanager.get_plugin("terminalreporter")
+            if tr:
+                tr.write_line(
+                    f"\n[isolate] {items[0].nodeid.split('::')[0]}: attempt "
+                    f"{attempt + 1} crashed ({crashed.splitlines()[0]}); "
+                    f"retrying {len(pending)} test(s)", yellow=True)
+        last_crash = crashed
+    for it in pending:
+        _synthesize_failure(
+            session, it,
+            f"test did not complete in {_MODULE_ATTEMPTS} isolated child "
+            f"attempts\n{last_crash or ''}")
+
+
+def pytest_runtestloop(session):
+    if (os.environ.get("DSTPU_TEST_CHILD")
+            or os.environ.get("DSTPU_NO_ISOLATE")
+            or session.config.option.collectonly
+            or not session.items):
+        return None  # default in-process loop
+    if getattr(session.config.option, "usepdb", False):
+        return None  # debugging needs in-process execution
+    # Group by module, preserving the (torch-last) collection order.
+    groups_ = {}
+    for it in session.items:
+        groups_.setdefault(it.nodeid.split("::")[0], []).append(it)
+    for mod_items in groups_.values():
+        _run_module_child(session, mod_items)
+        if session.shouldfail:
+            raise session.Failed(session.shouldfail)
+        if session.shouldstop:
+            raise session.Interrupted(session.shouldstop)
+    return True
+
 
 # Modules that import torch must run LAST: on a single-core host, torch's
 # runtime (once loaded) starves XLA:CPU's multi-device collective rendezvous
