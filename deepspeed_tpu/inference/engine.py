@@ -88,6 +88,11 @@ class InferenceEngine:
             model, hf_params = convert_hf_model(model, compute_dtype=self.dtype)
             if params is None:
                 params = hf_params
+        # align the model's compute dtype with the serving dtype — a bf16
+        # model served with dtype="fp32" would otherwise mix dtypes in the
+        # decode-loop carry (scan carries are dtype-strict)
+        if hasattr(model, "compute_dtype") and model.compute_dtype != self.dtype:
+            model.compute_dtype = self.dtype
         self.module = model
 
         # ---- topology: model axis = tp (reference _create_model_parallel_group)

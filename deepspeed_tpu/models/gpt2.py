@@ -21,7 +21,7 @@ import jax
 import jax.numpy as jnp
 
 from deepspeed_tpu.models.base import ATTN_IMPLS, cross_entropy_loss, dequant_block, gelu, layer_norm, sp_attention
-from deepspeed_tpu.ops.attention import attention_with_kv_cache, multihead_attention
+from deepspeed_tpu.ops.attention import decode_attention, multihead_attention, write_kv_cache
 
 
 @dataclasses.dataclass
@@ -74,9 +74,13 @@ class GPT2Model:
 
     def __init__(self, config: GPT2Config, compute_dtype=jnp.bfloat16,
                  remat: bool = False, remat_policy: Optional[str] = None,
-                 attn_impl: str = "dense"):
+                 attn_impl: str = "dense", decode_unroll: int = 1):
         self.config = config
         self.compute_dtype = compute_dtype
+        # layer-scan unroll factor for single-token decode steps: unrolling
+        # lets XLA overlap consecutive layers' weight DMAs with compute
+        # (per-layer matmuls are tiny at decode, so HBM latency dominates)
+        self.decode_unroll = decode_unroll
         self.remat = remat
         self.remat_policy = remat_policy
         assert attn_impl in ATTN_IMPLS, attn_impl
@@ -142,9 +146,13 @@ class GPT2Model:
 
     # ------------------------------------------------------------------ layers
     def _block_impl(self, x, blk, rng, train: bool, cache):
-        """One transformer block; with ``cache=(kc, vc, idx)`` the attention
-        runs against the KV cache (one shared implementation so training and
-        serving can never diverge numerically)."""
+        """One transformer block; with ``cache=(k_full, v_full, layer, idx)``
+        the attention runs against the KV cache (one shared implementation so
+        training and serving can never diverge numerically). ``k_full`` /
+        ``v_full`` are the FULL stacked head-major [L, B, H, S, Dh] caches:
+        only the new token's slice is written (in place, as a loop-carry
+        dynamic update) — never the whole cache (see
+        ops/attention.decode_attention)."""
         blk = dequant_block(blk, x.dtype)
         c = self.config
         b, t, d = x.shape
@@ -168,8 +176,9 @@ class GPT2Model:
                                            dropout_rng=drop_rng)
             kc = vc = None
         else:
-            kc, vc, idx = cache
-            attn, kc, vc = attention_with_kv_cache(q, k_, v_, kc, vc, idx)
+            kc, vc, layer, idx = cache
+            kc, vc, kl, vl = write_kv_cache(kc, vc, k_, v_, layer, idx)
+            attn = decode_attention(q, kl, vl, idx)
         attn = attn.reshape(b, t, d)
         x = x + jnp.einsum("btd,de->bte", attn, blk["attn_out_w"].astype(x.dtype)) + \
             blk["attn_out_b"].astype(x.dtype)
@@ -254,19 +263,24 @@ class GPT2Model:
     # --------------------------------------------------------- inference path
     def init_cache(self, batch_size: int, max_len: int, dtype=None):
         """Static-shape KV cache (the inference_context.h workspace analog —
-        reference csrc/transformer/inference/includes/inference_context.h)."""
+        reference csrc/transformer/inference/includes/inference_context.h).
+        Head-major layout [L, B, H, S, Dh] — see ops/attention.decode_attention."""
         c = self.config
         dtype = dtype or self.compute_dtype
-        shape = (c.num_layers, batch_size, max_len, c.num_heads, c.head_dim)
+        shape = (c.num_layers, batch_size, c.num_heads, max_len, c.head_dim)
         return {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype),
                 "index": jnp.zeros((), jnp.int32)}
 
-    def _block_cached(self, x, blk, kc, vc, idx):
-        return self._block_impl(x, blk, None, False, (kc, vc, idx))
+    def _block_cached(self, x, blk, kc, vc, layer, idx):
+        return self._block_impl(x, blk, None, False, (kc, vc, layer, idx))
 
     def forward_with_cache(self, params, input_ids, cache):
         """Prefill (T>1) or decode (T=1) step against the KV cache.
-        Returns (logits [B,T,V], new_cache)."""
+        Returns (logits [B,T,V], new_cache).
+
+        The stacked caches ride the layer-scan CARRY (per-layer slice writes
+        XLA keeps in place), not xs/ys — the ys form copied the entire cache
+        every step, which dominated decode latency (round-2 weak #2)."""
         c = self.config
         b, t = input_ids.shape
         idx = cache["index"]
@@ -274,13 +288,15 @@ class GPT2Model:
         pos = idx + jnp.arange(t)
         x = x + params["wpe"].astype(self.compute_dtype)[pos][None]
 
-        def scan_body(x, layer_in):
-            blk, kc, vc = layer_in
-            x, kc, vc = self._block_cached(x, blk, kc, vc, idx)
-            return x, (kc, vc)
+        def scan_body(carry, blk):
+            x, kc, vc, layer = carry
+            x, kc, vc = self._block_cached(x, blk, kc, vc, layer, idx)
+            return (x, kc, vc, layer + 1), None
 
-        x, (k_new, v_new) = jax.lax.scan(
-            scan_body, x, (params["blocks"], cache["k"], cache["v"]))
+        (x, k_new, v_new, _), _ = jax.lax.scan(
+            scan_body,
+            (x, cache["k"], cache["v"], jnp.zeros((), jnp.int32)),
+            params["blocks"], unroll=self.decode_unroll if t == 1 else 1)
         hidden = layer_norm(x, params["ln_f_scale"], params["ln_f_bias"], c.eps)
         logits = self.logits(params, hidden)
         return logits, {"k": k_new, "v": v_new, "index": idx + t}

@@ -14,8 +14,8 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 
-from deepspeed_tpu.models.base import ATTN_IMPLS, cross_entropy_loss, dequant_block, rms_norm, sp_attention
-from deepspeed_tpu.ops.attention import attention_with_kv_cache, multihead_attention
+from deepspeed_tpu.models.base import ATTN_IMPLS, cross_entropy_loss, dequant_block, rms_norm, sp_attention  # noqa: E501
+from deepspeed_tpu.ops.attention import decode_attention, multihead_attention, write_kv_cache
 from deepspeed_tpu.ops.rotary import apply_rotary_pos_emb, rope_frequencies
 
 
@@ -67,13 +67,15 @@ class LlamaModel:
 
     def __init__(self, config: LlamaConfig, compute_dtype=jnp.bfloat16,
                  remat: bool = False, remat_policy: Optional[str] = None,
-                 attn_impl: str = "dense"):
+                 attn_impl: str = "dense", decode_unroll: int = 1):
         self.config = config
         self.compute_dtype = compute_dtype
         self.remat = remat
         self.remat_policy = remat_policy
         assert attn_impl in ATTN_IMPLS, attn_impl
         self.attn_impl = attn_impl
+        # see GPT2Model: layer-scan unroll for single-token decode steps
+        self.decode_unroll = decode_unroll
 
     def init(self, rng):
         c = self.config
@@ -118,13 +120,16 @@ class LlamaModel:
         }
 
     def _block_impl(self, x, blk, cos, sin, train: bool, cache):
-        """One LLaMA block; with ``cache=(kc, vc, idx)`` attention runs against
-        the GQA KV cache (shared implementation for train + serving)."""
+        """One LLaMA block; with ``cache=(k_full, v_full, layer, idx)``
+        attention runs against the GQA KV cache (shared implementation for
+        train + serving). Only the new token's slice of the full stacked
+        head-major [L, B, Hkv, S, Dh] cache is written — see
+        ops/attention.decode_attention."""
         blk = dequant_block(blk, x.dtype)
         c = self.config
         b, t, d = x.shape
         hq, hkv, dh = c.num_heads, c.num_kv_heads, c.head_dim
-        idx = cache[2] if cache is not None else 0
+        idx = cache[3] if cache is not None else 0
         y = rms_norm(x, blk["attn_norm"], c.eps)
         q = jnp.einsum("btd,de->bte", y, blk["wq"].astype(y.dtype)).reshape(b, t, hq, dh)
         k_ = jnp.einsum("btd,de->bte", y, blk["wk"].astype(y.dtype)).reshape(b, t, hkv, dh)
@@ -142,8 +147,9 @@ class LlamaModel:
                 attn = multihead_attention(q, k_, v_, causal=True)
             kc = vc = None
         else:
-            kc, vc, idx = cache
-            attn, kc, vc = attention_with_kv_cache(q, k_, v_, kc, vc, idx)
+            kc, vc, layer, idx = cache
+            kc, vc, kl, vl = write_kv_cache(kc, vc, k_, v_, layer, idx)
+            attn = decode_attention(q, kl, vl, idx)
         x = x + jnp.einsum("bte,ed->btd", attn.reshape(b, t, hq * dh),
                            blk["wo"].astype(x.dtype))
         y = rms_norm(x, blk["mlp_norm"], c.eps)
@@ -186,31 +192,35 @@ class LlamaModel:
     # --------------------------------------------------------- inference path
     def init_cache(self, batch_size: int, max_len: int, dtype=None):
         """Static-shape GQA KV cache — stores num_kv_heads only (the grouped
-        query repeat happens inside attention_with_kv_cache)."""
+        query repeat happens inside decode_attention). Sequence-minor layout
+        [L, B, Hkv, S, Dh] — see ops/attention.decode_attention."""
         c = self.config
         dtype = dtype or self.compute_dtype
-        shape = (c.num_layers, batch_size, max_len, c.num_kv_heads, c.head_dim)
+        shape = (c.num_layers, batch_size, c.num_kv_heads, max_len, c.head_dim)
         return {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype),
                 "index": jnp.zeros((), jnp.int32)}
 
-    def _block_cached(self, x, blk, kc, vc, idx, cos, sin):
-        return self._block_impl(x, blk, cos, sin, False, (kc, vc, idx))
+    def _block_cached(self, x, blk, kc, vc, layer, idx, cos, sin):
+        return self._block_impl(x, blk, cos, sin, False, (kc, vc, layer, idx))
 
     def forward_with_cache(self, params, input_ids, cache):
-        """Prefill (T>1) or decode (T=1) against the KV cache."""
+        """Prefill (T>1) or decode (T=1) against the KV cache. Stacked caches
+        ride the scan carry with per-layer slice writes (see GPT2Model)."""
         c = self.config
         b, t = input_ids.shape
         idx = cache["index"]
         x = params["embed"].astype(self.compute_dtype)[input_ids]
         cos, sin = rope_frequencies(c.head_dim, c.max_seq_len, c.rope_theta)
 
-        def scan_body(x, layer_in):
-            blk, kc, vc = layer_in
-            x, kc, vc = self._block_cached(x, blk, kc, vc, idx, cos, sin)
-            return x, (kc, vc)
+        def scan_body(carry, blk):
+            x, kc, vc, layer = carry
+            x, kc, vc = self._block_cached(x, blk, kc, vc, layer, idx, cos, sin)
+            return (x, kc, vc, layer + 1), None
 
-        x, (k_new, v_new) = jax.lax.scan(
-            scan_body, x, (params["blocks"], cache["k"], cache["v"]))
+        (x, k_new, v_new, _), _ = jax.lax.scan(
+            scan_body,
+            (x, cache["k"], cache["v"], jnp.zeros((), jnp.int32)),
+            params["blocks"], unroll=self.decode_unroll if t == 1 else 1)
         hidden = rms_norm(x, params["final_norm"], c.eps)
         logits = self.logits(params, hidden)
         return logits, {"k": k_new, "v": v_new, "index": idx + t}

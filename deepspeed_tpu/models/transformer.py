@@ -29,7 +29,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from deepspeed_tpu.models.base import cross_entropy_loss, dequant_block, gelu, layer_norm
-from deepspeed_tpu.ops.attention import attention_with_kv_cache, multihead_attention
+from deepspeed_tpu.ops.attention import decode_attention, multihead_attention, write_kv_cache
 from deepspeed_tpu.ops.rotary import apply_rotary_pos_emb, rope_frequencies
 
 
@@ -134,11 +134,14 @@ class DecoderModel:
     supports_weight_quant = True   # blocks call dequant_block
 
     def __init__(self, config: DecoderConfig, compute_dtype=jnp.bfloat16,
-                 remat: bool = False, remat_policy: Optional[str] = None):
+                 remat: bool = False, remat_policy: Optional[str] = None,
+                 decode_unroll: int = 1):
         self.config = config
         self.compute_dtype = compute_dtype
         self.remat = remat
         self.remat_policy = remat_policy
+        # see GPT2Model: layer-scan unroll for single-token decode steps
+        self.decode_unroll = decode_unroll
         c = config
         assert c.activation in ("gelu", "gelu_exact", "relu"), c.activation
         assert c.pos_emb in ("learned", "none"), c.pos_emb
@@ -268,10 +271,13 @@ class DecoderModel:
         return q, k_, v_
 
     def _block_impl(self, x, blk, cache, local_flag=None):
+        # cache = (k_full, v_full, layer, idx): full stacked head-major
+        # [L,B,H,S,Dh] caches, updated with per-token slice writes only
+        # (see ops/attention.decode_attention docstring)
         blk = dequant_block(blk, x.dtype)
         c = self.config
         b, t, d = x.shape
-        idx = cache[2] if cache is not None else 0
+        idx = cache[3] if cache is not None else 0
 
         y1 = x if c.post_ln else layer_norm(x, blk["ln1_scale"],
                                             blk["ln1_bias"], c.eps)
@@ -289,19 +295,18 @@ class DecoderModel:
                                        scale=c.qk_scale)
             kc = vc = None
         else:
-            kc, vc, _ = cache
+            kc, vc, layer, _ = cache
+            s_max = kc.shape[3]  # head-major [L, B, H, S, Dh]
             dec_bias = None
             if c.alibi:
                 dec_bias = self._alibi[:, None] * jnp.arange(
-                    kc.shape[1], dtype=jnp.float32)[None, :]
+                    s_max, dtype=jnp.float32)[None, :]
             window = None
             if local_flag is not None:
-                window = jnp.where(local_flag, c.local_attn_window,
-                                   kc.shape[1] + 1)
-            attn, kc, vc = attention_with_kv_cache(q, k_, v_, kc, vc, idx,
-                                                   bias=dec_bias,
-                                                   scale=c.qk_scale,
-                                                   window=window)
+                window = jnp.where(local_flag, c.local_attn_window, s_max + 1)
+            kc, vc, kl, vl = write_kv_cache(kc, vc, k_, v_, layer, idx)
+            attn = decode_attention(q, kl, vl, idx, bias=dec_bias,
+                                    scale=c.qk_scale, window=window)
         attn = attn.reshape(b, t, d)
         attn_out = jnp.einsum("btd,de->bte", attn,
                               blk["attn_out_w"].astype(x.dtype)) + \
@@ -401,9 +406,10 @@ class DecoderModel:
 
     # --------------------------------------------------------- inference path
     def init_cache(self, batch_size: int, max_len: int, dtype=None):
+        # head-major [L, B, H, S, Dh] — see ops/attention.decode_attention
         c = self.config
         dtype = dtype or self.compute_dtype
-        shape = (c.num_layers, batch_size, max_len, c.num_heads, c.head_dim)
+        shape = (c.num_layers, batch_size, c.num_heads, max_len, c.head_dim)
         return {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype),
                 "index": jnp.zeros((), jnp.int32)}
 
@@ -418,15 +424,20 @@ class DecoderModel:
         else:
             use_flags = True
 
-        def scan_body(x, layer_in):
-            blk, kc, vc, flag = layer_in
+        def scan_body(carry, layer_in):
+            x, kc, vc, layer = carry
+            blk, flag = layer_in
             x, kc, vc = self._block_impl(
-                x, blk, (kc, vc, idx),
+                x, blk, (kc, vc, layer, idx),
                 local_flag=flag if use_flags else None)
-            return x, (kc, vc)
+            return (x, kc, vc, layer + 1), None
 
-        x, (k_new, v_new) = jax.lax.scan(
-            scan_body, x, (params["blocks"], cache["k"], cache["v"], flags))
+        t = input_ids.shape[1]
+        (x, k_new, v_new, _), _ = jax.lax.scan(
+            scan_body,
+            (x, cache["k"], cache["v"], jnp.zeros((), jnp.int32)),
+            (params["blocks"], flags),
+            unroll=self.decode_unroll if t == 1 else 1)
         if c.final_ln:
             x = layer_norm(x, params["ln_f_scale"], params["ln_f_bias"],
                            c.eps)

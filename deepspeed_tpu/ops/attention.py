@@ -51,38 +51,60 @@ def multihead_attention(
     return jnp.einsum("bhts,bshd->bthd", probs, v)
 
 
-def attention_with_kv_cache(
-    q: jax.Array,        # [B, 1, H, Dh] decode query (or [B, T, H, Dh] prefill)
-    k_new: jax.Array,    # same T as q
-    v_new: jax.Array,
-    k_cache: jax.Array,  # [B, S_max, H, Dh]
-    v_cache: jax.Array,
-    cache_index: jax.Array,  # scalar int — tokens already in cache
+def write_kv_cache(k_full, v_full, k_new, v_new, layer, idx):
+    """Write one block's new K/V ([B, T, Hkv, Dh]) into the full stacked
+    head-major [L, B, Hkv, S, Dh] caches at (layer, idx) — the per-token
+    slice write that XLA keeps in place on the layer-scan carry. Returns
+    (k_full, v_full, k_layer, v_layer) with the per-layer [B, Hkv, S, Dh]
+    views ready for :func:`decode_attention`."""
+    k_full = jax.lax.dynamic_update_slice(
+        k_full, k_new.transpose(0, 2, 1, 3)[None].astype(k_full.dtype),
+        (layer, 0, 0, idx, 0))
+    v_full = jax.lax.dynamic_update_slice(
+        v_full, v_new.transpose(0, 2, 1, 3)[None].astype(v_full.dtype),
+        (layer, 0, 0, idx, 0))
+    return (k_full, v_full,
+            jax.lax.dynamic_index_in_dim(k_full, layer, 0, keepdims=False),
+            jax.lax.dynamic_index_in_dim(v_full, layer, 0, keepdims=False))
+
+
+def decode_attention(
+    q: jax.Array,        # [B, T, Hq, Dh] current block's queries
+    k_cache: jax.Array,  # [B, Hkv, S_max, Dh] — new keys ALREADY written
+    v_cache: jax.Array,  # [B, Hkv, S_max, Dh]
+    cache_index: jax.Array,  # scalar int — first position of q in the cache
     *,
     scale: Optional[float] = None,
-    bias: Optional[jax.Array] = None,  # [H, S_max] additive (alibi: softmax
-    # shift-invariance makes slopes*key_pos correct for every query position)
-    window: Optional[jax.Array] = None,  # scalar: keys older than
-    # q_pos-window are masked (GPT-Neo local attention); None = full causal
-):
-    """Decode-time attention against a static-shape KV cache.
+    bias: Optional[jax.Array] = None,    # [H, S_max] additive (alibi)
+    window: Optional[jax.Array] = None,  # scalar sliding-window size
+) -> jax.Array:
+    """Attention of q against a cache that already holds its keys/values.
 
     Reference counterpart: ``softmax_context`` (csrc/transformer/inference
-    pt_binding.cpp) + the inference_context.h KV workspace. Static shapes keep
-    the decode loop compiled once (the CUDA-graph analog — SURVEY §7.12).
-    Returns (out, k_cache, v_cache) with the new tokens written at
-    ``cache_index``.
-    """
+    pt_binding.cpp) + the inference_context.h KV workspace. Static shapes
+    keep the decode loop compiled once (the CUDA-graph analog — SURVEY
+    §7.12). The write side (dynamic_update_slice of the new token's K/V at
+    ``cache_index``) lives with the cache owner — models write into the full
+    stacked [L, B, H, S, Dh] cache carried through the layer scan, which XLA
+    updates in place; returning per-layer cache copies through scan ys
+    rewrote the entire cache every decode step (round-2 weak #2, ~4x the
+    weight-streaming roofline cost at batch 8).
+
+    The cache is stored HEAD-MAJOR ([B, H, S, Dh]): each head's [S, Dh]
+    K/V block is then contiguous in HBM, so the QK^T (contract Dh) and PV
+    (contract S) reads stream sequentially. With the torch-style
+    [B, S, Hkv, Dh] logical shape, XLA assigned the loop-carried cache a
+    token-major layout (optimal for the one-token write, 128-byte-strided
+    for every read): measured ~150 GB/s effective cache streaming vs
+    1.6 TB/s on weights at batch 8."""
     b, t, hq, dh = q.shape
-    hkv = k_cache.shape[2]
-    s_max = k_cache.shape[1]
-    k_cache = jax.lax.dynamic_update_slice(k_cache, k_new.astype(k_cache.dtype), (0, cache_index, 0, 0))
-    v_cache = jax.lax.dynamic_update_slice(v_cache, v_new.astype(v_cache.dtype), (0, cache_index, 0, 0))
+    hkv = k_cache.shape[1]
+    s_max = k_cache.shape[2]
     scale = scale if scale is not None else dh ** -0.5
     # GQA: q heads grouped over kv heads (hq == hkv * rep; rep == 1 for MHA)
     rep = hq // hkv
     qg = q.reshape(b, t, hkv, rep, dh)
-    logits = jnp.einsum("btkrd,bskd->bkrts", qg, k_cache).astype(jnp.float32) * scale
+    logits = jnp.einsum("btkrd,bksd->bkrts", qg, k_cache).astype(jnp.float32) * scale
     if bias is not None:
         logits = logits + bias.astype(jnp.float32).reshape(
             1, hkv, rep, 1, s_max)
@@ -94,5 +116,5 @@ def attention_with_kv_cache(
         valid = valid & (q_pos - pos < window)
     logits = jnp.where(valid[None, None, None], logits, jnp.finfo(jnp.float32).min)
     probs = jax.nn.softmax(logits, axis=-1).astype(v_cache.dtype)
-    out = jnp.einsum("bkrts,bskd->btkrd", probs, v_cache)
-    return out.reshape(b, t, hq, dh), k_cache, v_cache
+    out = jnp.einsum("bkrts,bksd->btkrd", probs, v_cache)
+    return out.reshape(b, t, hq, dh)
