@@ -26,6 +26,12 @@ def _lib() -> ctypes.CDLL:
             ctypes.c_uint64, ctypes.c_int64, ctypes.c_float, ctypes.c_float,
             ctypes.c_float, ctypes.c_float, ctypes.c_float, ctypes.c_int,
             ctypes.c_int]
+        lib.dstpu_adam_step_fused.argtypes = [
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int, ctypes.c_float,
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
+            ctypes.c_uint64, ctypes.c_int64, ctypes.c_float, ctypes.c_float,
+            ctypes.c_float, ctypes.c_float, ctypes.c_float, ctypes.c_int,
+            ctypes.c_int]
         lib.dstpu_adagrad_step.argtypes = [
             ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
             ctypes.c_uint64, ctypes.c_float, ctypes.c_float, ctypes.c_float]
@@ -59,6 +65,39 @@ def adam_step(params: np.ndarray, grads: np.ndarray, exp_avg: np.ndarray,
                            _ptr(exp_avg_sq), params.size, step, lr,
                            betas[0], betas[1], eps, weight_decay,
                            int(adamw_mode), int(bias_correction))
+
+
+def adam_step_fused(params: np.ndarray, grads: np.ndarray,
+                    exp_avg: np.ndarray, exp_avg_sq: np.ndarray, step: int,
+                    lr: float, betas=(0.9, 0.999), eps: float = 1e-8,
+                    weight_decay: float = 0.0, adamw_mode: bool = True,
+                    bias_correction: bool = True, grad_scale: float = 1.0,
+                    emit_bf16: bool = False):
+    """One-pass fused Adam for the offload hot path: grads may be fp32 OR
+    bf16 (decoded inline — no separate convert/scale sweeps), ``grad_scale``
+    folds the engine's unscale/clip factor in, and with ``emit_bf16`` the
+    updated compute-dtype image is written in the same sweep.  Returns the
+    bf16 image (ml_dtypes view) or None."""
+    import ml_dtypes
+
+    assert params.dtype == np.float32
+    for a in (exp_avg, exp_avg_sq):
+        assert a.dtype == np.float32 and a.size == params.size
+    assert grads.size == params.size
+    grads = np.ascontiguousarray(grads)
+    if grads.dtype == ml_dtypes.bfloat16:
+        g_ptr, g_bf16 = grads.view(np.uint16), 1
+    else:
+        if grads.dtype != np.float32:  # e.g. fp16 grads from an fp16 engine
+            grads = np.ascontiguousarray(grads.astype(np.float32))
+        g_ptr, g_bf16 = grads, 0
+    out = np.empty(params.shape, np.uint16) if emit_bf16 else None
+    _lib().dstpu_adam_step_fused(
+        _ptr(params), _ptr(g_ptr), g_bf16, grad_scale, _ptr(exp_avg),
+        _ptr(exp_avg_sq), _ptr(out) if out is not None else None,
+        params.size, step, lr, betas[0], betas[1], eps, weight_decay,
+        int(adamw_mode), int(bias_correction))
+    return out.view(ml_dtypes.bfloat16) if out is not None else None
 
 
 def adagrad_step(params: np.ndarray, grads: np.ndarray, sum_sq: np.ndarray,
