@@ -90,6 +90,13 @@ class PipelineConfig(DeepSpeedConfigModel):
     partition_method: str = "parameters"
     activation_checkpoint_interval: int = 0
     micro_batches: Optional[int] = None
+    # "spmd": whole schedule compiled into one XLA program (default;
+    #   GPipe-shaped backward via autodiff — remat bounds memory).
+    # "host_1f1b": host-driven interpreter of the TrainSchedule instruction
+    #   stream over per-stage jitted functions; activation memory bounded by
+    #   num_pipe_buffers (pipeline depth), the reference's 1F1B profile
+    #   (runtime/pipe/engine.py:1287 _exec_schedule analog).
+    executor: str = "spmd"
 
 
 class SequenceParallelConfig(DeepSpeedConfigModel):

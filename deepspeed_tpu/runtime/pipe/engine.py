@@ -273,8 +273,23 @@ class PipelineEngine(DeepSpeedEngine):
                          collate_fn=collate_fn, topology=topology, **kw)
         self.num_stages = num_stages
         self.micro_batches = self.gas
+        self._exec_mode = self.config.pipeline.executor
+        if self._exec_mode not in ("spmd", "host_1f1b"):
+            raise PipelineError(
+                f"pipeline.executor must be 'spmd' or 'host_1f1b', "
+                f"got {self._exec_mode!r}")
+        self._executor_1f1b = None
+        self._1f1b_cast = None
+        self._1f1b_apply = None
+        self.last_1f1b_stats = None
+        if self._exec_mode == "host_1f1b":
+            from deepspeed_tpu.runtime.pipe.executor import (
+                Schedule1F1BExecutor)
+
+            self._executor_1f1b = Schedule1F1BExecutor(adapter, self.gas)
         log_dist(
             f"PipelineEngine: stages={num_stages} "
+            f"executor={self._exec_mode} "
             f"body_layers=[{adapter.body_start},{adapter.body_end}) "
             f"layers/stage={adapter.layers_per_stage} "
             f"tied_groups={list(adapter.tie_owner)}", ranks=[0])
@@ -304,9 +319,84 @@ class PipelineEngine(DeepSpeedEngine):
         self._compiled_train_step = jax.jit(train_step, donate_argnums=(0,))
         return self._compiled_train_step
 
+    # --------------------------------------------- host-driven 1F1B executor
+    def _run_fused_step(self, batch):
+        if self._exec_mode == "host_1f1b":
+            return self._run_host_1f1b_step(batch)
+        return super()._run_fused_step(batch)
+
+    def _run_host_1f1b_step(self, batch):
+        """One train_batch via the instruction-stream interpreter
+        (reference _exec_schedule:1287): per-stage jitted fwd/bwd driven by
+        TrainSchedule, activation memory bounded by num_pipe_buffers; the
+        epilogue (unscale/clip/optimizer/scale-update) reuses the engine's
+        compiled _apply_grads."""
+        import jax.numpy as jnp  # noqa: F811
+        from deepspeed_tpu.runtime.engine import TRAIN_BATCH_TIMER
+
+        self.tput_timer.start()
+        self.timers(TRAIN_BATCH_TIMER).start()
+        batch = self._apply_curriculum(batch)
+        batch = jax.device_put(batch, self._gas_batch_shardings(batch))
+        lr = jnp.asarray(self.get_lr()[0], jnp.float32)
+        if self._1f1b_cast is None:
+            self._1f1b_cast = jax.jit(self._cast_for_compute)
+
+            def apply(state, grads, lr):
+                grads = jax.tree_util.tree_map(
+                    lambda g: g.astype(jnp.float32), grads)
+                # copy the used scale into an output: the input state is
+                # donated, so its buffers must not be referenced afterwards
+                used_scale = state.scaler.cur_scale * 1.0
+                new_state, overflow, norm = self._apply_grads(state, grads, lr)
+                return new_state, overflow, norm, used_scale
+
+            # donate old state + grads: the epilogue must not double-buffer
+            # params/opt state in the executor whose point is peak memory
+            self._1f1b_apply = jax.jit(apply, donate_argnums=(0, 1))
+        cparams = self._1f1b_cast(self.state.params)
+        # keep the scale a device scalar — a host fetch here would fence
+        # dispatch against the previous step's scaler update (tunnel RTT)
+        scale = self.state.scaler.cur_scale
+        loss, grads, stats = self._executor_1f1b.train_batch(
+            cparams, batch, loss_scale=scale)
+        self.last_1f1b_stats = stats
+        self.state, overflow, norm, scale = self._1f1b_apply(
+            self.state, grads, lr)
+        self._global_grad_norm = norm
+        self.micro_steps += self.gas
+        self.global_steps += 1
+        if self.lr_scheduler is not None:
+            self.lr_scheduler.step()
+        metrics = {"loss": loss, "overflow": overflow, "grad_norm": norm,
+                   "loss_scale": scale}
+        self._after_step(metrics)
+        self.timers(TRAIN_BATCH_TIMER).stop(record=True)
+        self.tput_timer.stop(global_step=True)
+        if self._sync_each_step:
+            jax.block_until_ready(self.state.params)
+        return metrics["loss"]
+
     # --------------------------------------------------------------- user API
     def eval_batch(self, batch, compute_loss: bool = True):
-        """reference eval_batch:362 — forward-only pipeline pass."""
+        """reference eval_batch:362 — forward-only pipeline pass. In
+        host_1f1b mode this interprets InferenceSchedule tick by tick (the
+        path that still works when one XLA program cannot span the job)."""
+        if self._exec_mode == "host_1f1b":
+            leaves = jax.tree_util.tree_leaves(batch)
+            if leaves and leaves[0].ndim >= 1 and not self._looks_stacked(batch):
+                batch = jax.tree_util.tree_map(lambda x: x[None], batch)
+            batch = jax.device_put(batch, self._gas_batch_shardings(batch))
+            if self._1f1b_cast is None:
+                self._1f1b_cast = jax.jit(self._cast_for_compute)
+            M = jax.tree_util.tree_leaves(batch)[0].shape[0]
+            ex = self._executor_1f1b
+            if M != ex.M:
+                from deepspeed_tpu.runtime.pipe.executor import (
+                    Schedule1F1BExecutor)
+
+                ex = Schedule1F1BExecutor(self._executor_1f1b.adapter, M)
+            return ex.eval_batch(self._1f1b_cast(self.state.params), batch)
         if self._compiled_eval is None:
             def ev(params, batch):
                 cparams = self._cast_for_compute(params)

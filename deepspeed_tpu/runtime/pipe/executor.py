@@ -1,0 +1,351 @@
+"""Host-driven 1F1B pipeline executor — interprets ``schedule.py`` streams.
+
+Parity target: reference ``runtime/pipe/engine.py:1287 _exec_schedule`` — the
+instruction interpreter that binds ``TrainSchedule``'s per-stage tick streams
+(schedule.py:189) to compute/communication callbacks with a bounded buffer
+pool (``num_pipe_buffers``, schedule.py:248).
+
+Relationship to the SPMD engine (parallel/pipeline.py): the SPMD scan
+compiles the whole schedule into one XLA program, but its backward is
+autodiff's replay — GPipe-shaped, holding all M microbatch activations
+(unless remat'd). This executor interprets the 1F1B stream tick by tick over
+per-stage jitted functions, so at most ``num_pipe_buffers(stage) <= stages``
+microbatch activations are ever live per stage — activation memory is
+bounded by pipeline DEPTH, not microbatch count, exactly like the reference.
+It is also the execution model that extends to multi-slice DCN pipelining,
+where one SPMD program cannot span the job and stage boundaries become real
+transfers.
+
+Design notes (TPU-first):
+  * BackwardPass rematerializes the stage forward inside ``jax.vjp`` — a
+    buffer holds only the stage's INPUT activation (plus the pending output
+    grad), the jax.checkpoint-style trade the reference makes with
+    activation checkpointing. Peak live bytes per stage ~= num_pipe_buffers
+    * activation_size.
+  * Sends/recvs within a tick run in two phases (all sends first): the
+    reference orders each rank's cmds the same way, relying on p2p blocking
+    for cross-rank pairing; a FIFO per directed edge replaces the NCCL
+    channel. In a multi-slice deployment these become real
+    ``jax.device_put`` transfers — the interpreter is transfer-agnostic.
+  * Per-stage fwd/bwd are jitted once and REUSED across middle stages
+    (identical shapes), so compile count is O(1) in depth.
+  * The tied-weight sum (ReduceTiedGrads, reference :223) falls out of
+    accumulation: stage 0's prefix grads and the last stage's suffix grads
+    both accumulate into the same ``tied`` slot.
+"""
+
+from __future__ import annotations
+
+import functools
+from collections import deque
+from typing import Any, Callable, Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.runtime.pipe import schedule as sched
+
+
+def _tree_add(a, b):
+    if a is None:
+        return b
+    return jax.tree_util.tree_map(jnp.add, a, b)
+
+
+def _tree_bytes(tree) -> int:
+    return sum(x.nbytes for x in jax.tree_util.tree_leaves(tree)
+               if hasattr(x, "nbytes"))
+
+
+class _Buffer:
+    """One pipeline buffer slot (reference engine.py pipe_buffers)."""
+
+    __slots__ = ("mb_id", "x", "y", "gy", "gx")
+
+    def __init__(self):
+        self.mb_id = None   # microbatch index (FIFO order)
+        self.x = None       # stage input activation (kept until backward)
+        self.y = None       # stage output (kept until sent)
+        self.gy = None      # received output grad
+        self.gx = None      # input grad (kept until sent)
+
+    def live_bytes(self) -> int:
+        return sum(_tree_bytes(v) for v in (self.x, self.y, self.gy, self.gx)
+                   if v is not None)
+
+
+class Schedule1F1BExecutor:
+    """Interpret Train/Inference schedules over a PipelinedModelAdapter.
+
+    ``train_batch(params, batch)`` returns ``(mean_loss, grads, stats)``
+    where grads matches the params structure and stats records the measured
+    peak buffer occupancy / live activation bytes per stage (the memory
+    bound this executor exists to enforce).
+    """
+
+    def __init__(self, adapter, micro_batches: int,
+                 schedule_cls=sched.TrainSchedule):
+        self.adapter = adapter
+        self.S = adapter.num_stages
+        self.M = micro_batches
+        self.schedule_cls = schedule_cls
+        assert self.S >= 2, (
+            "the 1F1B executor is for multi-stage pipelines; single-stage "
+            "training uses the engine's fused step (DataParallelSchedule)")
+        self._build_fns()
+
+    # ------------------------------------------------------------ stage fns
+    def _build_fns(self):
+        # NOTE on dropout rngs: stage fns pass rngs=None to layers, the same
+        # as PipelinedModelAdapter.apply on the SPMD path — pipeline layers
+        # with stochastic behavior are not rng-threaded on EITHER executor
+        # today (the two paths stay numerically identical).
+        ad = self.adapter
+
+        def stage_body(body_s, x, train):
+            def body(h, lp):
+                return ad.body_layer.apply(lp, h, rngs=None,
+                                           train=train), None
+            return jax.lax.scan(body, x, body_s)[0]
+
+        def first_fwd(shared, body0, mb, *, train):
+            inputs, _ = ad._split_batch(mb)
+            h = ad._run_segment(shared, ad.prefix_idx, inputs, train)
+            return stage_body(body0, h, train)
+
+        def mid_fwd(body_s, x, *, train):
+            return stage_body(body_s, x, train)
+
+        def last_loss(body_last, shared, x, mb, *, train):
+            _, labels = ad._split_batch(mb)
+            y = stage_body(body_last, x, train)
+            out = ad._run_segment(shared, ad.suffix_idx, y, train)
+            if ad.module.loss_fn is not None:
+                return ad.module.loss_fn(out, labels)
+            return out
+
+        # shared params (pre/post/tied) enter first/last stages so their
+        # grads flow; vjp wrt (shared, body, x) as needed
+        self._first_fwd = jax.jit(functools.partial(first_fwd, train=True))
+        self._mid_fwd = jax.jit(functools.partial(mid_fwd, train=True))
+        self._first_fwd_eval = jax.jit(functools.partial(first_fwd,
+                                                         train=False))
+        self._mid_fwd_eval = jax.jit(functools.partial(mid_fwd, train=False))
+        self._last_fwd_eval = jax.jit(functools.partial(last_loss,
+                                                        train=False))
+
+        def first_bwd(shared, body0, mb, gy):
+            _, vjp = jax.vjp(
+                lambda s, b: first_fwd(s, b, mb, train=True), shared, body0)
+            return vjp(gy)  # (g_shared, g_body0)
+
+        def mid_bwd(body_s, x, gy):
+            _, vjp = jax.vjp(
+                lambda b, xx: mid_fwd(b, xx, train=True), body_s, x)
+            return vjp(gy)  # (g_body, gx)
+
+        def last_bwd(body_last, shared, x, mb, dloss):
+            loss, vjp = jax.vjp(
+                lambda b, s, xx: last_loss(b, s, xx, mb, train=True),
+                body_last, shared, x)
+            g_body, g_shared, gx = vjp(dloss)
+            return loss, g_body, g_shared, gx
+
+        self._first_bwd = jax.jit(first_bwd)
+        self._mid_bwd = jax.jit(mid_bwd)
+        self._last_bwd = jax.jit(last_bwd)
+
+    @staticmethod
+    def _shared_of(params):
+        return {"pre": params["pre"], "post": params["post"],
+                "tied": params["tied"]}
+
+    # ------------------------------------------------------------ execution
+    def train_batch(self, params, batch,
+                    optimizer_step_fn: Optional[Callable] = None,
+                    loss_scale=1.0):
+        """``batch`` leaves carry a leading [M] microbatch dim. Interprets
+        each stage's TrainSchedule stream tick-locked; returns
+        (mean_loss, grads, stats). ``optimizer_step_fn(grads)`` runs at the
+        OptimizerStep instruction when provided. ``loss_scale`` (python
+        float or device scalar — device keeps dispatch async) multiplies
+        the seed cotangent (fp16 dynamic-loss-scaling semantics — the
+        engine's _apply_grads unscales); the reported loss is UNscaled."""
+        S, M = self.S, self.M
+        ad = self.adapter
+        shared = self._shared_of(params)
+        # slice each stage's body params ONCE per batch (the pipe-sharded
+        # stack reshards on slicing; per-instruction slicing would repay
+        # that transfer every tick)
+        bodies = [jax.tree_util.tree_map(lambda a, s=s: a[s], params["body"])
+                  for s in range(S)]
+        body_of = lambda s: bodies[s]  # noqa: E731
+        mb_of = lambda i: jax.tree_util.tree_map(  # noqa: E731
+            lambda x: x[i], batch)
+
+        schedules = [self.schedule_cls(M, S, s) for s in range(S)]
+        streams = [list(s.steps()) for s in schedules]
+        n_ticks = max(len(st) for st in streams)
+        bufs = [[_Buffer() for _ in range(schedules[s].num_pipe_buffers())]
+                for s in range(S)]
+        act_wire = [deque() for _ in range(S)]   # edge s-1 -> s
+        grad_wire = [deque() for _ in range(S)]  # edge s+1 -> s
+        load_count = [0] * S    # LoadMicroBatch FIFO per stage
+        recv_count = [0] * S    # RecvActivation FIFO per stage (mb order)
+        g_shared = None
+        g_body: List[Any] = [None] * S
+        losses = []
+        dloss = jnp.asarray(loss_scale, jnp.float32) / M
+        stats = {"peak_buffers": [0] * S, "peak_live_bytes": [0] * S,
+                 "num_pipe_buffers": [schedules[s].num_pipe_buffers()
+                                      for s in range(S)]}
+        opt_ran = False
+
+        for tick in range(n_ticks):
+            cmds = [streams[s][tick] if tick < len(streams[s]) else []
+                    for s in range(S)]
+            # phase 1: sends (always reference completed earlier-tick data)
+            for s in range(S):
+                for c in cmds[s]:
+                    buf = bufs[s][c.buffer_id] if isinstance(
+                        c, sched.BufferOpInstruction) else None
+                    if isinstance(c, sched.SendActivation):
+                        act_wire[s + 1].append(buf.y)
+                        buf.y = None
+                    elif isinstance(c, sched.SendGrad):
+                        grad_wire[s - 1].append(buf.gx)
+                        buf.gx = None
+            # phase 2: recv + compute
+            for s in range(S):
+                for c in cmds[s]:
+                    buf = bufs[s][c.buffer_id] if isinstance(
+                        c, sched.BufferOpInstruction) else None
+                    if isinstance(c, sched.LoadMicroBatch):
+                        buf.mb_id = load_count[s]
+                        load_count[s] += 1
+                    elif isinstance(c, sched.RecvActivation):
+                        assert act_wire[s], (
+                            f"tick {tick} stage {s}: RecvActivation with an "
+                            "empty wire — schedule pairing violated")
+                        buf.x = act_wire[s].popleft()
+                        buf.mb_id = recv_count[s]
+                        recv_count[s] += 1
+                    elif isinstance(c, sched.RecvGrad):
+                        assert grad_wire[s], (
+                            f"tick {tick} stage {s}: RecvGrad with an empty "
+                            "wire — schedule pairing violated")
+                        buf.gy = grad_wire[s].popleft()
+                    elif isinstance(c, sched.ForwardPass):
+                        if s == 0:
+                            buf.x = mb_of(buf.mb_id)
+                            y = self._first_fwd(shared, body_of(0), buf.x)
+                        elif s < S - 1:
+                            y = self._mid_fwd(body_of(s), buf.x)
+                        else:
+                            # last stage: loss+backward fuse in BackwardPass
+                            # (value_and_grad) — forward here would double
+                            # the stage compute under remat-backward
+                            continue
+                        if s < S - 1:
+                            buf.y = y
+                    elif isinstance(c, sched.BackwardPass):
+                        if s == S - 1:
+                            loss, gb, gs, gx = self._last_bwd(
+                                body_of(s), shared, buf.x,
+                                mb_of(buf.mb_id), dloss)
+                            losses.append(loss)
+                            g_shared = _tree_add(g_shared, gs)
+                            g_body[s] = _tree_add(g_body[s], gb)
+                            buf.gx = gx
+                        elif s > 0:
+                            gb, gx = self._mid_bwd(body_of(s), buf.x, buf.gy)
+                            g_body[s] = _tree_add(g_body[s], gb)
+                            buf.gx = gx
+                        else:
+                            gs, gb = self._first_bwd(
+                                shared, body_of(0), buf.x, buf.gy)
+                            g_shared = _tree_add(g_shared, gs)
+                            g_body[0] = _tree_add(g_body[0], gb)
+                        buf.x = None   # memory release point (1F1B bound)
+                        buf.gy = None
+                    elif isinstance(c, sched.ReduceTiedGrads):
+                        pass  # tied sum falls out of g_shared accumulation
+                    elif isinstance(c, sched.ReduceGrads):
+                        pass  # data-axis reduction: GSPMD inside stage fns
+                    elif isinstance(c, sched.OptimizerStep):
+                        opt_ran = True
+            # memory accounting at tick boundary
+            for s in range(S):
+                live = [b for b in bufs[s] if b.live_bytes() > 0]
+                stats["peak_buffers"][s] = max(stats["peak_buffers"][s],
+                                               len(live))
+                stats["peak_live_bytes"][s] = max(
+                    stats["peak_live_bytes"][s],
+                    sum(b.live_bytes() for b in live))
+
+        assert len(losses) == M, f"expected {M} losses, got {len(losses)}"
+        grads = {
+            "pre": g_shared["pre"], "post": g_shared["post"],
+            "tied": g_shared["tied"],
+            "body": jax.tree_util.tree_map(
+                lambda *xs: jnp.stack(xs), *g_body),
+        }
+        mean_loss = sum(jax.tree_util.tree_leaves(losses)) / M
+        if opt_ran and optimizer_step_fn is not None:
+            optimizer_step_fn(grads)
+        return mean_loss, grads, stats
+
+    def eval_batch(self, params, batch):
+        """Forward-only interpretation of InferenceSchedule."""
+        S, M = self.S, self.M
+        shared = self._shared_of(params)
+        bodies = [jax.tree_util.tree_map(lambda a, s=s: a[s], params["body"])
+                  for s in range(S)]
+        body_of = lambda s: bodies[s]  # noqa: E731
+        mb_of = lambda i: jax.tree_util.tree_map(  # noqa: E731
+            lambda x: x[i], batch)
+
+        schedules = [sched.InferenceSchedule(M, S, s) for s in range(S)]
+        streams = [list(s.steps()) for s in schedules]
+        n_ticks = max(len(st) for st in streams)
+        bufs = [[_Buffer() for _ in range(schedules[s].num_pipe_buffers())]
+                for s in range(S)]
+        act_wire = [deque() for _ in range(S)]
+        counters = [0] * S
+        losses = []
+        for tick in range(n_ticks):
+            cmds = [streams[s][tick] if tick < len(streams[s]) else []
+                    for s in range(S)]
+            # forward-only: InferenceSchedule sends in the SAME tick as the
+            # forward (unlike TrainSchedule's previous-tick sends), so one
+            # ascending-stage pass in cmd order gives correct send/recv
+            # pairing — the producer stage always runs before its consumer
+            for s in range(S):
+                for c in cmds[s]:
+                    buf = bufs[s][c.buffer_id] if isinstance(
+                        c, sched.BufferOpInstruction) else None
+                    if isinstance(c, sched.LoadMicroBatch):
+                        buf.mb_id = counters[s]
+                        counters[s] += 1
+                    elif isinstance(c, sched.RecvActivation):
+                        assert act_wire[s], (
+                            f"tick {tick} stage {s}: RecvActivation with an "
+                            "empty wire — schedule pairing violated")
+                        buf.x = act_wire[s].popleft()
+                        buf.mb_id = counters[s]
+                        counters[s] += 1
+                    elif isinstance(c, sched.SendActivation):
+                        act_wire[s + 1].append(buf.y)
+                        buf.y = None
+                    elif isinstance(c, sched.ForwardPass):
+                        if s == 0 and S > 1:
+                            buf.y = self._first_fwd_eval(
+                                shared, body_of(0), mb_of(buf.mb_id))
+                        elif s < S - 1:
+                            buf.y = self._mid_fwd_eval(body_of(s), buf.x)
+                        else:
+                            losses.append(self._last_fwd_eval(
+                                body_of(s), shared, buf.x, mb_of(buf.mb_id)))
+                            buf.x = None
+        assert len(losses) == M
+        return sum(jax.tree_util.tree_leaves(losses)) / M

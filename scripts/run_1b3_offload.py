@@ -111,9 +111,11 @@ def main():
     # no-op step, which nothing downstream consumes.
     zero_grads = {n: np.zeros_like(m)
                   for n, m in engine._host_opt.master.items()}
-    t0 = time.perf_counter()
-    engine._host_opt.step(zero_grads, 1e-4)
-    t_host_adam = time.perf_counter() - t0
+    t_host_adam = float("inf")   # best-of-3: first call pays page faults /
+    for _ in range(3):           # library load; co-tenant CPU noise is real
+        t0 = time.perf_counter()
+        engine._host_opt.step(zero_grads, 1e-4)
+        t_host_adam = min(t_host_adam, time.perf_counter() - t0)
 
     # measured tunnel link rate (for the projection)
     probe = jnp.ones((16, 1024, 1024), jnp.float32)  # 64MB

@@ -1,0 +1,109 @@
+"""Host-driven 1F1B executor tests — the instruction-stream interpreter
+(runtime/pipe/executor.py; reference runtime/pipe/engine.py:1287
+_exec_schedule). Asserts the two properties the executor exists for:
+numerics identical to the SPMD engine, and activation memory bounded by
+``num_pipe_buffers`` (pipeline depth), not microbatch count."""
+
+import sys
+from pathlib import Path
+
+import jax
+import numpy as np
+import pytest
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[2]))
+
+from runtime.pipe.test_pipe import lm_stream, run_pipe_training  # noqa: E402
+
+
+def run_1f1b_training(pp, gas=4, steps=3, seed=0, num_layers=None):
+    return run_pipe_training(pp=pp, gas=gas, steps=steps, seed=seed,
+                             num_layers=num_layers, executor="host_1f1b")
+
+
+def test_1f1b_matches_spmd_engine():
+    """Same model/data/optimizer: interpreter losses == SPMD-scan losses."""
+    _, l_spmd = run_pipe_training(pp=2)
+    _, l_1f1b = run_1f1b_training(pp=2)
+    np.testing.assert_allclose(l_spmd, l_1f1b, rtol=2e-4)
+
+
+def test_1f1b_trains():
+    _, losses = run_1f1b_training(pp=2)
+    assert losses[-1] < losses[0], losses
+
+
+def test_1f1b_four_stages_tied():
+    _, l1 = run_pipe_training(pp=1, num_layers=4)
+    _, l4 = run_1f1b_training(pp=4, num_layers=4)
+    np.testing.assert_allclose(l1, l4, rtol=2e-4)
+
+
+def test_1f1b_memory_bounded_by_depth_not_microbatches():
+    """The 1F1B property: with M=8 microbatches over S=2 stages, peak live
+    buffers per stage == num_pipe_buffers (<= S) — NOT M (GPipe). This is
+    the reference's schedule.py:248 num_pipe_buffers bound, measured."""
+    M = 8
+    engine, _ = run_1f1b_training(pp=2, gas=M, steps=1)
+    stats = engine.last_1f1b_stats
+    assert stats is not None
+    for s, (peak, bound) in enumerate(zip(stats["peak_buffers"],
+                                          stats["num_pipe_buffers"])):
+        assert peak <= bound, (s, peak, bound)
+        assert peak < M, f"stage {s}: peak {peak} scales with microbatches"
+    # front stage holds the deepest window; must be exactly the 1F1B bound
+    assert stats["peak_buffers"][0] == stats["num_pipe_buffers"][0] == 2
+    assert max(stats["peak_live_bytes"]) > 0
+
+
+def test_1f1b_schedule_wire_pairing_validated():
+    """The interpreter asserts send/recv pairing — running it IS the
+    schedule-stream validation (schedules are no longer spec-only)."""
+    engine, losses = run_1f1b_training(pp=2, steps=1)
+    assert np.isfinite(losses[0])
+
+
+def test_1f1b_fp16_loss_scale_unscales():
+    """fp16 dynamic loss scaling composes: the seed cotangent is scaled,
+    _apply_grads unscales, training still converges."""
+    from deepspeed_tpu.models.gpt2 import GPT2Config
+    from deepspeed_tpu.models.pipeline_layers import gpt2_pipe
+    from deepspeed_tpu.parallel.topology import build_topology
+    from deepspeed_tpu.utils import groups
+    import deepspeed_tpu
+
+    groups.reset()
+    topo = build_topology(pp=2)
+    module = gpt2_pipe(GPT2Config.tiny(), num_stages=2)
+    engine, *_ = deepspeed_tpu.initialize(
+        model=module, topology=topo, config={
+            "train_batch_size": 32,
+            "train_micro_batch_size_per_gpu": 2,
+            "gradient_accumulation_steps": 4,
+            "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+            "fp16": {"enabled": True, "initial_scale_power": 4},
+            "pipeline": {"stages": 2, "executor": "host_1f1b"},
+            "steps_per_print": 0,
+        })
+    losses = [float(jax.device_get(engine.train_batch_from_stacked(b)))
+              for b in lm_stream(4, n=3)]
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0] + 0.5  # finite + not diverging
+
+
+def test_1f1b_eval_batch_inference_schedule():
+    """engine.eval_batch in host_1f1b mode interprets InferenceSchedule and
+    matches the SPMD eval loss (both engines trained one identical step)."""
+    engine_spmd, _ = run_pipe_training(pp=2, steps=1)
+    engine_1f1b, _ = run_1f1b_training(pp=2, steps=1)
+    batch = lm_stream(4, n=1, seed=7)[0]
+    l_spmd = float(jax.device_get(engine_spmd.eval_batch(batch)))
+    l_1f1b = float(jax.device_get(engine_1f1b.eval_batch(batch)))
+    np.testing.assert_allclose(l_spmd, l_1f1b, rtol=2e-4)
+
+
+def test_1f1b_rejects_unknown_executor():
+    from deepspeed_tpu.runtime.pipe.engine import PipelineError
+
+    with pytest.raises((PipelineError, Exception)):
+        run_pipe_training(pp=2, steps=1, executor="bogus")

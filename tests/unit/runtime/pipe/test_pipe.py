@@ -84,7 +84,7 @@ def lm_stream(gas, b=8, t=32, vocab=512, seed=0, n=3):
 
 
 def run_pipe_training(pp, gas=4, steps=3, stage=0, tie=True, seed=0, num_layers=None,
-                      tp=1):
+                      tp=1, executor="spmd"):
     groups.reset()
     topo = build_topology(pp=pp, tp=tp)
     if num_layers is None:
@@ -100,7 +100,7 @@ def run_pipe_training(pp, gas=4, steps=3, stage=0, tie=True, seed=0, num_layers=
             "gradient_accumulation_steps": gas,
             "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
             "zero_optimization": {"stage": stage},
-            "pipeline": {"stages": pp},
+            "pipeline": {"stages": pp, "executor": executor},
             "tensor_parallel": {"tp_size": tp},
             "steps_per_print": 0,
         })
