@@ -118,8 +118,10 @@ class Autotuner:
         n = info["num_params"]
         shard = dp if zero_stage >= 1 else 1
         compute_shard = dp if zero_stage >= 3 else 1
-        # fp32 master + 2 Adam moments (sharded from stage 1, host if offload)
-        opt_bytes = 0.0 if offload else 12.0 * n / shard
+        # fp32 master + 2 Adam moments (sharded from stage 1, host if
+        # offload) — offload only credits HBM at the stages the runtime
+        # exercises it (>= 1; _trial prunes stage-0 offload candidates)
+        opt_bytes = 0.0 if (offload and zero_stage >= 1) else 12.0 * n / shard
         param_bytes = 2.0 * n / compute_shard          # bf16 compute copy
         grad_bytes = 4.0 * n / (dp if zero_stage >= 2 else 1)
         act = 0.0
@@ -173,6 +175,17 @@ class Autotuner:
 
             topo = build_topology()
             dp = topo.data_parallel_size
+
+            if offload and zero_stage < 1:
+                # user-supplied spaces can pair offload with stage 0; the
+                # sharded host-master path is only exercised from stage 1 —
+                # reject rather than estimate a config the runtime may not
+                # honor (ADVICE r2)
+                return TrialResult(
+                    zero_stage, micro_batch, 0, 0, 0, float("inf"), 0.0,
+                    fits=False, gas=gas, offload=offload, remat=remat,
+                    pruned=True,
+                    error="pruned: optimizer offload requires ZeRO stage >= 1")
 
             est_bytes = self._estimate_device_bytes(
                 zero_stage, micro_batch, offload, remat, dp)

@@ -740,6 +740,27 @@ def clip_text_proj_policy(hf_model, dtype):
     return _clip_text_common(hf_model, dtype)
 
 
+def _normalize_megatron_sd(sd):
+    """Strip Megatron module-path prefixes to the flat layers.* namespace
+    shared by the dense and MoE converters."""
+    return {k.replace("language_model.", "").replace("encoder.", "transformer.")
+             .replace("transformer.layers.", "layers.")
+             .replace("embedding.", ""): v
+            for k, v in sd.items()}
+
+
+def _megatron_qkv_fns(num_heads, megatron_v2):
+    """Fused-qkv row-layout handlers shared by the Megatron converters:
+    v2 rows are (heads, 3, head_dim); v1 rows are already (3, heads, dh)."""
+    def qkv_w(x):
+        return _fuse_headwise_qkv(x, num_heads) if megatron_v2 else x.T
+
+    def qkv_b(x):
+        return _fuse_headwise_qkv_bias(x, num_heads) if megatron_v2 else x
+
+    return qkv_w, qkv_b
+
+
 def convert_megatron_gpt_checkpoint(sd, *, num_heads, megatron_v2=True,
                                     compute_dtype=None, eps=1e-5):
     """Megatron-LM GPT state dict → (GPT2Model, params).
@@ -756,10 +777,7 @@ def convert_megatron_gpt_checkpoint(sd, *, num_heads, megatron_v2=True,
 
     from deepspeed_tpu.models.gpt2 import GPT2Config, GPT2Model
 
-    sd = {k.replace("language_model.", "").replace("encoder.", "transformer.")
-           .replace("transformer.layers.", "layers.")
-           .replace("embedding.", ""): v
-          for k, v in sd.items()}
+    sd = _normalize_megatron_sd(sd)
     wte = _np(sd["word_embeddings.weight"])
     wpe = _np(sd["position_embeddings.weight"])
     num_layers = 1 + max(int(k.split(".")[1]) for k in sd
@@ -770,11 +788,7 @@ def convert_megatron_gpt_checkpoint(sd, *, num_heads, megatron_v2=True,
                      num_heads=num_heads, eps=eps, tie_embeddings=True)
     model = GPT2Model(cfg, compute_dtype=compute_dtype or jnp.bfloat16)
 
-    def qkv_w(x):
-        return _fuse_headwise_qkv(x, num_heads) if megatron_v2 else x.T
-
-    def qkv_b(x):
-        return (_fuse_headwise_qkv_bias(x, num_heads) if megatron_v2 else x)
+    qkv_w, qkv_b = _megatron_qkv_fns(num_heads, megatron_v2)
 
     blocks = _dense_blocks(sd, num_layers, {
         "ln1_scale": "layers.{i}.input_layernorm.weight",
@@ -791,6 +805,107 @@ def convert_megatron_gpt_checkpoint(sd, *, num_heads, megatron_v2=True,
         "mlp_out_b": "layers.{i}.mlp.dense_4h_to_h.bias",
     }, post_map={"qkv_w": qkv_w, "qkv_b": qkv_b,
                  "attn_out_w": _lin, "mlp_fc_w": _lin, "mlp_out_w": _lin})
+    params = {
+        "wte": jnp.asarray(wte), "wpe": jnp.asarray(wpe), "blocks": blocks,
+        "ln_f_scale": jnp.asarray(_np(sd["transformer.final_layernorm.weight"])),
+        "ln_f_bias": jnp.asarray(_np(sd["transformer.final_layernorm.bias"])),
+    }
+    return model, params
+
+
+def convert_megatron_moe_checkpoint(sd, *, num_heads, top_k=1,
+                                    megatron_v2=True, compute_dtype=None,
+                                    eps=1e-5):
+    """Megatron-DeepSpeed GPT-MoE state dict → (GPTMoEModel, params).
+
+    Reference analog: ``module_inject/containers/megatron_gpt_moe.py``
+    (DS_MegatronGPTMoEContainer) — the expert stacks live under
+    ``mlp.deepspeed_moe.experts.deepspeed_experts.{e}`` and the gate under
+    ``mlp.deepspeed_moe.gate.wg`` (reference moe/experts.py:15,
+    moe/layer.py:70); dense/MoE interleave is whatever the Megatron run
+    used, detected per layer from the checkpoint keys. Expert Linear
+    weights stack to this framework's [E, in, out] batched-einsum layout
+    (moe/layer.py ExpertFFN), so serving shards them over the 'expert'
+    mesh axis exactly like training.
+    """
+    import jax.numpy as jnp
+
+    from deepspeed_tpu.models.gpt_moe import GPTMoEConfig, GPTMoEModel
+
+    sd = _normalize_megatron_sd(sd)
+    wte = _np(sd["word_embeddings.weight"])
+    wpe = _np(sd["position_embeddings.weight"])
+    num_layers = 1 + max(int(k.split(".")[1]) for k in sd
+                         if k.startswith("layers."))
+    d = wte.shape[1]
+
+    def gate_key(i):
+        return f"layers.{i}.mlp.deepspeed_moe.gate.wg.weight"
+
+    moe_layers = tuple(i for i in range(num_layers) if gate_key(i) in sd)
+    if not moe_layers:
+        raise ValueError(
+            "no deepspeed_moe gate weights found — use "
+            "convert_megatron_gpt_checkpoint for dense Megatron checkpoints")
+    num_experts = 1 + max(
+        int(k.split("deepspeed_experts.")[1].split(".")[0])
+        for k in sd if f"layers.{moe_layers[0]}.mlp.deepspeed_moe.experts." in k)
+
+    cfg = GPTMoEConfig(vocab_size=wte.shape[0], max_seq_len=wpe.shape[0],
+                       num_layers=num_layers, hidden_size=d,
+                       num_heads=num_heads, num_experts=num_experts,
+                       moe_layers=moe_layers, top_k=top_k, eps=eps)
+    model = GPTMoEModel(cfg, compute_dtype=compute_dtype or jnp.bfloat16)
+
+    qkv_w, qkv_b = _megatron_qkv_fns(num_heads, megatron_v2)
+
+    blocks = []
+    for i in range(num_layers):
+        p = f"layers.{i}"
+        blk = {
+            "ln1_scale": jnp.asarray(_np(sd[f"{p}.input_layernorm.weight"])),
+            "ln1_bias": jnp.asarray(_np(sd[f"{p}.input_layernorm.bias"])),
+            "qkv_w": jnp.asarray(qkv_w(_np(
+                sd[f"{p}.attention.query_key_value.weight"]))),
+            "qkv_b": jnp.asarray(qkv_b(_np(
+                sd[f"{p}.attention.query_key_value.bias"]))),
+            "out_w": jnp.asarray(_lin(_np(sd[f"{p}.attention.dense.weight"]))),
+            "out_b": jnp.asarray(_np(sd[f"{p}.attention.dense.bias"])),
+            "ln2_scale": jnp.asarray(_np(
+                sd[f"{p}.post_attention_layernorm.weight"])),
+            "ln2_bias": jnp.asarray(_np(
+                sd[f"{p}.post_attention_layernorm.bias"])),
+        }
+        if i in moe_layers:
+            e = f"{p}.mlp.deepspeed_moe.experts.deepspeed_experts"
+            blk["moe"] = {
+                # reference TopKGate wg is Linear(d→E): weight [E, d] → [d, E]
+                "gate": {"wg": jnp.asarray(_np(sd[gate_key(i)]).T)},
+                "experts": {
+                    "w1": jnp.asarray(np.stack(
+                        [_lin(_np(sd[f"{e}.{j}.dense_h_to_4h.weight"]))
+                         for j in range(num_experts)])),
+                    "b1": jnp.asarray(np.stack(
+                        [_np(sd[f"{e}.{j}.dense_h_to_4h.bias"])
+                         for j in range(num_experts)])),
+                    "w2": jnp.asarray(np.stack(
+                        [_lin(_np(sd[f"{e}.{j}.dense_4h_to_h.weight"]))
+                         for j in range(num_experts)])),
+                    "b2": jnp.asarray(np.stack(
+                        [_np(sd[f"{e}.{j}.dense_4h_to_h.bias"])
+                         for j in range(num_experts)])),
+                },
+            }
+        else:
+            blk.update({
+                "mlp_fc_w": jnp.asarray(_lin(_np(
+                    sd[f"{p}.mlp.dense_h_to_4h.weight"]))),
+                "mlp_fc_b": jnp.asarray(_np(sd[f"{p}.mlp.dense_h_to_4h.bias"])),
+                "mlp_out_w": jnp.asarray(_lin(_np(
+                    sd[f"{p}.mlp.dense_4h_to_h.weight"]))),
+                "mlp_out_b": jnp.asarray(_np(sd[f"{p}.mlp.dense_4h_to_h.bias"])),
+            })
+        blocks.append(blk)
     params = {
         "wte": jnp.asarray(wte), "wpe": jnp.asarray(wpe), "blocks": blocks,
         "ln_f_scale": jnp.asarray(_np(sd["transformer.final_layernorm.weight"])),

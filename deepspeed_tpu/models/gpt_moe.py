@@ -18,7 +18,7 @@ import jax.numpy as jnp
 
 from deepspeed_tpu.models.base import cross_entropy_loss, gelu, layer_norm
 from deepspeed_tpu.moe.layer import MoE
-from deepspeed_tpu.ops.attention import multihead_attention
+from deepspeed_tpu.ops.attention import decode_attention, multihead_attention, write_kv_cache
 
 
 @dataclasses.dataclass
@@ -30,8 +30,13 @@ class GPTMoEConfig:
     num_heads: int = 12
     num_experts: int = 8
     moe_every: int = 2          # every Nth layer is MoE
+    # explicit MoE layer indices (overrides moe_every) — checkpoints decide
+    # their own dense/MoE interleave (ref containers/megatron_gpt_moe.py
+    # converts whatever pattern the Megatron run used)
+    moe_layers: Optional[tuple] = None
     top_k: int = 1
     capacity_factor: float = 1.25
+    eval_capacity_factor: float = 2.0
     aux_loss_weight: float = 0.01
     use_residual: bool = False  # PR-MoE
     eps: float = 1e-5
@@ -53,9 +58,14 @@ class GPTMoEModel:
         self.config = config
         self.compute_dtype = compute_dtype
         c = config
-        self.moe_layers = [i for i in range(c.num_layers) if (i + 1) % c.moe_every == 0]
+        if c.moe_layers is not None:
+            self.moe_layers = sorted(int(i) for i in c.moe_layers)
+        else:
+            self.moe_layers = [i for i in range(c.num_layers)
+                               if (i + 1) % c.moe_every == 0]
         self.moe = MoE(c.hidden_size, c.num_experts, k=c.top_k,
                        capacity_factor=c.capacity_factor,
+                       eval_capacity_factor=c.eval_capacity_factor,
                        use_residual=c.use_residual)
 
     def init(self, rng):
@@ -111,41 +121,102 @@ class GPTMoEModel:
         return {"wte": ("vocab_in", "hidden"), "wpe": ("seq", "hidden"),
                 "blocks": blocks, "ln_f_scale": ("hidden",), "ln_f_bias": ("hidden",)}
 
-    def _attn(self, x, blk):
+    def _attn(self, x, blk, cache=None):
+        """Attention sub-block; ``cache=(k_full, v_full, layer, idx)`` runs
+        against the stacked head-major [L, B, H, S, Dh] KV cache (same
+        write/read ops as the dense families — ops/attention.py)."""
         c = self.config
         b, t, d = x.shape
         y = layer_norm(x, blk["ln1_scale"], blk["ln1_bias"], c.eps)
         qkv = y @ blk["qkv_w"].astype(y.dtype) + blk["qkv_b"].astype(y.dtype)
         q, k_, v_ = jnp.split(qkv, 3, axis=-1)
         shape = (b, t, c.num_heads, c.head_dim)
-        attn = multihead_attention(q.reshape(shape), k_.reshape(shape),
-                                   v_.reshape(shape), causal=True)
-        return x + attn.reshape(b, t, d) @ blk["out_w"].astype(x.dtype) + \
+        q, k_, v_ = q.reshape(shape), k_.reshape(shape), v_.reshape(shape)
+        if cache is None:
+            attn = multihead_attention(q, k_, v_, causal=True)
+            kc = vc = None
+        else:
+            kc, vc, layer, idx = cache
+            kc, vc, kl, vl = write_kv_cache(kc, vc, k_, v_, layer, idx)
+            attn = decode_attention(q, kl, vl, idx)
+        x = x + attn.reshape(b, t, d) @ blk["out_w"].astype(x.dtype) + \
             blk["out_b"].astype(x.dtype)
+        return x, kc, vc
+
+    def _ffn(self, x, blk, i, *, train: bool, rng):
+        """Dense MLP or MoE FFN for layer ``i`` → (x, aux_loss)."""
+        c = self.config
+        y = layer_norm(x, blk["ln2_scale"], blk["ln2_bias"], c.eps)
+        if i in self.moe_layers:
+            sub = jax.random.fold_in(rng, i) if rng is not None else None
+            moe_out, l_aux, _ = self.moe.apply(blk["moe"], y, train=train, rng=sub)
+            return x + moe_out, l_aux
+        h = gelu(y @ blk["mlp_fc_w"].astype(y.dtype) +
+                 blk["mlp_fc_b"].astype(y.dtype))
+        x = x + h @ blk["mlp_out_w"].astype(x.dtype) + \
+            blk["mlp_out_b"].astype(x.dtype)
+        return x, jnp.zeros((), jnp.float32)
+
+    def _embed(self, params, input_ids, start_pos=0):
+        x = params["wte"].astype(self.compute_dtype)[input_ids]
+        pos = start_pos + jnp.arange(input_ids.shape[1])
+        return x + params["wpe"].astype(self.compute_dtype)[pos][None]
+
+    def _forward_blocks(self, params, x, *, rng=None, train: bool = False):
+        total_aux = jnp.zeros((), jnp.float32)
+        for i, blk in enumerate(params["blocks"]):
+            x, _, _ = self._attn(x, blk)
+            x, l_aux = self._ffn(x, blk, i, train=train, rng=rng)
+            total_aux = total_aux + l_aux
+        c = self.config
+        return layer_norm(x, params["ln_f_scale"], params["ln_f_bias"],
+                          c.eps), total_aux
+
+    def forward_hidden(self, params, input_ids, *, rngs=None,
+                       train: bool = False):
+        rng = rngs.get("dropout") if isinstance(rngs, dict) else rngs
+        x = self._embed(params, input_ids)
+        hidden, _ = self._forward_blocks(params, x, rng=rng, train=train)
+        return hidden
+
+    def logits(self, params, hidden):
+        return jnp.einsum("btd,vd->btv", hidden,
+                          params["wte"].astype(hidden.dtype))
 
     def apply(self, params, batch, *, rngs=None, train: bool = False):
         c = self.config
-        ids = batch["input_ids"]
-        b, t = ids.shape
-        x = params["wte"].astype(self.compute_dtype)[ids]
-        x = x + params["wpe"].astype(self.compute_dtype)[:t][None]
         rng = rngs.get("dropout") if isinstance(rngs, dict) else rngs
-        total_aux = jnp.zeros((), jnp.float32)
-        for i, blk in enumerate(params["blocks"]):
-            x = self._attn(x, blk)
-            y = layer_norm(x, blk["ln2_scale"], blk["ln2_bias"], c.eps)
-            if i in self.moe_layers:
-                sub = jax.random.fold_in(rng, i) if rng is not None else None
-                moe_out, l_aux, _ = self.moe.apply(blk["moe"], y, train=train, rng=sub)
-                x = x + moe_out
-                total_aux = total_aux + l_aux
-            else:
-                h = gelu(y @ blk["mlp_fc_w"].astype(y.dtype) +
-                         blk["mlp_fc_b"].astype(y.dtype))
-                x = x + h @ blk["mlp_out_w"].astype(x.dtype) + \
-                    blk["mlp_out_b"].astype(x.dtype)
-        x = layer_norm(x, params["ln_f_scale"], params["ln_f_bias"], c.eps)
-        logits = jnp.einsum("btd,vd->btv", x, params["wte"].astype(x.dtype))
+        x = self._embed(params, batch["input_ids"])
+        hidden, total_aux = self._forward_blocks(params, x, rng=rng, train=train)
+        logits = self.logits(params, hidden)
         ce, n = cross_entropy_loss(logits, batch["labels"])
         loss = ce + c.aux_loss_weight * total_aux / max(len(self.moe_layers), 1)
         return loss, {"loss": loss, "ce_loss": ce, "aux_loss": total_aux, "ntokens": n}
+
+    # --------------------------------------------------------- inference path
+    def init_cache(self, batch_size: int, max_len: int, dtype=None):
+        """Static-shape stacked KV cache, head-major [L, B, H, S, Dh] (same
+        layout as the dense families; ops/attention.decode_attention)."""
+        c = self.config
+        dtype = dtype or self.compute_dtype
+        shape = (c.num_layers, batch_size, c.num_heads, max_len, c.head_dim)
+        return {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype),
+                "index": jnp.zeros((), jnp.int32)}
+
+    def forward_with_cache(self, params, input_ids, cache):
+        """Prefill (T>1) or decode (T=1) step against the KV cache →
+        (logits [B,T,V], new_cache). MoE layers gate in eval mode
+        (eval_capacity_factor, no gate noise) so decode is deterministic;
+        with experts sharded over the 'expert' mesh axis the dispatch and
+        combine einsums lower to the same all-to-alls as training (ref
+        inference/engine.py:274 expert groups at serve time)."""
+        c = self.config
+        idx = cache["index"]
+        x = self._embed(params, input_ids, start_pos=idx)
+        kc, vc = cache["k"], cache["v"]
+        for i, blk in enumerate(params["blocks"]):
+            x, kc, vc = self._attn(x, blk, cache=(kc, vc, i, idx))
+            x, _ = self._ffn(x, blk, i, train=False, rng=None)
+        hidden = layer_norm(x, params["ln_f_scale"], params["ln_f_bias"], c.eps)
+        return self.logits(params, hidden), \
+            {"k": kc, "v": vc, "index": idx + input_ids.shape[1]}

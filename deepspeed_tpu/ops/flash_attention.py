@@ -16,6 +16,11 @@ whole blocks above the diagonal via ``pl.when``. The backward pass is the
 standard two-kernel FA2 recomputation (dq; dk/dv) using the saved
 log-sum-exp rows, with the same grid structure. Matmuls run in the storage
 dtype (bf16 on the training path — full MXU rate) with f32 accumulation.
+Precision note: the P·V, dS·K, P^T·dO and dS^T·Q products therefore see
+their p/ds operand ROUNDED to the storage dtype before the MXU — the
+standard FA2-on-bf16 tradeoff, but a change vs all-f32 operands; set
+``DSTPU_FLASH_F32_PRECISE=1`` to keep those operands in f32 (half MXU
+rate) for tolerance-sensitive runs.
 Known tradeoff: causally-masked grid steps skip COMPUTE via ``pl.when`` but
 still fetch their K/V tiles (Pallas grids are rectangular) — ~2x the K/V
 bandwidth of a bounded walk on the causal path; measured wins at seq
@@ -54,6 +59,17 @@ def _dot_f32(a, b, dims):
                                preferred_element_type=jnp.float32)
 
 
+def _mm_dtype(storage_dtype):
+    """Dtype for the computed p/ds operands of the second-stage matmuls:
+    the storage dtype (full MXU rate) unless DSTPU_FLASH_F32_PRECISE=1
+    opts back into all-f32 operands (see module docstring)."""
+    import os
+
+    if os.environ.get("DSTPU_FLASH_F32_PRECISE") == "1":
+        return jnp.float32
+    return storage_dtype
+
+
 def _causal_mask(s, qi, kj, block_q, block_k):
     bq, bk = s.shape
     q_pos = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
@@ -90,7 +106,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref, l_ref, acc_ref, *,
         corr = jnp.exp(m_prev - m_new)
         l_ref[...] = (l_ref[...][:, 0] * corr + p.sum(axis=-1))[:, None]
         acc_ref[...] = acc_ref[...] * corr[:, None] + \
-            _dot_f32(p.astype(v.dtype), v, ((1,), (0,)))
+            _dot_f32(p.astype(_mm_dtype(v.dtype)), v, ((1,), (0,)))
         m_ref[...] = m_new[:, None]
 
     @pl.when(kj == nk - 1)
@@ -128,7 +144,7 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
         p = jnp.exp(s - lse[:, None])
         dp = _dot_f32(do, v, ((1,), (1,)))
         ds = p * (dp - delta[:, None])
-        dq_acc_ref[...] += _dot_f32(ds.astype(k.dtype), k, ((1,), (0,)))
+        dq_acc_ref[...] += _dot_f32(ds.astype(_mm_dtype(k.dtype)), k, ((1,), (0,)))
 
     @pl.when(kj == nk - 1)
     def _finish():
@@ -161,10 +177,10 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         if causal:
             s = _causal_mask(s, qi, kj, block_q, block_k)
         p = jnp.exp(s - lse[:, None])
-        dv_acc_ref[...] += _dot_f32(p.astype(do.dtype), do, ((0,), (0,)))
+        dv_acc_ref[...] += _dot_f32(p.astype(_mm_dtype(do.dtype)), do, ((0,), (0,)))
         dp = _dot_f32(do, v, ((1,), (1,)))
         ds = p * (dp - delta[:, None])
-        dk_acc_ref[...] += _dot_f32(ds.astype(q.dtype), q, ((0,), (0,)))
+        dk_acc_ref[...] += _dot_f32(ds.astype(_mm_dtype(q.dtype)), q, ((0,), (0,)))
 
     @pl.when(qi == nq - 1)
     def _finish():
@@ -188,6 +204,20 @@ def _pick_block(t: int, pref: int) -> int:
     while t % blk:
         blk //= 2
     return max(blk, 1)
+
+
+@functools.lru_cache(maxsize=1)
+def vma_typing_supported() -> bool:
+    """True when this JAX carries shard_map varying-axis (vma) typing
+    (aval ``.vma`` + ``ShapeDtypeStruct(vma=...)``). On versions predating
+    it, ``_sds``'s getattr silently finds no vma, so strict-checked
+    shard_map would reject pallas_call outputs opaquely — callers
+    (ops/ring_attention.py) use this to fall back to check_vma=False."""
+    try:
+        jax.ShapeDtypeStruct((1,), jnp.float32, vma=frozenset())
+        return hasattr(jax.typeof(jnp.zeros(())), "vma")
+    except TypeError:
+        return False
 
 
 def _sds(*operands_then_args):

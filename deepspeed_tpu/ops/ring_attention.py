@@ -37,6 +37,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
+from deepspeed_tpu.ops.flash_attention import vma_typing_supported
 from deepspeed_tpu.parallel.topology import SEQ_AXIS
 
 # true -inf (not finfo.min): fully-masked blocks must zero out in the online
@@ -187,7 +188,7 @@ def _ring_flash_fwd(q, k, v, mesh, causal, axis, scale=None):
         return out.astype(ql.dtype), lse_run
 
     spec = P(None, axis)
-    check = jax.default_backend() == "tpu"
+    check = jax.default_backend() == "tpu" and vma_typing_supported()
     out, lse = jax.shard_map(
         local, mesh=mesh, in_specs=(spec, spec, spec),
         out_specs=(spec, P(None, axis, None)), axis_names={axis},
@@ -264,7 +265,7 @@ def _ring_flash_bwd(mesh, causal, axis, scale, res, g):
                 unflat(dv_acc).astype(vl.dtype))
 
     spec = P(None, axis)
-    check = jax.default_backend() == "tpu"
+    check = jax.default_backend() == "tpu" and vma_typing_supported()
     dq, dk, dv = jax.shard_map(
         local2, mesh=mesh,
         in_specs=(spec, spec, spec, spec, P(None, axis, None),
@@ -331,7 +332,8 @@ def ulysses_attention(
     # checking — that's what flash_attention._sds's vma plumbing is for.
     from deepspeed_tpu.ops.flash_attention import _interpret_default
 
-    strict = inner != "flash" or not _interpret_default()
+    strict = (inner != "flash" or not _interpret_default()) and \
+        vma_typing_supported()
     return jax.shard_map(local, mesh=mesh, in_specs=(spec, spec, spec),
                          out_specs=spec, axis_names={axis},
                          check_vma=strict)(q, k, v)

@@ -157,9 +157,22 @@ class DeepSpeedHybridEngine(DeepSpeedEngine):
         # reshard — same-topology refreshes assign directly and let the
         # compiled program place them at dispatch.
         params = self._eval_params()
-        inf.params = params if inf.topology is self.topology \
-            else inf._shard_and_cast(params)
-        out = inf.generate(input_ids, **kwargs)
+        resized = inf.topology is not self.topology
+        inf.params = inf._shard_and_cast(params) if resized else params
+        # generate() traces lazily: any decode path that consults the global
+        # topology at trace time (attn_impl ring/ring_flash/ulysses reads
+        # groups.get_mesh()) must capture the GENERATION mesh while the
+        # params live on it — swap it in around the call (ADVICE r2)
+        if resized:
+            from deepspeed_tpu.utils import groups as groups_mod
+
+            groups_mod.initialize(inf.topology)
+            try:
+                out = inf.generate(input_ids, **kwargs)
+            finally:
+                groups_mod.initialize(self.topology)
+        else:
+            out = inf.generate(input_ids, **kwargs)
         self.generate_calls += 1
         self.generate_latency_s += time.perf_counter() - t0
         self.generated_tokens += out.shape[0] * (

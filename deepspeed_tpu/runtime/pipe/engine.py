@@ -279,6 +279,7 @@ class PipelineEngine(DeepSpeedEngine):
                 f"pipeline.executor must be 'spmd' or 'host_1f1b', "
                 f"got {self._exec_mode!r}")
         self._executor_1f1b = None
+        self._executor_1f1b_eval = {}  # M → executor (eval_batch sizes)
         self._1f1b_cast = None
         self._1f1b_apply = None
         self.last_1f1b_stats = None
@@ -392,10 +393,15 @@ class PipelineEngine(DeepSpeedEngine):
             M = jax.tree_util.tree_leaves(batch)[0].shape[0]
             ex = self._executor_1f1b
             if M != ex.M:
-                from deepspeed_tpu.runtime.pipe.executor import (
-                    Schedule1F1BExecutor)
+                # cache per-M executors: a fresh one per call would re-jit
+                # its stage functions on every eval_batch
+                if M not in self._executor_1f1b_eval:
+                    from deepspeed_tpu.runtime.pipe.executor import (
+                        Schedule1F1BExecutor)
 
-                ex = Schedule1F1BExecutor(self._executor_1f1b.adapter, M)
+                    self._executor_1f1b_eval[M] = Schedule1F1BExecutor(
+                        self._executor_1f1b.adapter, M)
+                ex = self._executor_1f1b_eval[M]
             return ex.eval_batch(self._1f1b_cast(self.state.params), batch)
         if self._compiled_eval is None:
             def ev(params, batch):

@@ -23,6 +23,7 @@ fails). Results land in CHECKPOINT_PARITY.json at the repo root.
 from __future__ import annotations
 
 import argparse
+import functools
 import json
 import os
 import sys
@@ -40,6 +41,59 @@ def _record(name, status, detail=""):
     print(f"[{status}] {name}: {detail}")
 
 
+@functools.lru_cache(maxsize=1)
+def _hf_cache_dirs():
+    """Every place weights could already live on this machine: HF env-var
+    caches, the default hub cache, and vendored-weights directories."""
+    dirs = []
+    for env in ("HF_HOME", "TRANSFORMERS_CACHE", "HF_HUB_CACHE"):
+        v = os.environ.get(env)
+        if v:
+            dirs += [v, os.path.join(v, "hub")]
+    dirs += [os.path.expanduser("~/.cache/huggingface/hub"),
+             "/root/weights", "/opt/weights", os.path.join(_REPO, "weights")]
+    return [d for d in dict.fromkeys(dirs) if os.path.isdir(d)]
+
+
+@functools.lru_cache(maxsize=1)
+def _discover_local_snapshots():
+    """(model_name, path) for every locally cached (hub layout) or vendored
+    (flat directory with config.json) HF model — probed BEFORE declaring
+    any check SKIPPED, so a populated cache is used even offline."""
+    found = []
+    for root in _hf_cache_dirs():
+        for entry in sorted(os.listdir(root)):
+            p = os.path.join(root, entry)
+            if entry.startswith("models--") and os.path.isdir(
+                    os.path.join(p, "snapshots")):
+                snaps = os.path.join(p, "snapshots")
+                for rev in sorted(os.listdir(snaps)):
+                    sp = os.path.join(snaps, rev)
+                    if os.path.exists(os.path.join(sp, "config.json")):
+                        found.append(
+                            (entry[len("models--"):].replace("--", "/"), sp))
+                        break
+            elif os.path.isdir(p) and os.path.exists(
+                    os.path.join(p, "config.json")):
+                found.append((entry, p))
+    return found
+
+
+def _load_hf(model_id: str, cls):
+    """Try the local cache/vendored snapshots first, then the network."""
+    try:
+        return cls.from_pretrained(model_id, local_files_only=True), "local"
+    except Exception:
+        pass
+    for name, path in _discover_local_snapshots():
+        if name == model_id or name.endswith("/" + model_id):
+            try:
+                return cls.from_pretrained(path), f"vendored:{path}"
+            except Exception:
+                continue
+    return cls.from_pretrained(model_id), "network"
+
+
 def check_causal_lm(model_id: str, name: str, prompt_len: int = 16):
     try:
         import torch
@@ -47,9 +101,13 @@ def check_causal_lm(model_id: str, name: str, prompt_len: int = 16):
     except ImportError as e:
         return _record(name, "SKIPPED", f"missing lib: {e}")
     try:
-        hf = transformers.AutoModelForCausalLM.from_pretrained(model_id)
+        hf, source = _load_hf(model_id, transformers.AutoModelForCausalLM)
+        print(f"  ({name}: weights from {source})")
     except Exception as e:
-        return _record(name, "SKIPPED", f"weights unavailable: {e}")
+        return _record(
+            name, "SKIPPED",
+            f"weights unavailable locally ({len(_hf_cache_dirs())} cache "
+            f"dirs probed) and no network: {e}")
     hf = hf.eval()
     import deepspeed_tpu
 
@@ -87,7 +145,8 @@ def check_stable_diffusion(model_id: str):
     try:
         from diffusers import StableDiffusionPipeline
 
-        pipe = StableDiffusionPipeline.from_pretrained(model_id)
+        pipe, source = _load_hf(model_id, StableDiffusionPipeline)
+        print(f"  ({name}: weights from {source})")
     except Exception as e:
         return _record(name, "SKIPPED", f"weights unavailable: {e}")
     import jax.numpy as jnp
@@ -140,6 +199,26 @@ def main():
     if args.sd:
         check_stable_diffusion(args.sd)
 
+    # any OTHER locally cached/vendored causal LM is free parity evidence —
+    # verify everything the machine already has
+    checked = {args.gpt2, args.llama}
+    for model_name, path in _discover_local_snapshots():
+        if model_name in checked or any(model_name in str(v) for v in RESULTS):
+            continue
+        try:
+            with open(os.path.join(path, "config.json")) as f:
+                archs = json.load(f).get("architectures") or []
+        except Exception:
+            continue
+        if any(a.endswith("ForCausalLM") for a in archs):
+            checked.add(model_name)
+            check_causal_lm(path, f"local:{model_name}")
+
+    RESULTS["_probe"] = {
+        "status": "INFO",
+        "detail": f"cache dirs probed: {_hf_cache_dirs()}; "
+                  f"snapshots found: "
+                  f"{[n for n, _ in _discover_local_snapshots()]}"}
     with open(os.path.join(_REPO, "CHECKPOINT_PARITY.json"), "w") as f:
         json.dump(RESULTS, f, indent=1)
     failed = [k for k, v in RESULTS.items() if v["status"] == "FAILED"]

@@ -105,5 +105,5 @@ def test_1f1b_eval_batch_inference_schedule():
 def test_1f1b_rejects_unknown_executor():
     from deepspeed_tpu.runtime.pipe.engine import PipelineError
 
-    with pytest.raises((PipelineError, Exception)):
+    with pytest.raises(PipelineError, match="pipeline.executor"):
         run_pipe_training(pp=2, steps=1, executor="bogus")
