@@ -110,8 +110,19 @@ class InferenceEngine:
         if params is None and config.checkpoint is not None:
             params = self._load_checkpoint_params(config.checkpoint)
         if params is None:
-            params = jax.jit(model.init)(jax.random.PRNGKey(config.seed))
+            # cast fused INTO the jitted init: XLA folds the astype into the
+            # elementwise RNG sampling, so only serving-dtype params ever
+            # materialize — initializing a 7B model in f32 and casting after
+            # would transiently need 2x the weight HBM (27 GB at 6.7B)
+            def _init_cast(key):
+                return jax.tree_util.tree_map(
+                    lambda x: x.astype(self.dtype)
+                    if x.dtype == jnp.float32 else x, model.init(key))
+
+            params = jax.jit(_init_cast)(jax.random.PRNGKey(config.seed))
         self.params = self._shard_and_cast(params)
+        params = None  # drop the caller-scope tree: the quantize walk below
+        # frees each bf16 leaf as its int8 replacement is built
         if self.weight_quant and not getattr(self.module,
                                              "supports_weight_quant", False):
             # an explicit int8 request that cannot be honored must fail
@@ -165,11 +176,15 @@ class InferenceEngine:
             nonlocal count
             if isinstance(tree, dict):
                 out = {}
-                for k, v in tree.items():
+                for k, v in list(tree.items()):
                     if in_blocks and hasattr(v, "ndim") and v.ndim == 3 and \
                             v.dtype in (jnp.float32, jnp.bfloat16,
                                         jnp.float16) and min(v.shape[1:]) >= 16:
                         out[k] = q(v)
+                        # consume the source leaf: at 7B scale holding the
+                        # full bf16 tree alongside the int8 one would peak
+                        # at ~3x the quantized footprint
+                        tree[k] = None
                         count += 1
                     else:
                         out[k] = walk(v, in_blocks or k == "blocks")
