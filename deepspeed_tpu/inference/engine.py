@@ -68,7 +68,7 @@ class InferenceEngine:
         # int8 = weight-only quantization (reference GroupQuantizer path,
         # module_inject/replace_module.py:140): HBM holds int8 weights +
         # per-column scales, compute runs in bf16 on per-layer dequantized
-        # tiles (see models/base.dequant_block)
+        # tiles (see models/base.qdot)
         self.weight_quant = bool(config.quant.enabled)
         if self.dtype == jnp.int8:
             self.weight_quant = True
@@ -109,20 +109,6 @@ class InferenceEngine:
         # ---- parameters: explicit > checkpoint > fresh init
         if params is None and config.checkpoint is not None:
             params = self._load_checkpoint_params(config.checkpoint)
-        if params is None:
-            # cast fused INTO the jitted init: XLA folds the astype into the
-            # elementwise RNG sampling, so only serving-dtype params ever
-            # materialize — initializing a 7B model in f32 and casting after
-            # would transiently need 2x the weight HBM (27 GB at 6.7B)
-            def _init_cast(key):
-                return jax.tree_util.tree_map(
-                    lambda x: x.astype(self.dtype)
-                    if x.dtype == jnp.float32 else x, model.init(key))
-
-            params = jax.jit(_init_cast)(jax.random.PRNGKey(config.seed))
-        self.params = self._shard_and_cast(params)
-        params = None  # drop the caller-scope tree: the quantize walk below
-        # frees each bf16 leaf as its int8 replacement is built
         if self.weight_quant and not getattr(self.module,
                                              "supports_weight_quant", False):
             # an explicit int8 request that cannot be honored must fail
@@ -131,13 +117,38 @@ class InferenceEngine:
             raise ValueError(
                 f"int8 weight quantization requested but "
                 f"{type(self.module).__name__} does not support dequant "
-                "blocks (models must call models/base.dequant_block in "
+                "blocks (models must route weight matmuls through models/base.qdot in "
                 "their block scan and set supports_weight_quant = True)")
-        if self.weight_quant:
-            self.params, n_q = self._quantize_block_weights(self.params)
-            log_dist(f"weight-only int8: quantized {n_q} block weight "
-                     "tensors (per-layer, per-output-column scales)",
+        if (params is None and self.weight_quant
+                and config.tp_size == 1 and config.ep_size == 1):
+            # stream-init: each quantizable block leaf is initialized AND
+            # quantized in its own fused program (XLA DCE reduces the jitted
+            # init to just that leaf), so the full serving-dtype tree never
+            # materializes — HBM peak is the int8 tree + ONE bf16 leaf
+            # (~9.4 GB at 6.7B vs ~20 GB init-then-quantize). Values are
+            # bit-identical to the one-shot init.
+            self.params, n_q = self._stream_init_quantized(
+                jax.random.PRNGKey(config.seed))
+            log_dist(f"weight-only int8: stream-initialized {n_q} block "
+                     "weight tensors (per-layer, per-output-column scales)",
                      ranks=[0])
+        else:
+            if params is None:
+                # cast fused INTO the jitted init: XLA folds the astype into
+                # the elementwise RNG sampling, so only serving-dtype params
+                # ever materialize — initializing a 7B model in f32 and
+                # casting after would transiently need 2x the weight HBM
+                # (27 GB at 6.7B)
+                params = jax.jit(self._init_cast)(
+                    jax.random.PRNGKey(config.seed))
+            self.params = self._shard_and_cast(params)
+            params = None  # drop the caller-scope tree: the quantize walk
+            # below frees each bf16 leaf as its int8 replacement is built
+            if self.weight_quant:
+                self.params, n_q = self._quantize_block_weights(self.params)
+                log_dist(f"weight-only int8: quantized {n_q} block weight "
+                         "tensors (per-layer, per-output-column scales)",
+                         ranks=[0])
 
         self._compiled: Dict[Tuple, Any] = {}
         self._gen_rng = jax.random.PRNGKey(config.seed)
@@ -146,6 +157,77 @@ class InferenceEngine:
             f"ep={config.ep_size} max_tokens={config.max_tokens}", ranks=[0])
 
     # ----------------------------------------------------------------- params
+    def _init_cast(self, key):
+        """Fresh init with the serving-dtype cast fused into the jitted
+        program (XLA folds the astype into the RNG sampling)."""
+        return jax.tree_util.tree_map(
+            lambda x: x.astype(self.dtype)
+            if x.dtype == jnp.float32 else x, self.module.init(key))
+
+    @staticmethod
+    def _is_quantizable(leaf, in_blocks: bool) -> bool:
+        """Same predicate as _quantize_block_weights: stacked [L, in, out]
+        float matmul weights under a 'blocks' subtree."""
+        return (in_blocks and hasattr(leaf, "ndim") and leaf.ndim == 3
+                and leaf.dtype in (jnp.float32, jnp.bfloat16, jnp.float16)
+                and min(leaf.shape[1:]) >= 16)
+
+    def _stream_init_quantized(self, key):
+        """Random-init int8 serving without ever materializing the full
+        serving-dtype tree: each quantizable block leaf gets its own fused
+        jitted program (init -> take leaf -> quantize) — XLA dead-code
+        eliminates every other leaf's sampling, so the program's footprint
+        is ONE bf16 leaf + its int8 image. Peak HBM = int8 tree + largest
+        bf16 leaf (~9.4 GB at 6.7B) instead of full-bf16 + int8 (~20 GB),
+        which is the difference between fitting and OOMing a 16 GB chip.
+        Values are bit-identical to the one-shot init + quantize path
+        (single-mesh tp=1/ep=1 only; larger meshes take the sharded
+        two-phase path). Reference sizing analog: the deployment-sized
+        GroupQuantizer load in module_inject/replace_module.py:140."""
+        from deepspeed_tpu.compression.quantize import quantize_int8
+
+        shapes = jax.eval_shape(self._init_cast, key)
+
+        def find_qpaths(tree, in_blocks=False, prefix=()):
+            out = []
+            if isinstance(tree, dict):
+                for k, v in tree.items():
+                    if self._is_quantizable(v, in_blocks):
+                        out.append(prefix + (k,))
+                    else:
+                        out.extend(find_qpaths(v, in_blocks or k == "blocks",
+                                               prefix + (k,)))
+            return out
+
+        def get(tree, path):
+            for k in path:
+                tree = tree[k]
+            return tree
+
+        qpaths = find_qpaths(shapes)
+        quantized = {}
+        for path in qpaths:
+            def leaf_q(key, _path=path):
+                leaf = get(self._init_cast(key), _path)
+                qv, scale = jax.vmap(
+                    lambda w: quantize_int8(w, per_channel_axis=1))(leaf)
+                return {"__q__": qv, "__scale__": scale}
+
+            # block per leaf: overlapping two leaf programs would double the
+            # transient bf16 footprint this path exists to avoid
+            quantized[path] = jax.block_until_ready(jax.jit(leaf_q)(key))
+
+        def rest(key):
+            tree = self._init_cast(key)
+            for path in qpaths:
+                del get(tree, path[:-1])[path[-1]]
+            return tree
+
+        params = jax.jit(rest)(key)
+        for path, qleaf in quantized.items():
+            get(params, path[:-1])[path[-1]] = qleaf
+        return params, len(qpaths)
+
     def _shard_and_cast(self, params):
         specs = self.plan.compute_specs(
             jax.eval_shape(lambda: params), self.logical_axes)
@@ -177,14 +259,18 @@ class InferenceEngine:
             if isinstance(tree, dict):
                 out = {}
                 for k, v in list(tree.items()):
-                    if in_blocks and hasattr(v, "ndim") and v.ndim == 3 and \
-                            v.dtype in (jnp.float32, jnp.bfloat16,
-                                        jnp.float16) and min(v.shape[1:]) >= 16:
-                        out[k] = q(v)
-                        # consume the source leaf: at 7B scale holding the
-                        # full bf16 tree alongside the int8 one would peak
-                        # at ~3x the quantized footprint
-                        tree[k] = None
+                    if self._is_quantizable(v, in_blocks):
+                        # consume the source leaf BEFORE quantizing: at 7B
+                        # scale holding the full bf16 tree alongside the
+                        # int8 one would peak at ~3x the quantized
+                        # footprint. Mutating `tree` is safe only because
+                        # _shard_and_cast always returns fresh dict
+                        # containers (never caller-owned ones); a failure
+                        # mid-walk leaves the source tree with popped keys,
+                        # and the caller must not reuse it.
+                        leaf = tree.pop(k)
+                        out[k] = q(leaf)
+                        del leaf
                         count += 1
                     else:
                         out[k] = walk(v, in_blocks or k == "blocks")
@@ -291,7 +377,23 @@ class InferenceEngine:
 
     def _build_generate(self, b, t, max_new, *, do_sample, top_k, top_p,
                         eos_token_id, pad_token_id):
+        """Two compiled programs — prefill (builds the cache, picks token 0)
+        and decode (the token loop) — composed by a host-side driver.
+
+        Why not one fused program: a single XLA program carrying BOTH the
+        prefill graph and the decode loop over the full weight tree fails
+        with ResourceExhausted on large models on this backend even though
+        its compiled peak memory fits (measured at 6.7B int8: prefill-only
+        and decode-only each run fine; the fusion of the two does not).
+        Both programs still recompile per prompt length (the KV cache is
+        shaped [*, t + max_new, *], so `total` is in both cache keys) —
+        the split's benefit is the ResourceExhausted fix plus smaller
+        individual executables. It mirrors the split the reference's
+        inference engine makes between its prompt and token phases
+        (csrc/transformer/inference pt_binding.cpp allocate_workspace
+        prompt/token paths)."""
         model = self.module
+        total = t + max_new
 
         def pick(logits, temp, rng):
             logits = logits.astype(jnp.float32)
@@ -300,61 +402,94 @@ class InferenceEngine:
             logits = filter_logits(logits / temp, top_k=top_k, top_p=top_p)
             return jax.random.categorical(rng, logits, axis=-1).astype(jnp.int32)
 
+        pf_key = ("pf", b, t, total, do_sample, top_k, top_p)
+        if pf_key not in self._compiled:
+            def prefill(params, ids, temp, rng):
+                cache = model.init_cache(b, total, dtype=self.dtype)
+                logits, cache = model.forward_with_cache(params, ids, cache)
+                rng, sub = jax.random.split(rng)
+                return pick(logits[:, -1], temp, sub), cache, rng
+
+            self._compiled[pf_key] = jax.jit(prefill)
+        prefill_fn = self._compiled[pf_key]
+
+        if eos_token_id is None:
+            dec_key = ("dec", b, total, max_new, do_sample, top_k, top_p)
+            if dec_key not in self._compiled:
+                def decode(params, tok, cache, temp, rng):
+                    def step(carry, _):
+                        tok, cache, rng = carry
+                        logits, cache = model.forward_with_cache(
+                            params, tok[:, None], cache)
+                        rng, sub = jax.random.split(rng)
+                        nxt = pick(logits[:, -1], temp, sub)
+                        return (nxt, cache, rng), tok
+
+                    (last, _, _), toks = jax.lax.scan(
+                        step, (tok, cache, rng), None, length=max_new - 1)
+                    return jnp.concatenate([toks.T, last[:, None]], axis=1)
+
+                # donate the cache: the decode loop must not double-buffer
+                # the [L,B,H,S,Dh] KV tensors at 7B scale
+                self._compiled[dec_key] = jax.jit(decode, donate_argnums=(2,))
+            decode_fn = self._compiled[dec_key]
+
+            def gen(params, ids, temp, rng):
+                tok, cache, rng = prefill_fn(params, ids, temp, rng)
+                if max_new == 1:
+                    return tok[:, None]
+                return decode_fn(params, tok, cache, temp, rng)
+
+            return gen
+
+        # EOS path: while_loop exits once every row has EMITTED its eos
+        # (prev_done); pending-but-unwritten eos keeps the loop alive one
+        # more tick so it lands in the buffer.
+        dec_key = ("dec_eos", b, total, max_new, do_sample, top_k, top_p,
+                   eos_token_id, pad_token_id)
+        if dec_key not in self._compiled:
+            def decode_eos(params, tok, cache, temp, rng):
+                done = tok == eos_token_id
+                buf = jnp.full((max_new, b), pad_token_id, jnp.int32)
+
+                def cond(carry):
+                    i, *_rest, prev_done, _buf = carry
+                    return (i < max_new) & ~jnp.all(prev_done)
+
+                def body(carry):
+                    i, tok, cache, rng, done, prev_done, buf = carry
+                    buf = buf.at[i].set(tok)
+
+                    def do_step(args):
+                        tok, cache, rng = args
+                        logits, cache = model.forward_with_cache(
+                            params, tok[:, None], cache)
+                        rng, sub = jax.random.split(rng)
+                        nxt = pick(logits[:, -1], temp, sub)
+                        return jnp.where(done, pad_token_id, nxt), cache, rng
+
+                    # skip the decode forward when this was the last token
+                    # to emit (parity with the scan path's max_new - 1
+                    # forwards)
+                    need = (i + 1 < max_new) & ~jnp.all(done)
+                    nxt, cache, rng = jax.lax.cond(
+                        need, do_step, lambda args: args, (tok, cache, rng))
+                    return (i + 1, nxt, cache, rng,
+                            done | (nxt == eos_token_id), done, buf)
+
+                prev_done = jnp.zeros((b,), bool)
+                *_state, buf = jax.lax.while_loop(
+                    cond, body, (0, tok, cache, rng, done, prev_done, buf))
+                return buf.T
+
+            self._compiled[dec_key] = jax.jit(decode_eos, donate_argnums=(2,))
+        decode_eos_fn = self._compiled[dec_key]
+
         def gen(params, ids, temp, rng):
-            cache = model.init_cache(b, t + max_new, dtype=self.dtype)
-            logits, cache = model.forward_with_cache(params, ids, cache)
-            rng, sub = jax.random.split(rng)
-            tok = pick(logits[:, -1], temp, sub)
+            tok, cache, rng = prefill_fn(params, ids, temp, rng)
+            return decode_eos_fn(params, tok, cache, temp, rng)
 
-            if eos_token_id is None:
-                def step(carry, _):
-                    tok, cache, rng = carry
-                    logits, cache = model.forward_with_cache(
-                        params, tok[:, None], cache)
-                    rng, sub = jax.random.split(rng)
-                    nxt = pick(logits[:, -1], temp, sub)
-                    return (nxt, cache, rng), tok
-
-                (last, _, _), toks = jax.lax.scan(
-                    step, (tok, cache, rng), None, length=max_new - 1)
-                return jnp.concatenate([toks.T, last[:, None]], axis=1)
-
-            # EOS path: while_loop exits once every row has EMITTED its eos
-            # (prev_done); pending-but-unwritten eos keeps the loop alive one
-            # more tick so it lands in the buffer.
-            done = tok == eos_token_id
-            buf = jnp.full((max_new, b), pad_token_id, jnp.int32)
-
-            def cond(carry):
-                i, *_rest, prev_done, _buf = carry
-                return (i < max_new) & ~jnp.all(prev_done)
-
-            def body(carry):
-                i, tok, cache, rng, done, prev_done, buf = carry
-                buf = buf.at[i].set(tok)
-
-                def do_step(args):
-                    tok, cache, rng = args
-                    logits, cache = model.forward_with_cache(
-                        params, tok[:, None], cache)
-                    rng, sub = jax.random.split(rng)
-                    nxt = pick(logits[:, -1], temp, sub)
-                    return jnp.where(done, pad_token_id, nxt), cache, rng
-
-                # skip the decode forward when this was the last token to
-                # emit (parity with the scan path's max_new - 1 forwards)
-                need = (i + 1 < max_new) & ~jnp.all(done)
-                nxt, cache, rng = jax.lax.cond(
-                    need, do_step, lambda args: args, (tok, cache, rng))
-                return (i + 1, nxt, cache, rng,
-                        done | (nxt == eos_token_id), done, buf)
-
-            prev_done = jnp.zeros((b,), bool)
-            *_state, buf = jax.lax.while_loop(
-                cond, body, (0, tok, cache, rng, done, prev_done, buf))
-            return buf.T
-
-        return jax.jit(gen)
+        return gen
 
     # ------------------------------------------------------------- utilities
     @property

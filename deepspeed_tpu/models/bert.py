@@ -21,7 +21,7 @@ from typing import Any, Dict, Optional
 import jax
 import jax.numpy as jnp
 
-from deepspeed_tpu.models.base import cross_entropy_loss, dequant_block, layer_norm
+from deepspeed_tpu.models.base import cross_entropy_loss, layer_norm, qdot
 from deepspeed_tpu.ops.attention import multihead_attention
 
 _ACTS = {
@@ -77,7 +77,7 @@ class BertConfig:
 class BertModel:
     """Encoder ModelSpec with MLM ("mlm") or classification ("cls") head."""
 
-    supports_weight_quant = True   # blocks call dequant_block
+    supports_weight_quant = True   # weight matmuls go through base.qdot
 
     def __init__(self, config: BertConfig, compute_dtype=jnp.bfloat16,
                  head: str = "mlm", remat: bool = False):
@@ -166,25 +166,22 @@ class BertModel:
 
     # ------------------------------------------------------------------ block
     def _block(self, x, blk, mask_bias):
-        blk = dequant_block(blk, x.dtype)
         c = self.config
         b, t, d = x.shape
         h, dh = c.num_heads, c.head_dim
-        qkv = jnp.einsum("btd,de->bte", x, blk["qkv_w"].astype(x.dtype)) + \
+        # qdot: int8 weights stream into the matmul, scale on the output
+        qkv = qdot("btd,de->bte", x, blk["qkv_w"]) + \
             blk["qkv_b"].astype(x.dtype)
         q, k_, v_ = (z.reshape(b, t, h, dh) for z in jnp.split(qkv, 3, -1))
         attn = multihead_attention(q, k_, v_, causal=False, mask=mask_bias)
         attn = attn.reshape(b, t, d)
-        a_out = jnp.einsum("btd,de->bte", attn,
-                           blk["attn_out_w"].astype(x.dtype)) + \
+        a_out = qdot("btd,de->bte", attn, blk["attn_out_w"]) + \
             blk["attn_out_b"].astype(x.dtype)
         x = layer_norm(x + a_out, blk["attn_ln_scale"], blk["attn_ln_bias"],
                        c.eps)                                  # post-LN
-        mid = self._act(jnp.einsum("btd,dm->btm", x,
-                                   blk["mlp_fc_w"].astype(x.dtype)) +
+        mid = self._act(qdot("btd,dm->btm", x, blk["mlp_fc_w"]) +
                         blk["mlp_fc_b"].astype(x.dtype))
-        m_out = jnp.einsum("btm,md->btd", mid,
-                           blk["mlp_out_w"].astype(x.dtype)) + \
+        m_out = qdot("btm,md->btd", mid, blk["mlp_out_w"]) + \
             blk["mlp_out_b"].astype(x.dtype)
         return layer_norm(x + m_out, blk["mlp_ln_scale"], blk["mlp_ln_bias"],
                           c.eps)

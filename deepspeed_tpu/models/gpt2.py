@@ -20,7 +20,7 @@ from typing import Any, Dict, Optional
 import jax
 import jax.numpy as jnp
 
-from deepspeed_tpu.models.base import ATTN_IMPLS, cross_entropy_loss, dequant_block, gelu, layer_norm, sp_attention
+from deepspeed_tpu.models.base import ATTN_IMPLS, cross_entropy_loss, gelu, layer_norm, qdot, sp_attention
 from deepspeed_tpu.ops.attention import decode_attention, multihead_attention, write_kv_cache
 
 
@@ -70,7 +70,7 @@ class GPT2Config:
 class GPT2Model:
     """Causal-LM ModelSpec. batch = {"input_ids": [B,T] int32, "labels": [B,T]}."""
 
-    supports_weight_quant = True   # blocks call dequant_block
+    supports_weight_quant = True   # weight matmuls go through base.qdot
 
     def __init__(self, config: GPT2Config, compute_dtype=jnp.bfloat16,
                  remat: bool = False, remat_policy: Optional[str] = None,
@@ -153,12 +153,13 @@ class GPT2Model:
         only the new token's slice is written (in place, as a loop-carry
         dynamic update) — never the whole cache (see
         ops/attention.decode_attention)."""
-        blk = dequant_block(blk, x.dtype)
         c = self.config
         b, t, d = x.shape
         h, dh = c.num_heads, c.head_dim
         y = layer_norm(x, blk["ln1_scale"], blk["ln1_bias"], c.eps)
-        qkv = jnp.einsum("btd,de->bte", y, blk["qkv_w"].astype(y.dtype)) + \
+        # qdot streams int8 weights straight into the matmul (scale folded
+        # into the output) — no dequantized bf16 tiles in HBM
+        qkv = qdot("btd,de->bte", y, blk["qkv_w"]) + \
             blk["qkv_b"].astype(y.dtype)
         q, k_, v_ = jnp.split(qkv, 3, axis=-1)
         q = q.reshape(b, t, h, dh)
@@ -180,12 +181,12 @@ class GPT2Model:
             kc, vc, kl, vl = write_kv_cache(kc, vc, k_, v_, layer, idx)
             attn = decode_attention(q, kl, vl, idx)
         attn = attn.reshape(b, t, d)
-        x = x + jnp.einsum("btd,de->bte", attn, blk["attn_out_w"].astype(x.dtype)) + \
+        x = x + qdot("btd,de->bte", attn, blk["attn_out_w"]) + \
             blk["attn_out_b"].astype(x.dtype)
         y = layer_norm(x, blk["ln2_scale"], blk["ln2_bias"], c.eps)
-        hmid = gelu(jnp.einsum("btd,dm->btm", y, blk["mlp_fc_w"].astype(y.dtype)) +
+        hmid = gelu(qdot("btd,dm->btm", y, blk["mlp_fc_w"]) +
                     blk["mlp_fc_b"].astype(y.dtype))
-        x = x + jnp.einsum("btm,md->btd", hmid, blk["mlp_out_w"].astype(x.dtype)) + \
+        x = x + qdot("btm,md->btd", hmid, blk["mlp_out_w"]) + \
             blk["mlp_out_b"].astype(x.dtype)
         return x, kc, vc
 

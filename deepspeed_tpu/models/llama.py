@@ -14,7 +14,7 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 
-from deepspeed_tpu.models.base import ATTN_IMPLS, cross_entropy_loss, dequant_block, rms_norm, sp_attention  # noqa: E501
+from deepspeed_tpu.models.base import ATTN_IMPLS, cross_entropy_loss, qdot, rms_norm, sp_attention  # noqa: E501
 from deepspeed_tpu.ops.attention import decode_attention, multihead_attention, write_kv_cache
 from deepspeed_tpu.ops.rotary import apply_rotary_pos_emb, rope_frequencies
 
@@ -63,7 +63,7 @@ class LlamaConfig:
 class LlamaModel:
     """Causal-LM ModelSpec: batch = {"input_ids": [B,T], "labels": [B,T]}."""
 
-    supports_weight_quant = True   # blocks call dequant_block
+    supports_weight_quant = True   # weight matmuls go through base.qdot
 
     def __init__(self, config: LlamaConfig, compute_dtype=jnp.bfloat16,
                  remat: bool = False, remat_policy: Optional[str] = None,
@@ -125,15 +125,16 @@ class LlamaModel:
         train + serving). Only the new token's slice of the full stacked
         head-major [L, B, Hkv, S, Dh] cache is written — see
         ops/attention.decode_attention."""
-        blk = dequant_block(blk, x.dtype)
         c = self.config
         b, t, d = x.shape
         hq, hkv, dh = c.num_heads, c.num_kv_heads, c.head_dim
         idx = cache[3] if cache is not None else 0
         y = rms_norm(x, blk["attn_norm"], c.eps)
-        q = jnp.einsum("btd,de->bte", y, blk["wq"].astype(y.dtype)).reshape(b, t, hq, dh)
-        k_ = jnp.einsum("btd,de->bte", y, blk["wk"].astype(y.dtype)).reshape(b, t, hkv, dh)
-        v_ = jnp.einsum("btd,de->bte", y, blk["wv"].astype(y.dtype)).reshape(b, t, hkv, dh)
+        # qdot streams int8 weights straight into the matmul (scale folded
+        # into the output) — no dequantized bf16 tiles in HBM
+        q = qdot("btd,de->bte", y, blk["wq"]).reshape(b, t, hq, dh)
+        k_ = qdot("btd,de->bte", y, blk["wk"]).reshape(b, t, hkv, dh)
+        v_ = qdot("btd,de->bte", y, blk["wv"]).reshape(b, t, hkv, dh)
         q = apply_rotary_pos_emb(q, cos, sin, position_offset=idx)
         k_ = apply_rotary_pos_emb(k_, cos, sin, position_offset=idx)
         if cache is None:
@@ -150,12 +151,11 @@ class LlamaModel:
             kc, vc, layer, idx = cache
             kc, vc, kl, vl = write_kv_cache(kc, vc, k_, v_, layer, idx)
             attn = decode_attention(q, kl, vl, idx)
-        x = x + jnp.einsum("bte,ed->btd", attn.reshape(b, t, hq * dh),
-                           blk["wo"].astype(x.dtype))
+        x = x + qdot("bte,ed->btd", attn.reshape(b, t, hq * dh), blk["wo"])
         y = rms_norm(x, blk["mlp_norm"], c.eps)
-        gate = jax.nn.silu(jnp.einsum("btd,dm->btm", y, blk["w_gate"].astype(y.dtype)))
-        up = jnp.einsum("btd,dm->btm", y, blk["w_up"].astype(y.dtype))
-        x = x + jnp.einsum("btm,md->btd", gate * up, blk["w_down"].astype(x.dtype))
+        gate = jax.nn.silu(qdot("btd,dm->btm", y, blk["w_gate"]))
+        up = qdot("btd,dm->btm", y, blk["w_up"])
+        x = x + qdot("btm,md->btd", gate * up, blk["w_down"])
         return x, kc, vc
 
     def _block(self, x, blk, cos, sin, train: bool):

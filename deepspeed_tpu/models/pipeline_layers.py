@@ -101,9 +101,19 @@ class GPT2BlockLayer:
         qkv = jnp.einsum("btd,de->bte", y, blk["qkv_w"].astype(y.dtype)) + \
             blk["qkv_b"].astype(y.dtype)
         q, k_, v_ = jnp.split(qkv, 3, axis=-1)
+        # attention dropout matches the fused model (gpt2.py _block_impl):
+        # applied only when training AND an rng key is threaded in — the
+        # pipeline executors derive the key per (microbatch, global layer)
+        # via PipelinedModelAdapter.layer_key
+        rng = rngs.get("dropout") if isinstance(rngs, dict) else rngs
+        drop_rng = None
+        if train and c.dropout > 0.0 and rng is not None:
+            rng, drop_rng = jax.random.split(rng)
         attn = multihead_attention(
             q.reshape(b, t, h, dh), k_.reshape(b, t, h, dh), v_.reshape(b, t, h, dh),
-            causal=True)
+            causal=True,
+            dropout_rate=c.dropout if (train and drop_rng is not None) else 0.0,
+            dropout_rng=drop_rng)
         x = x + jnp.einsum("btd,de->bte", attn.reshape(b, t, d),
                            blk["attn_out_w"].astype(x.dtype)) + \
             blk["attn_out_b"].astype(x.dtype)

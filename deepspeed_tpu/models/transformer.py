@@ -28,7 +28,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from deepspeed_tpu.models.base import cross_entropy_loss, dequant_block, gelu, layer_norm
+from deepspeed_tpu.models.base import cross_entropy_loss, gelu, layer_norm, qdot
 from deepspeed_tpu.ops.attention import decode_attention, multihead_attention, write_kv_cache
 from deepspeed_tpu.ops.rotary import apply_rotary_pos_emb, rope_frequencies
 
@@ -131,7 +131,7 @@ class DecoderConfig:
 class DecoderModel:
     """Causal-LM ModelSpec. batch = {"input_ids": [B,T], "labels": [B,T]}."""
 
-    supports_weight_quant = True   # blocks call dequant_block
+    supports_weight_quant = True   # weight matmuls go through base.qdot
 
     def __init__(self, config: DecoderConfig, compute_dtype=jnp.bfloat16,
                  remat: bool = False, remat_policy: Optional[str] = None,
@@ -253,7 +253,7 @@ class DecoderModel:
         c = self.config
         b, t, d = x.shape
         h, dh = c.num_heads, c.head_dim
-        qkv = jnp.einsum("btd,de->bte", x, blk["qkv_w"].astype(x.dtype)) + \
+        qkv = qdot("btd,de->bte", x, blk["qkv_w"]) + \
             blk["qkv_b"].astype(x.dtype)
         q, k_, v_ = jnp.split(qkv, 3, axis=-1)
         q = q.reshape(b, t, h, dh)
@@ -273,8 +273,9 @@ class DecoderModel:
     def _block_impl(self, x, blk, cache, local_flag=None):
         # cache = (k_full, v_full, layer, idx): full stacked head-major
         # [L,B,H,S,Dh] caches, updated with per-token slice writes only
-        # (see ops/attention.decode_attention docstring)
-        blk = dequant_block(blk, x.dtype)
+        # (see ops/attention.decode_attention docstring). Weight matmuls go
+        # through qdot: int8 weights stream into the matmul, scale on the
+        # output.
         c = self.config
         b, t, d = x.shape
         idx = cache[3] if cache is not None else 0
@@ -308,18 +309,15 @@ class DecoderModel:
             attn = decode_attention(q, kl, vl, idx, bias=dec_bias,
                                     scale=c.qk_scale, window=window)
         attn = attn.reshape(b, t, d)
-        attn_out = jnp.einsum("btd,de->bte", attn,
-                              blk["attn_out_w"].astype(x.dtype)) + \
+        attn_out = qdot("btd,de->bte", attn, blk["attn_out_w"]) + \
             blk["attn_out_b"].astype(x.dtype)
 
         if c.parallel_residual:
             y2 = layer_norm(x, blk["ln2_scale"], blk["ln2_bias"], c.eps) \
                 if c.dual_ln else y1
-            mid = self._act(jnp.einsum("btd,dm->btm", y2,
-                                       blk["mlp_fc_w"].astype(x.dtype)) +
+            mid = self._act(qdot("btd,dm->btm", y2, blk["mlp_fc_w"]) +
                             blk["mlp_fc_b"].astype(x.dtype))
-            mlp_out = jnp.einsum("btm,md->btd", mid,
-                                 blk["mlp_out_w"].astype(x.dtype)) + \
+            mlp_out = qdot("btm,md->btd", mid, blk["mlp_out_w"]) + \
                 blk["mlp_out_b"].astype(x.dtype)
             x = x + attn_out + mlp_out
         else:
@@ -328,11 +326,9 @@ class DecoderModel:
                 x = layer_norm(x, blk["ln1_scale"], blk["ln1_bias"], c.eps)
             y2 = x if c.post_ln else layer_norm(x, blk["ln2_scale"],
                                                 blk["ln2_bias"], c.eps)
-            mid = self._act(jnp.einsum("btd,dm->btm", y2,
-                                       blk["mlp_fc_w"].astype(x.dtype)) +
+            mid = self._act(qdot("btd,dm->btm", y2, blk["mlp_fc_w"]) +
                             blk["mlp_fc_b"].astype(x.dtype))
-            x = x + jnp.einsum("btm,md->btd", mid,
-                               blk["mlp_out_w"].astype(x.dtype)) + \
+            x = x + qdot("btm,md->btd", mid, blk["mlp_out_w"]) + \
                 blk["mlp_out_b"].astype(x.dtype)
             if c.post_ln:
                 x = layer_norm(x, blk["ln2_scale"], blk["ln2_bias"], c.eps)

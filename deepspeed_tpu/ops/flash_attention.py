@@ -216,7 +216,9 @@ def vma_typing_supported() -> bool:
     try:
         jax.ShapeDtypeStruct((1,), jnp.float32, vma=frozenset())
         return hasattr(jax.typeof(jnp.zeros(())), "vma")
-    except TypeError:
+    except Exception:
+        # any probe failure (TypeError on old ShapeDtypeStruct, AttributeError
+        # when jax.typeof is absent, ...) degrades to check_vma=False
         return False
 
 

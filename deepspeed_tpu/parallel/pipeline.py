@@ -41,6 +41,7 @@ def spmd_pipeline(
     num_stages: int,
     num_microbatches: int,
     remat: bool = False,
+    index_args: bool = False,
 ) -> jax.Array:
     """Run ``num_microbatches`` inputs through ``num_stages`` pipeline stages.
 
@@ -50,16 +51,27 @@ def spmd_pipeline(
     stage_params — pytree whose leaves have leading dim ``num_stages``,
         sharded ``P('pipe', ...)``.
     inputs — ``[M, ...]`` microbatch stream (replicated over 'pipe').
+    index_args — when True, the stage fn is called as
+        ``stage_fn(params_slice, x, stage, mb_id)`` with traced int32
+        scalars: the stage index and the microbatch index that stage is
+        processing this tick (``t - stage``; out-of-range on bubble ticks,
+        whose outputs are discarded). Lets callers derive per-(stage,
+        microbatch, layer) dropout keys that match the host-driven 1F1B
+        interpreter exactly (reference threads CudaRNGStatesTracker state
+        through its stages, activation_checkpointing/checkpointing.py:121).
 
     Returns ``[M, ...]`` last-stage outputs.
     """
     assert inputs.shape[0] == num_microbatches
     S, M = num_stages, num_microbatches
+    if not index_args:
+        base_fn = stage_fn
+        stage_fn = lambda p, x, stage, mb: base_fn(p, x)  # noqa: E731
     if S == 1:
-        def body(_, x):
+        def body(m, x):
             one = jax.tree_util.tree_map(lambda p: p[0], stage_params)
-            return None, stage_fn(one, x)
-        return jax.lax.scan(body, None, inputs)[1]
+            return m + 1, stage_fn(one, x, jnp.int32(0), m)
+        return jax.lax.scan(body, jnp.int32(0), inputs)[1]
 
     fn = jax.checkpoint(stage_fn) if remat else stage_fn
 
@@ -84,7 +96,7 @@ def spmd_pipeline(
         def tick(carry, t):
             state, outputs = carry
             x = jnp.where(stage == 0, xs[t % M], state)
-            y = fn(params_one, x)
+            y = fn(params_one, x, stage, t - stage)
             outputs = outputs.at[(t - (S - 1)) % M].set(y)
             state = jax.lax.ppermute(
                 y, PIPE_AXIS, [(i, (i + 1) % S) for i in range(S)])

@@ -186,7 +186,17 @@ class PipelinedModelAdapter:
             return params["pre"].get(str(i))
         return params["post"].get(str(i))
 
-    def _run_segment(self, params, idx_list, x, train: bool):
+    @staticmethod
+    def layer_key(base, mb_id, layer_idx):
+        """Per-(microbatch, global-layer) dropout key. Both executors (SPMD
+        scan and host 1F1B interpreter) derive keys through this one
+        function, so pipelined dropout is numerics-identical across them —
+        the functional analog of the reference's CudaRNGStatesTracker
+        threading (activation_checkpointing/checkpointing.py:121)."""
+        return jax.random.fold_in(jax.random.fold_in(base, mb_id), layer_idx)
+
+    def _run_segment(self, params, idx_list, x, train: bool,
+                     rng_base=None, mb_id=None):
         for i in idx_list:
             layer = self.module.layers[i]
             spec = self.module.layer_specs[i]
@@ -195,7 +205,9 @@ class PipelinedModelAdapter:
                 # lm head projecting through the embedding table)
                 x = spec.forward_fn(self._layer_params(params, i), x)
             elif hasattr(layer, "apply"):
-                x = layer.apply(self._layer_params(params, i), x, rngs=None, train=train)
+                k = (self.layer_key(rng_base, mb_id, i)
+                     if rng_base is not None else None)
+                x = layer.apply(self._layer_params(params, i), x, rngs=k, train=train)
             else:
                 x = layer(x)
         return x
@@ -211,34 +223,56 @@ class PipelinedModelAdapter:
 
     def apply(self, params, batch, *, rngs=None, train: bool = False):
         """batch leaves carry a leading [M] microbatch dim (the pipeline
-        stream == gradient-accumulation microbatches, reference engine.py:81)."""
+        stream == gradient-accumulation microbatches, reference engine.py:81).
+        ``rngs`` (a key, or {'dropout': key}) threads per-(microbatch, layer)
+        dropout keys through prefix/body/suffix via ``layer_key``."""
         M = jax.tree_util.tree_leaves(batch)[0].shape[0]
+        base = rngs.get("dropout") if isinstance(rngs, dict) else rngs
+        if not train:
+            base = None
+        K = self.layers_per_stage
 
-        def pre_fn(mb):
+        def pre_fn(args):
+            mb, mb_id = args
             inputs, _ = self._split_batch(mb)
-            return self._run_segment(params, self.prefix_idx, inputs, train)
+            return self._run_segment(params, self.prefix_idx, inputs, train,
+                                     base, mb_id)
 
-        xs = jax.lax.map(pre_fn, batch)
+        xs = jax.lax.map(pre_fn, (batch, jnp.arange(M)))
 
-        def stage_fn(stage_params, x):
-            def body(h, lp):
-                return self.body_layer.apply(lp, h, rngs=None, train=train), None
+        if base is None:
+            def stage_fn(stage_params, x):
+                def body(h, lp):
+                    return self.body_layer.apply(
+                        lp, h, rngs=None, train=train), None
 
-            return jax.lax.scan(body, x, stage_params)[0]
+                return jax.lax.scan(body, x, stage_params)[0]
+        else:
+            def stage_fn(stage_params, x, stage, mb_id):
+                def body(h, lp_k):
+                    lp, k = lp_k
+                    key = self.layer_key(base, mb_id,
+                                         self.body_start + stage * K + k)
+                    return self.body_layer.apply(
+                        lp, h, rngs=key, train=train), None
+
+                return jax.lax.scan(body, x,
+                                    (stage_params, jnp.arange(K)))[0]
 
         ys = spmd_pipeline(stage_fn, params["body"], xs, mesh=self.mesh,
                            num_stages=self.num_stages, num_microbatches=M,
-                           remat=self.remat)
+                           remat=self.remat, index_args=base is not None)
 
         def post_fn(args):
-            y, mb = args
+            y, mb, mb_id = args
             _, labels = self._split_batch(mb)
-            out = self._run_segment(params, self.suffix_idx, y, train)
+            out = self._run_segment(params, self.suffix_idx, y, train,
+                                    base, mb_id)
             if self.module.loss_fn is not None:
                 return self.module.loss_fn(out, labels)
             return out
 
-        losses = jax.lax.map(post_fn, (ys, batch))
+        losses = jax.lax.map(post_fn, (ys, batch, jnp.arange(M)))
         loss = jnp.mean(losses.astype(jnp.float32))
         return loss, {"loss": loss}
 
@@ -359,8 +393,13 @@ class PipelineEngine(DeepSpeedEngine):
         # keep the scale a device scalar — a host fetch here would fence
         # dispatch against the previous step's scaler update (tunnel RTT)
         scale = self.state.scaler.cur_scale
+        # same per-step base key as the SPMD path (_build_train_step passes
+        # rngs={'dropout': fold_in(dropout_rng, step)}) — the executor folds
+        # (mb_id, layer) on top via layer_key, so both executors drop the
+        # same units
+        rng = jax.random.fold_in(self._dropout_rng, self.global_steps)
         loss, grads, stats = self._executor_1f1b.train_batch(
-            cparams, batch, loss_scale=scale)
+            cparams, batch, loss_scale=scale, rngs=rng)
         self.last_1f1b_stats = stats
         self.state, overflow, norm, scale = self._1f1b_apply(
             self.state, grads, lr)

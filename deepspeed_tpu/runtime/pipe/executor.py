@@ -42,8 +42,18 @@ from typing import Any, Callable, Dict, List, Optional
 
 import jax
 import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
 
 from deepspeed_tpu.runtime.pipe import schedule as sched
+
+
+def _axes_in(entry, axis_names) -> bool:
+    """True when a PartitionSpec entry (axis name or tuple of names) only
+    references axes present in ``axis_names``."""
+    if isinstance(entry, (tuple, list)):
+        return all(e in axis_names for e in entry)
+    return entry in axis_names
 
 
 def _tree_add(a, b):
@@ -92,68 +102,222 @@ class Schedule1F1BExecutor:
         assert self.S >= 2, (
             "the 1F1B executor is for multi-stage pipelines; single-stage "
             "training uses the engine's fused step (DataParallelSchedule)")
-        self._build_fns()
+        self._fns_cache: Dict[bool, Dict[str, Callable]] = {}
+        self.submeshes = self._build_submeshes()
+
+    # ------------------------------------------------------- stage submeshes
+    def _build_submeshes(self):
+        """One submesh per stage: the full mesh's devices at pipe index s,
+        keeping every other axis. Stage params/compute are PINNED to their
+        submesh and every inter-stage wire is a real jax.device_put transfer
+        — the placement model the reference's PP uses (module.py:85
+        partitions layers onto disjoint rank sets; p2p.py:50 moves the
+        boundary tensors), and the execution model that extends to
+        multi-slice DCN pipelining where one SPMD program cannot span the
+        job. Returns None (single-mesh fallback: stages replicated over
+        'pipe') when the mesh lacks a pipe axis of size S."""
+        from deepspeed_tpu.parallel.topology import PIPE_AXIS
+
+        mesh = getattr(self.adapter, "mesh", None)
+        if mesh is None or PIPE_AXIS not in mesh.axis_names:
+            return None
+        if mesh.shape[PIPE_AXIS] != self.S:
+            return None
+        ax = list(mesh.axis_names).index(PIPE_AXIS)
+        names = tuple(n for n in mesh.axis_names if n != PIPE_AXIS)
+        subs = []
+        for s in range(self.S):
+            devs = np.take(np.asarray(mesh.devices), s, axis=ax)
+            subs.append(jax.sharding.Mesh(devs, names))
+        return subs
+
+    def stage_device_sets(self):
+        """Per-stage device sets (disjoint when submeshes are active) —
+        asserted by tests; the single-mesh fallback returns the full set
+        for every stage."""
+        if self.submeshes is None:
+            mesh = getattr(self.adapter, "mesh", None)
+            full = frozenset(np.asarray(mesh.devices).ravel().tolist()) \
+                if mesh is not None else frozenset()
+            return [full] * self.S
+        return [frozenset(np.asarray(m.devices).ravel().tolist())
+                for m in self.submeshes]
+
+    @staticmethod
+    def _spec_without_lead(arr):
+        """PartitionSpec of ``arr`` minus its leading (pipe) entry — the
+        intra-stage sharding a stage-sliced leaf keeps on its submesh."""
+        spec = getattr(getattr(arr, "sharding", None), "spec", None)
+        if spec is None:
+            return P()
+        return P(*tuple(spec)[1:])
+
+    @staticmethod
+    def _spec_of(arr):
+        spec = getattr(getattr(arr, "sharding", None), "spec", None)
+        return P() if spec is None else P(*tuple(spec))
+
+    def _to_stage(self, tree, s, stacked_src=None):
+        """Transfer a pytree to stage ``s``'s submesh, preserving each
+        leaf's intra-stage sharding. This IS the pipeline wire: between
+        submeshes it is a real device-to-device (ICI/DCN) transfer.
+        ``stacked_src`` (the [S, ...] pipe-stacked source tree) supplies
+        the target spec for freshly stage-sliced leaves: the source spec
+        minus its leading 'pipe' entry."""
+        if self.submeshes is None:
+            return tree
+        sub = self.submeshes[s]
+
+        def put(x, src=None):
+            spec = (self._spec_without_lead(src) if src is not None
+                    else self._spec_of(x))
+            # drop spec entries referring to axes absent from the submesh
+            entries = tuple(e for e in tuple(spec)
+                            if e is None or _axes_in(e, sub.axis_names))
+            return jax.device_put(x, NamedSharding(sub, P(*entries)))
+
+        if stacked_src is not None:
+            return jax.tree_util.tree_map(put, tree, stacked_src)
+        return jax.tree_util.tree_map(put, tree)
+
+    def _from_stages(self, per_stage):
+        """Stack per-stage grad pytrees (each living on its stage submesh)
+        back onto the FULL mesh in the params['body'] layout: leaves move
+        submesh -> full mesh (the reverse wire), then stack under the
+        pipe-sharded spec so the engine epilogue sees the same layout the
+        SPMD path produces."""
+        mesh = self.adapter.mesh
+        if self.submeshes is None:
+            return jax.tree_util.tree_map(
+                lambda *xs: jnp.stack(xs), *per_stage)
+
+        from deepspeed_tpu.parallel.topology import PIPE_AXIS
+
+        def stack(*xs):
+            spec_rest = self._spec_of(xs[0])
+            entries = tuple(e for e in tuple(spec_rest)
+                            if e is None or _axes_in(e, mesh.axis_names))
+            moved = [jax.device_put(
+                x, NamedSharding(mesh, P(*entries))) for x in xs]
+            return jax.device_put(
+                jnp.stack(moved), NamedSharding(mesh, P(PIPE_AXIS, *entries)))
+
+        return jax.tree_util.tree_map(stack, *per_stage)
+
+    def _to_full(self, tree):
+        """Reverse wire: move a stage-resident pytree onto the full mesh
+        (replicated over 'pipe'), keeping intra-stage sharding."""
+        if self.submeshes is None:
+            return tree
+        mesh = self.adapter.mesh
+
+        def put(x):
+            spec = self._spec_of(x)
+            entries = tuple(e for e in tuple(spec)
+                            if e is None or _axes_in(e, mesh.axis_names))
+            return jax.device_put(x, NamedSharding(mesh, P(*entries)))
+
+        return jax.tree_util.tree_map(put, tree)
 
     # ------------------------------------------------------------ stage fns
-    def _build_fns(self):
-        # NOTE on dropout rngs: stage fns pass rngs=None to layers, the same
-        # as PipelinedModelAdapter.apply on the SPMD path — pipeline layers
-        # with stochastic behavior are not rng-threaded on EITHER executor
-        # today (the two paths stay numerically identical).
+    def _fns(self, use_rng: bool) -> Dict[str, Callable]:
+        """Jitted per-stage fwd/bwd functions. Two static variants: without
+        rngs (layers see rngs=None — dropout off, the pre-round-4 program)
+        and with rngs, where every layer's key is
+        ``PipelinedModelAdapter.layer_key(base, mb_id, global_layer_idx)``
+        — the SAME derivation the SPMD scan uses, so the two executors stay
+        numerics-identical with dropout enabled. stage/mb_id are traced
+        int32 scalars (mid-stage fns are reused across stages; a python int
+        would recompile per stage/microbatch)."""
+        if use_rng in self._fns_cache:
+            return self._fns_cache[use_rng]
         ad = self.adapter
+        K = ad.layers_per_stage
+        key_of = type(ad).layer_key
 
-        def stage_body(body_s, x, train):
-            def body(h, lp):
-                return ad.body_layer.apply(lp, h, rngs=None,
+        def stage_body(body_s, x, train, stage, mb_id, base):
+            if base is None:
+                def body(h, lp):
+                    return ad.body_layer.apply(lp, h, rngs=None,
+                                               train=train), None
+                return jax.lax.scan(body, x, body_s)[0]
+
+            def body(h, lp_k):
+                lp, k = lp_k
+                key = key_of(base, mb_id, ad.body_start + stage * K + k)
+                return ad.body_layer.apply(lp, h, rngs=key,
                                            train=train), None
-            return jax.lax.scan(body, x, body_s)[0]
+            return jax.lax.scan(body, x, (body_s, jnp.arange(K)))[0]
 
-        def first_fwd(shared, body0, mb, *, train):
+        def first_fwd(shared, body0, mb, mb_id=None, base=None, *, train):
             inputs, _ = ad._split_batch(mb)
-            h = ad._run_segment(shared, ad.prefix_idx, inputs, train)
-            return stage_body(body0, h, train)
+            h = ad._run_segment(shared, ad.prefix_idx, inputs, train,
+                                base, mb_id)
+            return stage_body(body0, h, train, 0, mb_id, base)
 
-        def mid_fwd(body_s, x, *, train):
-            return stage_body(body_s, x, train)
+        def mid_fwd(body_s, x, stage=None, mb_id=None, base=None, *, train):
+            return stage_body(body_s, x, train, stage, mb_id, base)
 
-        def last_loss(body_last, shared, x, mb, *, train):
+        def last_loss(body_last, shared, x, mb, mb_id=None, base=None, *,
+                      train):
             _, labels = ad._split_batch(mb)
-            y = stage_body(body_last, x, train)
-            out = ad._run_segment(shared, ad.suffix_idx, y, train)
+            y = stage_body(body_last, x, train, self.S - 1, mb_id, base)
+            out = ad._run_segment(shared, ad.suffix_idx, y, train,
+                                  base, mb_id)
             if ad.module.loss_fn is not None:
                 return ad.module.loss_fn(out, labels)
             return out
 
-        # shared params (pre/post/tied) enter first/last stages so their
-        # grads flow; vjp wrt (shared, body, x) as needed
-        self._first_fwd = jax.jit(functools.partial(first_fwd, train=True))
-        self._mid_fwd = jax.jit(functools.partial(mid_fwd, train=True))
-        self._first_fwd_eval = jax.jit(functools.partial(first_fwd,
-                                                         train=False))
-        self._mid_fwd_eval = jax.jit(functools.partial(mid_fwd, train=False))
-        self._last_fwd_eval = jax.jit(functools.partial(last_loss,
-                                                        train=False))
-
-        def first_bwd(shared, body0, mb, gy):
+        def first_bwd(shared, body0, mb, gy, mb_id=None, base=None):
             _, vjp = jax.vjp(
-                lambda s, b: first_fwd(s, b, mb, train=True), shared, body0)
+                lambda s, b: first_fwd(s, b, mb, mb_id, base, train=True),
+                shared, body0)
             return vjp(gy)  # (g_shared, g_body0)
 
-        def mid_bwd(body_s, x, gy):
+        def mid_bwd(body_s, x, gy, stage=None, mb_id=None, base=None):
             _, vjp = jax.vjp(
-                lambda b, xx: mid_fwd(b, xx, train=True), body_s, x)
+                lambda b, xx: mid_fwd(b, xx, stage, mb_id, base, train=True),
+                body_s, x)
             return vjp(gy)  # (g_body, gx)
 
-        def last_bwd(body_last, shared, x, mb, dloss):
+        def last_bwd(body_last, shared, x, mb, dloss, mb_id=None, base=None):
             loss, vjp = jax.vjp(
-                lambda b, s, xx: last_loss(b, s, xx, mb, train=True),
+                lambda b, s, xx: last_loss(b, s, xx, mb, mb_id, base,
+                                           train=True),
                 body_last, shared, x)
             g_body, g_shared, gx = vjp(dloss)
             return loss, g_body, g_shared, gx
 
-        self._first_bwd = jax.jit(first_bwd)
-        self._mid_bwd = jax.jit(mid_bwd)
-        self._last_bwd = jax.jit(last_bwd)
+        # shared params (pre/post/tied) enter first/last stages so their
+        # grads flow; vjp wrt (shared, body, x) as needed. Without rngs the
+        # optional args are dropped so compiled signatures match round 3.
+        if use_rng:
+            fns = {
+                "first_fwd": jax.jit(functools.partial(first_fwd, train=True)),
+                "mid_fwd": jax.jit(functools.partial(mid_fwd, train=True)),
+                "first_bwd": jax.jit(first_bwd),
+                "mid_bwd": jax.jit(mid_bwd),
+                "last_bwd": jax.jit(last_bwd),
+            }
+        else:
+            fns = {
+                "first_fwd": jax.jit(lambda s, b, mb: first_fwd(
+                    s, b, mb, train=True)),
+                "mid_fwd": jax.jit(lambda b, x: mid_fwd(b, x, train=True)),
+                "first_bwd": jax.jit(lambda s, b, mb, gy: first_bwd(
+                    s, b, mb, gy)),
+                "mid_bwd": jax.jit(lambda b, x, gy: mid_bwd(b, x, gy)),
+                "last_bwd": jax.jit(lambda b, s, x, mb, d: last_bwd(
+                    b, s, x, mb, d)),
+            }
+        # eval is always rng-free (dropout off)
+        fns["first_fwd_eval"] = jax.jit(lambda s, b, mb: first_fwd(
+            s, b, mb, train=False))
+        fns["mid_fwd_eval"] = jax.jit(lambda b, x: mid_fwd(b, x, train=False))
+        fns["last_fwd_eval"] = jax.jit(lambda b, s, x, mb: last_loss(
+            b, s, x, mb, train=False))
+        self._fns_cache[use_rng] = fns
+        return fns
 
     @staticmethod
     def _shared_of(params):
@@ -163,25 +327,41 @@ class Schedule1F1BExecutor:
     # ------------------------------------------------------------ execution
     def train_batch(self, params, batch,
                     optimizer_step_fn: Optional[Callable] = None,
-                    loss_scale=1.0):
+                    loss_scale=1.0, rngs=None):
         """``batch`` leaves carry a leading [M] microbatch dim. Interprets
         each stage's TrainSchedule stream tick-locked; returns
         (mean_loss, grads, stats). ``optimizer_step_fn(grads)`` runs at the
         OptimizerStep instruction when provided. ``loss_scale`` (python
         float or device scalar — device keeps dispatch async) multiplies
         the seed cotangent (fp16 dynamic-loss-scaling semantics — the
-        engine's _apply_grads unscales); the reported loss is UNscaled."""
+        engine's _apply_grads unscales); the reported loss is UNscaled.
+        ``rngs`` (a key, or {'dropout': key}) enables per-(microbatch,
+        layer) dropout keys — derivation shared with the SPMD path via
+        ``PipelinedModelAdapter.layer_key``."""
         S, M = self.S, self.M
         ad = self.adapter
+        base = rngs.get("dropout") if isinstance(rngs, dict) else rngs
+        fns = self._fns(base is not None)
+        # traced scalars (a python int would recompile per value)
+        _i32 = lambda v: jnp.asarray(v, jnp.int32)  # noqa: E731
+        # stage placement: shared params pinned to the two end stages (the
+        # only ones that touch them); body slices pinned per stage; the rng
+        # base replicated onto every stage's submesh
         shared = self._shared_of(params)
+        shared_first = self._to_stage(shared, 0)
+        shared_last = self._to_stage(shared, S - 1)
         # slice each stage's body params ONCE per batch (the pipe-sharded
         # stack reshards on slicing; per-instruction slicing would repay
         # that transfer every tick)
-        bodies = [jax.tree_util.tree_map(lambda a, s=s: a[s], params["body"])
-                  for s in range(S)]
+        bodies = [self._to_stage(
+            jax.tree_util.tree_map(lambda a, s=s: a[s], params["body"]), s,
+            stacked_src=params["body"])
+            for s in range(S)]
         body_of = lambda s: bodies[s]  # noqa: E731
-        mb_of = lambda i: jax.tree_util.tree_map(  # noqa: E731
-            lambda x: x[i], batch)
+        mb_of = lambda i, s: self._to_stage(jax.tree_util.tree_map(  # noqa: E731,E501
+            lambda x: x[i], batch), s)
+        base_s = ([self._to_stage(base, s) for s in range(S)]
+                  if base is not None else [None] * S)
 
         schedules = [self.schedule_cls(M, S, s) for s in range(S)]
         streams = [list(s.steps()) for s in schedules]
@@ -192,10 +372,12 @@ class Schedule1F1BExecutor:
         grad_wire = [deque() for _ in range(S)]  # edge s+1 -> s
         load_count = [0] * S    # LoadMicroBatch FIFO per stage
         recv_count = [0] * S    # RecvActivation FIFO per stage (mb order)
-        g_shared = None
+        g_shared_first = None   # shared-param grads from stage 0
+        g_shared_last = None    # shared-param grads from stage S-1
         g_body: List[Any] = [None] * S
         losses = []
-        dloss = jnp.asarray(loss_scale, jnp.float32) / M
+        dloss = self._to_stage(jnp.asarray(loss_scale, jnp.float32) / M,
+                               S - 1)
         stats = {"peak_buffers": [0] * S, "peak_live_bytes": [0] * S,
                  "num_pipe_buffers": [schedules[s].num_pipe_buffers()
                                       for s in range(S)]}
@@ -210,10 +392,12 @@ class Schedule1F1BExecutor:
                     buf = bufs[s][c.buffer_id] if isinstance(
                         c, sched.BufferOpInstruction) else None
                     if isinstance(c, sched.SendActivation):
-                        act_wire[s + 1].append(buf.y)
+                        # the wire: a real cross-submesh transfer (reference
+                        # p2p.py:50 send/recv pair)
+                        act_wire[s + 1].append(self._to_stage(buf.y, s + 1))
                         buf.y = None
                     elif isinstance(c, sched.SendGrad):
-                        grad_wire[s - 1].append(buf.gx)
+                        grad_wire[s - 1].append(self._to_stage(buf.gx, s - 1))
                         buf.gx = None
             # phase 2: recv + compute
             for s in range(S):
@@ -237,10 +421,22 @@ class Schedule1F1BExecutor:
                         buf.gy = grad_wire[s].popleft()
                     elif isinstance(c, sched.ForwardPass):
                         if s == 0:
-                            buf.x = mb_of(buf.mb_id)
-                            y = self._first_fwd(shared, body_of(0), buf.x)
+                            buf.x = mb_of(buf.mb_id, 0)
+                            if base is None:
+                                y = fns["first_fwd"](shared_first,
+                                                     body_of(0), buf.x)
+                            else:
+                                y = fns["first_fwd"](shared_first,
+                                                     body_of(0),
+                                                     buf.x, _i32(buf.mb_id),
+                                                     base_s[0])
                         elif s < S - 1:
-                            y = self._mid_fwd(body_of(s), buf.x)
+                            if base is None:
+                                y = fns["mid_fwd"](body_of(s), buf.x)
+                            else:
+                                y = fns["mid_fwd"](body_of(s), buf.x,
+                                                   _i32(s), _i32(buf.mb_id),
+                                                   base_s[s])
                         else:
                             # last stage: loss+backward fuse in BackwardPass
                             # (value_and_grad) — forward here would double
@@ -250,21 +446,38 @@ class Schedule1F1BExecutor:
                             buf.y = y
                     elif isinstance(c, sched.BackwardPass):
                         if s == S - 1:
-                            loss, gb, gs, gx = self._last_bwd(
-                                body_of(s), shared, buf.x,
-                                mb_of(buf.mb_id), dloss)
+                            if base is None:
+                                loss, gb, gs, gx = fns["last_bwd"](
+                                    body_of(s), shared_last, buf.x,
+                                    mb_of(buf.mb_id, s), dloss)
+                            else:
+                                loss, gb, gs, gx = fns["last_bwd"](
+                                    body_of(s), shared_last, buf.x,
+                                    mb_of(buf.mb_id, s), dloss,
+                                    _i32(buf.mb_id), base_s[s])
                             losses.append(loss)
-                            g_shared = _tree_add(g_shared, gs)
+                            g_shared_last = _tree_add(g_shared_last, gs)
                             g_body[s] = _tree_add(g_body[s], gb)
                             buf.gx = gx
                         elif s > 0:
-                            gb, gx = self._mid_bwd(body_of(s), buf.x, buf.gy)
+                            if base is None:
+                                gb, gx = fns["mid_bwd"](body_of(s), buf.x,
+                                                        buf.gy)
+                            else:
+                                gb, gx = fns["mid_bwd"](
+                                    body_of(s), buf.x, buf.gy, _i32(s),
+                                    _i32(buf.mb_id), base_s[s])
                             g_body[s] = _tree_add(g_body[s], gb)
                             buf.gx = gx
                         else:
-                            gs, gb = self._first_bwd(
-                                shared, body_of(0), buf.x, buf.gy)
-                            g_shared = _tree_add(g_shared, gs)
+                            if base is None:
+                                gs, gb = fns["first_bwd"](
+                                    shared_first, body_of(0), buf.x, buf.gy)
+                            else:
+                                gs, gb = fns["first_bwd"](
+                                    shared_first, body_of(0), buf.x, buf.gy,
+                                    _i32(buf.mb_id), base_s[0])
+                            g_shared_first = _tree_add(g_shared_first, gs)
                             g_body[0] = _tree_add(g_body[0], gb)
                         buf.x = None   # memory release point (1F1B bound)
                         buf.gy = None
@@ -284,11 +497,16 @@ class Schedule1F1BExecutor:
                     sum(b.live_bytes() for b in live))
 
         assert len(losses) == M, f"expected {M} losses, got {len(losses)}"
+        # reassemble on the FULL mesh: per-stage body grads stack back into
+        # the pipe-sharded [S, K, ...] layout; the two end stages' shared
+        # grads sum (ReduceTiedGrads semantics — the tie-group reduction is
+        # this cross-stage add, reference pipe/engine.py:223)
+        g_shared = _tree_add(self._to_full(g_shared_first),
+                             self._to_full(g_shared_last))
         grads = {
             "pre": g_shared["pre"], "post": g_shared["post"],
             "tied": g_shared["tied"],
-            "body": jax.tree_util.tree_map(
-                lambda *xs: jnp.stack(xs), *g_body),
+            "body": self._from_stages(g_body),
         }
         mean_loss = sum(jax.tree_util.tree_leaves(losses)) / M
         if opt_ran and optimizer_step_fn is not None:
@@ -298,12 +516,17 @@ class Schedule1F1BExecutor:
     def eval_batch(self, params, batch):
         """Forward-only interpretation of InferenceSchedule."""
         S, M = self.S, self.M
+        fns = self._fns(False)
         shared = self._shared_of(params)
-        bodies = [jax.tree_util.tree_map(lambda a, s=s: a[s], params["body"])
-                  for s in range(S)]
+        shared_first = self._to_stage(shared, 0)
+        shared_last = self._to_stage(shared, S - 1)
+        bodies = [self._to_stage(
+            jax.tree_util.tree_map(lambda a, s=s: a[s], params["body"]), s,
+            stacked_src=params["body"])
+            for s in range(S)]
         body_of = lambda s: bodies[s]  # noqa: E731
-        mb_of = lambda i: jax.tree_util.tree_map(  # noqa: E731
-            lambda x: x[i], batch)
+        mb_of = lambda i, s: self._to_stage(jax.tree_util.tree_map(  # noqa: E731,E501
+            lambda x: x[i], batch), s)
 
         schedules = [sched.InferenceSchedule(M, S, s) for s in range(S)]
         streams = [list(s.steps()) for s in schedules]
@@ -335,17 +558,19 @@ class Schedule1F1BExecutor:
                         buf.mb_id = counters[s]
                         counters[s] += 1
                     elif isinstance(c, sched.SendActivation):
-                        act_wire[s + 1].append(buf.y)
+                        act_wire[s + 1].append(self._to_stage(buf.y, s + 1))
                         buf.y = None
                     elif isinstance(c, sched.ForwardPass):
                         if s == 0 and S > 1:
-                            buf.y = self._first_fwd_eval(
-                                shared, body_of(0), mb_of(buf.mb_id))
+                            buf.y = fns["first_fwd_eval"](
+                                shared_first, body_of(0),
+                                mb_of(buf.mb_id, 0))
                         elif s < S - 1:
-                            buf.y = self._mid_fwd_eval(body_of(s), buf.x)
+                            buf.y = fns["mid_fwd_eval"](body_of(s), buf.x)
                         else:
-                            losses.append(self._last_fwd_eval(
-                                body_of(s), shared, buf.x, mb_of(buf.mb_id)))
+                            losses.append(fns["last_fwd_eval"](
+                                body_of(s), shared_last, buf.x,
+                                mb_of(buf.mb_id, s)))
                             buf.x = None
         assert len(losses) == M
         return sum(jax.tree_util.tree_leaves(losses)) / M

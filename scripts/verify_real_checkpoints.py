@@ -203,7 +203,7 @@ def main():
     # verify everything the machine already has
     checked = {args.gpt2, args.llama}
     for model_name, path in _discover_local_snapshots():
-        if model_name in checked or any(model_name in str(v) for v in RESULTS):
+        if model_name in checked or f"local:{model_name}" in RESULTS:
             continue
         try:
             with open(os.path.join(path, "config.json")) as f:
