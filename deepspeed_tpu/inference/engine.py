@@ -402,10 +402,15 @@ class InferenceEngine:
             logits = filter_logits(logits / temp, top_k=top_k, top_p=top_p)
             return jax.random.categorical(rng, logits, axis=-1).astype(jnp.int32)
 
+        # pad the KV allocation to a multiple of 128 so the flash-decode
+        # kernel's sequence blocks tile (ops/attention.decode_attention
+        # routing); masking by cache_index keeps padded positions inert
+        cache_len = (total + 127) // 128 * 128
+
         pf_key = ("pf", b, t, total, do_sample, top_k, top_p)
         if pf_key not in self._compiled:
             def prefill(params, ids, temp, rng):
-                cache = model.init_cache(b, total, dtype=self.dtype)
+                cache = model.init_cache(b, cache_len, dtype=self.dtype)
                 logits, cache = model.forward_with_cache(params, ids, cache)
                 rng, sub = jax.random.split(rng)
                 return pick(logits[:, -1], temp, sub), cache, rng
@@ -492,6 +497,25 @@ class InferenceEngine:
         return gen
 
     # ------------------------------------------------------------- utilities
+    def compiled_programs(self, batch: int, prompt_len: int, max_new: int,
+                          *, do_sample: bool = False, top_k: int = 0,
+                          top_p: float = 1.0):
+        """The (prefill, decode) jitted programs generate() uses for this
+        shape — built on demand. For benches that time the programs
+        directly (PROFILE_DECODE.md methodology) without reconstructing
+        the private cache keys. Greedy/eos-free only (decode is the scan
+        program; the eos path's while-loop program is not exposed)."""
+        self._build_generate(batch, prompt_len, max_new,
+                             do_sample=do_sample, top_k=top_k,
+                             top_p=float(top_p), eos_token_id=None,
+                             pad_token_id=0)
+        total = prompt_len + max_new
+        pf = self._compiled[("pf", batch, prompt_len, total, do_sample,
+                             top_k, float(top_p))]
+        dec = self._compiled.get(("dec", batch, total, max_new, do_sample,
+                                  top_k, float(top_p)))
+        return pf, dec
+
     @property
     def config(self):
         return self._config

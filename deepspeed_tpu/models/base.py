@@ -89,6 +89,23 @@ def qdot(eq, x, w):
     pt_binding.cpp (vector_matmul_int8 path)."""
     if isinstance(w, dict) and "__q__" in w:
         q, s = w["__q__"], w["__scale__"]
+        # decode fast path: tiny activations, weight-streaming-bound — the
+        # Pallas kernel keeps HBM reads at 1 byte/weight (int8 upcast
+        # in-register on the way into the MXU). Every model's qdot call
+        # contracts x's last dim against q's axis 0 with the output on
+        # q's axis 1, so the flat [N, D] @ [D, E] form is general here.
+        lhs, rhs = eq.replace(" ", "").split("->")
+        xs, ws = lhs.split(",")
+        std_form = (len(ws) == 2 and ws[0] == xs[-1] and rhs == xs[:-1] + ws[1])
+        n_rows = 1
+        for dim in x.shape[:-1]:
+            n_rows *= dim
+        if (std_form and q.ndim == 2 and n_rows <= 32
+                and jax.default_backend() == "tpu"):
+            from deepspeed_tpu.ops.int8_matmul import int8_matmul
+
+            out2d = int8_matmul(x.reshape(n_rows, x.shape[-1]), q, s)
+            return out2d.reshape(x.shape[:-1] + (q.shape[1],))
         out = jnp.einsum(eq, x, q.astype(x.dtype))
         return out * s.reshape((1,) * (out.ndim - 1) + (-1,)).astype(x.dtype)
     return jnp.einsum(eq, x, w.astype(x.dtype))

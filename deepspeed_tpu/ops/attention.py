@@ -96,8 +96,28 @@ def decode_attention(
     [B, S, Hkv, Dh] logical shape, XLA assigned the loop-carried cache a
     token-major layout (optimal for the one-token write, 128-byte-strided
     for every read): measured ~150 GB/s effective cache streaming vs
-    1.6 TB/s on weights at batch 8."""
+    1.6 TB/s on weights at batch 8.
+
+    Single-token unbiased/unwindowed decode on TPU routes to the Pallas
+    flash-decode kernel (ops/flash_decode.py): valid-prefix cache reads
+    via scalar-prefetch block clamping + VMEM online softmax."""
     b, t, hq, dh = q.shape
+    rep_ = hq // k_cache.shape[1]
+    if (t == 1 and bias is None and window is None
+            and k_cache.shape[2] % 128 == 0
+            and rep_ >= 8
+            and jax.default_backend() == "tpu"):
+        # Wide-GQA only (rep >= 8): each grid cell feeds the MXU a
+        # [rep, Dh] x [Dh, BS] slab. For MHA both kernel variants MEASURED
+        # SLOWER than this einsum (round 4, 125M B=8: einsum 1.42 ms/tok
+        # vs 5.05 MXU-cell kernel / 1.94 head-batched VPU kernel): XLA
+        # lays the decode loop's cache carry out for einsum lane
+        # parallelism, and a pallas operand in that layout pays a
+        # relayout copy per step — see PROFILE_DECODE.md. Cache length
+        # must tile (the engine pads its KV allocation to 128).
+        from deepspeed_tpu.ops.flash_decode import flash_decode
+
+        return flash_decode(q, k_cache, v_cache, cache_index, scale=scale)
     hkv = k_cache.shape[1]
     s_max = k_cache.shape[2]
     scale = scale if scale is not None else dh ** -0.5

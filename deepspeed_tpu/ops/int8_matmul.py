@@ -1,0 +1,116 @@
+"""Int8 weight-streaming matmul kernel for memory-bound decode.
+
+Reference counterpart: the dequant-fused int8 GEMV path in
+``csrc/transformer/inference`` (pt_binding.cpp vector_matmul + the
+dequantization kernels in dequantize.cu) — the reference streams int8
+weights through a fused dequant+GEMV so HBM traffic stays 1 byte/weight.
+
+Why a Pallas kernel: XLA will not reliably fuse an ``int8 -> bf16``
+convert into a dot operand — measured at GPT-2-125M decode, the
+``qdot`` einsum path (convert materialized per layer) made int8 SLOWER
+than bf16 (0.53 vs 0.43 ms/tok) because each weight pays int8-read +
+bf16-write + bf16-read. Here the int8 tile is DMA'd into VMEM as int8
+(1 byte/weight of HBM traffic — the whole point of weight-only
+quantization) and upcast in-register on its way into the MXU; the
+per-output-column scale multiplies the f32 accumulator once at the end.
+
+Decode shapes: activations are tiny ([B<=16, D]); weights dominate.
+The grid walks (E tiles x D tiles) with D innermost so each output tile
+accumulates across the contraction in VMEM scratch.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+# Fat tiles: decode matmuls are weight-streaming-bound and the per-grid-cell
+# overhead is what erased the int8 bandwidth win in the first cut (~430
+# cells/step at 125M measured ≈ bf16). Blocks are picked as the LARGEST
+# divisors of (E, D) under a VMEM byte budget — at 125M every block matmul
+# becomes 1 grid cell ([768, 2304] int8 = 1.7 MB); at 6.7B shapes ~2-8
+# cells. Budget 8 MB keeps tile + double-buffer + accumulator well under
+# the ~16 MB/core VMEM.
+MAX_TILE_BYTES = 8 * 1024 * 1024
+MAX_BLOCK_E = 8192
+
+
+def _kernel(x_ref, q_ref, s_ref, o_ref, acc_ref, *, nd: int, out_dtype):
+    di = pl.program_id(1)
+
+    @pl.when(di == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    # int8 tile upcasts in-register: HBM saw 1 byte/weight
+    w = q_ref[...].astype(jnp.bfloat16)              # [BD, BE]
+    x = x_ref[...]                                   # [B, BD]
+    acc_ref[...] += jax.lax.dot_general(
+        x, w, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+
+    @pl.when(di == nd - 1)
+    def _finish():
+        o_ref[...] = (acc_ref[...] * s_ref[...].astype(jnp.float32)) \
+            .astype(out_dtype)
+
+
+def _divisor_block(n: int, quantum: int, cap: int) -> int:
+    """Largest divisor of ``n`` that is a multiple of ``quantum`` and
+    <= cap; falls back to halving ``cap`` when no such divisor exists
+    (then requiring only divisibility of n)."""
+    best = 0
+    m = 1
+    while quantum * m <= min(n, cap):
+        if n % (quantum * m) == 0:
+            best = quantum * m
+        m += 1
+    if best:
+        return best
+    blk = min(n, cap)
+    while n % blk:
+        blk //= 2
+    return max(blk, 1)
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def int8_matmul(x: jax.Array, q: jax.Array, s: jax.Array,
+                interpret: Optional[bool] = None) -> jax.Array:
+    """``(x [B, D] bf16) @ (q [D, E] int8) * (s [..., E] f32) -> [B, E]``.
+
+    ``s`` may carry leading unit dims (the engine stores per-layer scales
+    as [1, E]); it is flattened to [E].
+    """
+    b, d = x.shape
+    d2, e = q.shape
+    assert d == d2, (x.shape, q.shape)
+    s = s.reshape(e)
+    be = _divisor_block(e, 128, MAX_BLOCK_E)
+    bd = _divisor_block(d, 8, max(MAX_TILE_BYTES // be, 512))
+    nd, ne = d // bd, e // be
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    kernel = functools.partial(_kernel, nd=nd, out_dtype=x.dtype)
+    kw = {}
+    if not interpret:
+        kw["compiler_params"] = pltpu.CompilerParams(
+            dimension_semantics=("parallel", "arbitrary"))
+    return pl.pallas_call(
+        kernel,
+        grid=(ne, nd),
+        in_specs=[
+            pl.BlockSpec((b, bd), lambda ei, di: (0, di)),
+            pl.BlockSpec((bd, be), lambda ei, di: (di, ei)),
+            pl.BlockSpec((1, be), lambda ei, di: (0, ei)),
+        ],
+        out_specs=pl.BlockSpec((b, be), lambda ei, di: (0, ei)),
+        out_shape=jax.ShapeDtypeStruct((b, e), x.dtype),
+        scratch_shapes=[pltpu.VMEM((b, be), jnp.float32)],
+        interpret=interpret,
+        **kw,
+    )(x, q.astype(jnp.int8), s.reshape(1, e))

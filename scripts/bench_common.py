@@ -60,3 +60,47 @@ def train_tokens_per_sec(*, attn_impl: str, remat: bool, remat_policy,
         float(jax.device_get(loss))
         best = min(best, time.perf_counter() - t0)
     return batch * gas * seq * steps / best
+
+
+RESULT_TAG = "PHASE_RESULT:"
+
+
+def emit_phase_result(result) -> None:
+    import json
+
+    print(RESULT_TAG + json.dumps(result), flush=True)
+
+
+def run_phase_isolated(script_path, name, attempts=3, timeout=2400):
+    """Run `python script_path --phase name` in fresh subprocesses until one
+    succeeds (emits a RESULT_TAG line). The tunneled chip is shared: a
+    transient RESOURCE_EXHAUSTED from a co-tenant's allocation poisons the
+    whole JAX client, so in-process retries are useless — each attempt
+    needs a clean process (see .claude/skills/verify/SKILL.md, axon
+    gotchas)."""
+    import json
+    import subprocess
+    import sys
+    import time
+
+    last = None
+    for attempt in range(attempts):
+        try:
+            proc = subprocess.run(
+                [sys.executable, script_path, "--phase", name],
+                capture_output=True, text=True, timeout=timeout)
+        except subprocess.TimeoutExpired:
+            last = f"timeout after {timeout}s"
+        else:
+            for line in proc.stdout.splitlines():
+                if line.startswith(RESULT_TAG):
+                    out = json.loads(line[len(RESULT_TAG):])
+                    print(f"[{name}] attempt {attempt}: ok", flush=True)
+                    return out
+            tail = (proc.stdout + proc.stderr)[-600:]
+            last = (f"rc={proc.returncode}: "
+                    f"{tail.splitlines()[-1] if tail else ''}")
+        print(f"[{name}] attempt {attempt} failed: {last}", flush=True)
+        if attempt + 1 < attempts:
+            time.sleep(15)  # give the co-tenant's spike a beat to clear
+    return {"error": f"all {attempts} attempts failed; last: {str(last)[:300]}"}

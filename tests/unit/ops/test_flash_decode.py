@@ -1,0 +1,58 @@
+"""Flash-decode kernel tests (interpret mode on CPU — the same kernel
+lines the TPU serving path runs). Numerics vs the einsum reference
+``ops/attention.decode_attention`` computes on non-TPU backends."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepspeed_tpu.ops.attention import decode_attention
+from deepspeed_tpu.ops.flash_decode import flash_decode
+
+pytestmark = pytest.mark.quick
+
+
+def mk(b, hq, hkv, s_max, dh, idx, seed=0):
+    rng = np.random.RandomState(seed)
+    q = jnp.asarray(rng.randn(b, 1, hq, dh), jnp.float32) * 0.5
+    # positions beyond idx hold garbage — the mask must exclude them
+    k = jnp.asarray(rng.randn(b, hkv, s_max, dh), jnp.float32)
+    v = jnp.asarray(rng.randn(b, hkv, s_max, dh), jnp.float32)
+    return q, k, v
+
+
+@pytest.mark.parametrize("b,hq,hkv,idx", [(1, 4, 4, 17), (8, 4, 4, 63),
+                                          (2, 8, 2, 30)])
+def test_matches_einsum_reference(b, hq, hkv, idx):
+    s_max, dh = 64, 16
+    q, k, v = mk(b, hq, hkv, s_max, dh, idx)
+    ref = decode_attention(q, k, v, jnp.int32(idx))  # einsum path on CPU
+    out = flash_decode(q, k, v, jnp.int32(idx), block_s=16)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-4, atol=2e-4)
+
+
+def test_mask_excludes_future_positions():
+    """Garbage beyond cache_index must not leak into the output."""
+    b, hq, hkv, s_max, dh, idx = 1, 2, 2, 64, 16, 9
+    q, k, v = mk(b, hq, hkv, s_max, dh, idx, seed=1)
+    out1 = flash_decode(q, k, v, jnp.int32(idx), block_s=16)
+    # overwrite everything past idx with huge values
+    k2 = k.at[:, :, idx + 1:].set(1e4)
+    v2 = v.at[:, :, idx + 1:].set(-1e4)
+    out2 = flash_decode(q, k2, v2, jnp.int32(idx), block_s=16)
+    np.testing.assert_allclose(np.asarray(out1), np.asarray(out2),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_traced_index_under_jit():
+    b, hq, hkv, s_max, dh = 2, 4, 4, 64, 16
+    q, k, v = mk(b, hq, hkv, s_max, dh, 0, seed=2)
+
+    f = jax.jit(lambda q, k, v, i: flash_decode(q, k, v, i, block_s=16))
+    for idx in (3, 40, 63):
+        ref = decode_attention(q, k, v, jnp.int32(idx))
+        out = f(q, k, v, jnp.int32(idx))
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=2e-4, atol=2e-4)
