@@ -155,7 +155,7 @@ def _bench_serving(on_tpu: bool):
     out = {"prompt_len": prompt_len, "decode_len": decode_len,
            "batch": 1, "trials": trials}
 
-    def measure(dtype, batch):
+    def measure(dtype, batch, with_prefill=True):
         groups.reset()
         long_new = decode_len + 1
         short_new = max(2, long_new // 8)
@@ -165,14 +165,17 @@ def _bench_serving(on_tpu: bool):
         engine.generate(fresh(batch), max_new_tokens=short_new)
         engine.generate(fresh(batch), max_new_tokens=long_new)
         temp = jnp.float32(1.0)
-        # prefill: API-level latency through generate (includes dispatch)
         pf_ts = []
-        for _ in range(trials):
-            ids = fresh(batch)
-            t0 = time.perf_counter()
-            engine.generate(ids, max_new_tokens=1)
-            pf_ts.append(time.perf_counter() - t0)
-        pf_ts.sort()
+        if with_prefill:
+            # prefill: API latency through generate (includes dispatch);
+            # warm its program first so trial 0 doesn't time a compile
+            engine.generate(fresh(batch), max_new_tokens=1)
+            for _ in range(trials):
+                ids = fresh(batch)
+                t0 = time.perf_counter()
+                engine.generate(ids, max_new_tokens=1)
+                pf_ts.append(time.perf_counter() - t0)
+            pf_ts.sort()
         # decode: dual-length differencing on the compiled decode programs
         # (long minus short cancels the ~90-110 ms per-dispatch relay
         # constant; both lengths share one 128-padded KV allocation so the
@@ -194,10 +197,10 @@ def _bench_serving(on_tpu: bool):
             med[mn] = ts[len(ts) // 2]
         per_tok = (med[long_new] - med[short_new]) / (long_new - short_new)
         del engine
-        entry = {
-            "prefill_p50_ms": round(pf_ts[len(pf_ts) // 2] * 1e3, 2),
-            "prefill_best_ms": round(pf_ts[0] * 1e3, 2),
-        }
+        entry = {}
+        if pf_ts:
+            entry["prefill_p50_ms"] = round(pf_ts[len(pf_ts) // 2] * 1e3, 2)
+            entry["prefill_best_ms"] = round(pf_ts[0] * 1e3, 2)
         if per_tok > 0:
             entry["decode_ms_per_token"] = round(per_tok * 1e3, 3)
             entry["decode_tokens_per_sec"] = round(batch / per_tok, 1)
@@ -208,7 +211,7 @@ def _bench_serving(on_tpu: bool):
 
     for name in ("bf16", "int8"):
         entry = measure(name, 1)
-        b8 = measure(name, 8)
+        b8 = measure(name, 8, with_prefill=False)
         entry["batch8_decode_tokens_per_sec"] = b8["decode_tokens_per_sec"]
         entry["batch8_decode_ms_per_token"] = b8["decode_ms_per_token"]
         out[name] = entry

@@ -102,10 +102,14 @@ def qdot(eq, x, w):
             n_rows *= dim
         if (std_form and q.ndim == 2 and n_rows <= 32
                 and jax.default_backend() == "tpu"):
-            from deepspeed_tpu.ops.int8_matmul import int8_matmul
+            from deepspeed_tpu.ops.int8_matmul import int8_matmul, plan_blocks
 
-            out2d = int8_matmul(x.reshape(n_rows, x.shape[-1]), q, s)
-            return out2d.reshape(x.shape[:-1] + (q.shape[1],))
+            # only when the tiling plan is a few fat cells (per-cell
+            # overhead otherwise erases the bandwidth win — measured a
+            # net regression at 6.7B, see plan_blocks)
+            if plan_blocks(q.shape[0], q.shape[1])[2] <= 4:
+                out2d = int8_matmul(x.reshape(n_rows, x.shape[-1]), q, s)
+                return out2d.reshape(x.shape[:-1] + (q.shape[1],))
         out = jnp.einsum(eq, x, q.astype(x.dtype))
         return out * s.reshape((1,) * (out.ndim - 1) + (-1,)).astype(x.dtype)
     return jnp.einsum(eq, x, w.astype(x.dtype))

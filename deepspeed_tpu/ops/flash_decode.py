@@ -22,6 +22,16 @@ GPT-2-125M batch-8 decode (round 4):
 GQA native: q heads grouped per kv head ([rep, Dh] q tile against the
 [S, Dh] cache of their shared kv head). Serving-only: no VJP (training
 uses ops/flash_attention.py).
+
+Status per variant (round-4 measurements, PROFILE_DECODE.md):
+  * wide-GQA (rep >= 8) MXU-slab kernel — the PRODUCTION route
+    (ops/attention.decode_attention gates on rep).
+  * MHA head-batched VPU kernel (``_mha_kernel``) — measured SLOWER than
+    the XLA einsum it would replace (1.94 vs 1.42 ms/tok at 125M B=8)
+    because the decode loop's cache carry is laid out for einsum lane
+    parallelism and the pallas operand pays a relayout copy per step.
+    Kept test-covered but UNROUTED, pending carry-layout control
+    (round 5); delete it instead if that lever never lands.
 """
 
 from __future__ import annotations

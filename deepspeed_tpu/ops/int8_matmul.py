@@ -78,6 +78,19 @@ def _divisor_block(n: int, quantum: int, cap: int) -> int:
     return max(blk, 1)
 
 
+def plan_blocks(d: int, e: int):
+    """(bd, be, grid_cells) for a [D, E] weight. Callers (models/base.qdot)
+    only route through the kernel when the plan is a FEW fat cells:
+    per-grid-cell overhead measured ~2 us, which erases the int8 bandwidth
+    win once divisor-hostile dims shatter the grid (LLaMA's 11008 = 2^8*43
+    yields 256-wide blocks -> ~2000 cells/step at 6.7B, a net regression
+    vs the einsum). A manual-DMA whole-matmul kernel removes the per-cell
+    cost and is the round-5 path."""
+    be = _divisor_block(e, 128, MAX_BLOCK_E)
+    bd = _divisor_block(d, 128, max(MAX_TILE_BYTES // be, 512))
+    return bd, be, (d // bd) * (e // be)
+
+
 @functools.partial(jax.jit, static_argnames=("interpret",))
 def int8_matmul(x: jax.Array, q: jax.Array, s: jax.Array,
                 interpret: Optional[bool] = None) -> jax.Array:
@@ -90,8 +103,9 @@ def int8_matmul(x: jax.Array, q: jax.Array, s: jax.Array,
     d2, e = q.shape
     assert d == d2, (x.shape, q.shape)
     s = s.reshape(e)
-    be = _divisor_block(e, 128, MAX_BLOCK_E)
-    bd = _divisor_block(d, 8, max(MAX_TILE_BYTES // be, 512))
+    # bd is BOTH x's last dim block (must be 128-divisible) and the weight
+    # block's sublane dim — plan_blocks uses quantum 128 for either
+    bd, be, _cells = plan_blocks(d, e)
     nd, ne = d // bd, e // be
     if interpret is None:
         interpret = jax.default_backend() != "tpu"

@@ -55,14 +55,40 @@ def child():
         "gradient_clipping": 1.0,
         "steps_per_print": 0,
     })
+    import time as _time
+
+    import jax
+
+    # the engine's own ThroughputTimer wraps the (async) train_batch CALL,
+    # so on this relay it self-reports dispatch rate — physically
+    # impossible numbers (36M tokens/sec observed). The child therefore
+    # owns the measurement: value-fenced steps, steps 3+ timed, and it
+    # writes the result file itself (the engine hook is disarmed by
+    # removing the env var it checks).
+    result_path = os.environ.pop("DSTPU_AUTOTUNING_RESULT", None)
     mb = engine.config.train_micro_batch_size_per_gpu
     rng = np.random.RandomState(0)
-    for _ in range(12):  # engine exits itself at global step 5
+
+    def step():
         ids = rng.randint(0, cfg.vocab_size,
                           size=(GAS, mb, SEQ + 1)).astype(np.int32)
-        engine.train_batch_from_stacked(
+        loss = engine.train_batch_from_stacked(
             {"input_ids": ids[:, :, :-1], "labels": ids[:, :, 1:]})
-    raise SystemExit("engine did not self-report after 12 steps")
+        float(jax.device_get(loss))
+
+    for _ in range(3):      # compile + warm
+        step()
+    n = 4
+    t0 = _time.perf_counter()
+    for _ in range(n):
+        step()
+    dt = _time.perf_counter() - t0
+    samples_per_sec = n * mb * GAS / dt
+    if result_path:
+        with open(result_path, "w") as f:
+            json.dump({"metric": samples_per_sec,
+                       "unit": "samples/sec (value-fenced)"}, f)
+    raise SystemExit(0)
 
 
 def analytic_estimates():
@@ -122,14 +148,19 @@ def main():
     env["JAX_PLATFORMS"] = "cpu"
     env["DSTPU_ACCELERATOR"] = "cpu"
     env["PYTHONPATH"] = _REPO + os.pathsep + env.get("PYTHONPATH", "")
-    proc = subprocess.run(
-        [sys.executable, os.path.abspath(__file__), "--analytic"],
-        env=env, capture_output=True, text=True, timeout=1800)
     est = {}
-    for line in proc.stdout.splitlines():
-        if line.startswith("ANALYTIC_JSON "):
-            for stage, mb, v in json.loads(line[len("ANALYTIC_JSON "):]):
-                est[(stage, mb)] = v
+    try:
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--analytic"],
+            env=env, capture_output=True, text=True, timeout=1800)
+        for line in proc.stdout.splitlines():
+            if line.startswith("ANALYTIC_JSON "):
+                for stage, mb, v in json.loads(line[len("ANALYTIC_JSON "):]):
+                    est[(stage, mb)] = v
+    except Exception as e:
+        # never discard the on-chip measurements because the CPU cost-model
+        # pass hung/crashed — rank correlation just degrades to null
+        print(f"[analytic] failed: {type(e).__name__}: {e}", flush=True)
     for t in trials:
         t["analytic_tokens_per_sec"] = est.get(
             (t["zero_stage"], t["micro_batch"]))
@@ -155,10 +186,15 @@ def main():
         "best_measured": best,
         "spearman_rank_correlation_analytic_vs_measured": rho,
         "note": "measured via the CLI's subprocess experiment contract "
-                "(DSTPU_AUTOTUNING_CONFIG/RESULT; engine self-reports at "
-                "step 5). Analytic numbers are the cost model's ABSOLUTE "
-                "estimates — known to be optimistic (no dispatch/bubble "
-                "model); the rank correlation is the dogfood question.",
+                "(DSTPU_AUTOTUNING_CONFIG/RESULT); each child times "
+                "value-fenced steps itself (async dispatch makes timer-"
+                "bracketed dispatch rates physically impossible — "
+                "PROFILE_DECODE.md methodology). Analytic numbers are the "
+                "cost model's ABSOLUTE estimates — known optimistic (no "
+                "dispatch/bubble model); the rank correlation is the "
+                "dogfood question. measured_tokens_per_sec includes the "
+                "per-step fence (~0.1s), so it under-reads the async "
+                "pipeline rate bench.py measures (93.5k at stage0/mb8).",
     }
     with open(os.path.join(_REPO, "AUTOTUNE_125M_MEASURED.json"), "w") as f:
         json.dump(out, f, indent=1)

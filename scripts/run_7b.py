@@ -68,6 +68,7 @@ def serve_phase(dtype):
     engine = deepspeed_tpu.init_inference(
         LlamaModel(cfg), dtype=dtype,
         max_out_tokens=prompt_len + long_new)
+    engine.generate(fresh(), max_new_tokens=1)  # warm the prefill program
     engine.generate(fresh(), max_new_tokens=short_new)
     engine.generate(fresh(), max_new_tokens=long_new)
     build_s = time.perf_counter() - t0
