@@ -23,7 +23,7 @@ def mk(b, hq, hkv, s_max, dh, idx, seed=0):
 
 
 @pytest.mark.parametrize("b,hq,hkv,idx", [(1, 4, 4, 17), (8, 4, 4, 63),
-                                          (2, 8, 2, 30)])
+                                          (2, 8, 2, 30), (2, 16, 2, 45)])
 def test_matches_einsum_reference(b, hq, hkv, idx):
     s_max, dh = 64, 16
     q, k, v = mk(b, hq, hkv, s_max, dh, idx)
