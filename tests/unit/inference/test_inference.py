@@ -100,6 +100,30 @@ def test_sampling_reproducible_and_topk():
     assert a.shape == c.shape == (1, 12)
 
 
+def test_compiled_programs_accessor_and_kv_padding():
+    """compiled_programs() exposes the exact prefill/decode programs
+    generate() uses (benches time them directly — PROFILE_DECODE.md), and
+    the KV allocation pads to a multiple of 128 (flash-decode tiling)
+    while masking keeps padded positions inert: the accessor-driven
+    two-program path must reproduce generate()'s tokens exactly."""
+    groups.reset()
+    cfg = GPT2Config.tiny()
+    engine = deepspeed_tpu.init_inference(GPT2Model(cfg), dtype="bf16",
+                                          max_out_tokens=40)
+    ids = np.random.RandomState(3).randint(0, cfg.vocab_size,
+                                           size=(2, 8)).astype(np.int32)
+    ref = engine.generate(ids, max_new_tokens=6)
+    pf, dec = engine.compiled_programs(2, 8, 6)
+    tok, cache, rng = pf(engine.params, jnp.asarray(ids),
+                         jnp.float32(1.0), jax.random.PRNGKey(0))
+    # padded cache: every cache leaf's sequence dim is a multiple of 128
+    for leaf in jax.tree_util.tree_leaves(cache):
+        if getattr(leaf, "ndim", 0) >= 4:
+            assert leaf.shape[-2] % 128 == 0, leaf.shape
+    toks = dec(engine.params, tok, cache, jnp.float32(1.0), rng)
+    np.testing.assert_array_equal(np.asarray(toks), ref[:, 8:])
+
+
 def test_max_tokens_guard():
     engine = make_engine(GPT2Model(GPT2Config.tiny(), compute_dtype=jnp.float32),
                          max_out_tokens=16)
