@@ -21,7 +21,7 @@ import jax
 import jax.numpy as jnp
 
 from deepspeed_tpu.models.base import ATTN_IMPLS, cross_entropy_loss, gelu, layer_norm, qdot, sp_attention
-from deepspeed_tpu.ops.attention import decode_attention, multihead_attention, write_kv_cache
+from deepspeed_tpu.ops.attention import alloc_kv_cache, cached_attention, multihead_attention
 
 
 @dataclasses.dataclass
@@ -178,8 +178,7 @@ class GPT2Model:
             kc = vc = None
         else:
             kc, vc, layer, idx = cache
-            kc, vc, kl, vl = write_kv_cache(kc, vc, k_, v_, layer, idx)
-            attn = decode_attention(q, kl, vl, idx)
+            attn, kc, vc = cached_attention(q, kc, vc, k_, v_, layer, idx)
         attn = attn.reshape(b, t, d)
         x = x + qdot("btd,de->bte", attn, blk["attn_out_w"]) + \
             blk["attn_out_b"].astype(x.dtype)
@@ -265,11 +264,14 @@ class GPT2Model:
     def init_cache(self, batch_size: int, max_len: int, dtype=None):
         """Static-shape KV cache (the inference_context.h workspace analog —
         reference csrc/transformer/inference/includes/inference_context.h).
-        Head-major layout [L, B, H, S, Dh] — see ops/attention.decode_attention."""
+        Head-major, token-pair packed for Dh < 128 — see
+        ops/attention.kv_pack_factor / decode_attention."""
         c = self.config
         dtype = dtype or self.compute_dtype
-        shape = (c.num_layers, batch_size, c.num_heads, max_len, c.head_dim)
-        return {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype),
+        return {"k": alloc_kv_cache(c.num_layers, batch_size, c.num_heads,
+                                    max_len, c.head_dim, dtype),
+                "v": alloc_kv_cache(c.num_layers, batch_size, c.num_heads,
+                                    max_len, c.head_dim, dtype),
                 "index": jnp.zeros((), jnp.int32)}
 
     def _block_cached(self, x, blk, kc, vc, layer, idx):

@@ -18,7 +18,7 @@ import jax.numpy as jnp
 
 from deepspeed_tpu.models.base import cross_entropy_loss, gelu, layer_norm
 from deepspeed_tpu.moe.layer import MoE
-from deepspeed_tpu.ops.attention import decode_attention, multihead_attention, write_kv_cache
+from deepspeed_tpu.ops.attention import alloc_kv_cache, cached_attention, multihead_attention
 
 
 @dataclasses.dataclass
@@ -137,8 +137,7 @@ class GPTMoEModel:
             kc = vc = None
         else:
             kc, vc, layer, idx = cache
-            kc, vc, kl, vl = write_kv_cache(kc, vc, k_, v_, layer, idx)
-            attn = decode_attention(q, kl, vl, idx)
+            attn, kc, vc = cached_attention(q, kc, vc, k_, v_, layer, idx)
         x = x + attn.reshape(b, t, d) @ blk["out_w"].astype(x.dtype) + \
             blk["out_b"].astype(x.dtype)
         return x, kc, vc
@@ -195,12 +194,15 @@ class GPTMoEModel:
 
     # --------------------------------------------------------- inference path
     def init_cache(self, batch_size: int, max_len: int, dtype=None):
-        """Static-shape stacked KV cache, head-major [L, B, H, S, Dh] (same
-        layout as the dense families; ops/attention.decode_attention)."""
+        """Static-shape stacked KV cache, head-major, token-pair packed for
+        Dh < 128 (same layout as the dense families;
+        ops/attention.kv_pack_factor)."""
         c = self.config
         dtype = dtype or self.compute_dtype
-        shape = (c.num_layers, batch_size, c.num_heads, max_len, c.head_dim)
-        return {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype),
+        return {"k": alloc_kv_cache(c.num_layers, batch_size, c.num_heads,
+                                    max_len, c.head_dim, dtype),
+                "v": alloc_kv_cache(c.num_layers, batch_size, c.num_heads,
+                                    max_len, c.head_dim, dtype),
                 "index": jnp.zeros((), jnp.int32)}
 
     def forward_with_cache(self, params, input_ids, cache):

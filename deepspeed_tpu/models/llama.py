@@ -15,7 +15,7 @@ import jax
 import jax.numpy as jnp
 
 from deepspeed_tpu.models.base import ATTN_IMPLS, cross_entropy_loss, qdot, rms_norm, sp_attention  # noqa: E501
-from deepspeed_tpu.ops.attention import decode_attention, multihead_attention, write_kv_cache
+from deepspeed_tpu.ops.attention import alloc_kv_cache, cached_attention, multihead_attention
 from deepspeed_tpu.ops.rotary import apply_rotary_pos_emb, rope_frequencies
 
 
@@ -149,8 +149,7 @@ class LlamaModel:
             kc = vc = None
         else:
             kc, vc, layer, idx = cache
-            kc, vc, kl, vl = write_kv_cache(kc, vc, k_, v_, layer, idx)
-            attn = decode_attention(q, kl, vl, idx)
+            attn, kc, vc = cached_attention(q, kc, vc, k_, v_, layer, idx)
         x = x + qdot("bte,ed->btd", attn.reshape(b, t, hq * dh), blk["wo"])
         y = rms_norm(x, blk["mlp_norm"], c.eps)
         gate = jax.nn.silu(qdot("btd,dm->btm", y, blk["w_gate"]))
@@ -192,12 +191,16 @@ class LlamaModel:
     # --------------------------------------------------------- inference path
     def init_cache(self, batch_size: int, max_len: int, dtype=None):
         """Static-shape GQA KV cache — stores num_kv_heads only (the grouped
-        query repeat happens inside decode_attention). Sequence-minor layout
-        [L, B, Hkv, S, Dh] — see ops/attention.decode_attention."""
+        query repeat happens inside decode_attention). Head-major,
+        token-pair packed for Dh < 128 — see ops/attention.kv_pack_factor."""
         c = self.config
         dtype = dtype or self.compute_dtype
-        shape = (c.num_layers, batch_size, c.num_kv_heads, max_len, c.head_dim)
-        return {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype),
+        return {"k": alloc_kv_cache(c.num_layers, batch_size,
+                                    c.num_kv_heads, max_len, c.head_dim,
+                                    dtype),
+                "v": alloc_kv_cache(c.num_layers, batch_size,
+                                    c.num_kv_heads, max_len, c.head_dim,
+                                    dtype),
                 "index": jnp.zeros((), jnp.int32)}
 
     def _block_cached(self, x, blk, kc, vc, layer, idx, cos, sin):

@@ -29,7 +29,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from deepspeed_tpu.models.base import cross_entropy_loss, gelu, layer_norm, qdot
-from deepspeed_tpu.ops.attention import decode_attention, multihead_attention, write_kv_cache
+from deepspeed_tpu.ops.attention import alloc_kv_cache, cache_seq_len, cached_attention, multihead_attention
 from deepspeed_tpu.ops.rotary import apply_rotary_pos_emb, rope_frequencies
 
 
@@ -297,7 +297,7 @@ class DecoderModel:
             kc = vc = None
         else:
             kc, vc, layer, _ = cache
-            s_max = kc.shape[3]  # head-major [L, B, H, S, Dh]
+            s_max = cache_seq_len(kc, c.head_dim)
             dec_bias = None
             if c.alibi:
                 dec_bias = self._alibi[:, None] * jnp.arange(
@@ -305,9 +305,9 @@ class DecoderModel:
             window = None
             if local_flag is not None:
                 window = jnp.where(local_flag, c.local_attn_window, s_max + 1)
-            kc, vc, kl, vl = write_kv_cache(kc, vc, k_, v_, layer, idx)
-            attn = decode_attention(q, kl, vl, idx, bias=dec_bias,
-                                    scale=c.qk_scale, window=window)
+            attn, kc, vc = cached_attention(q, kc, vc, k_, v_, layer, idx,
+                                            bias=dec_bias, scale=c.qk_scale,
+                                            window=window)
         attn = attn.reshape(b, t, d)
         attn_out = qdot("btd,de->bte", attn, blk["attn_out_w"]) + \
             blk["attn_out_b"].astype(x.dtype)
@@ -402,11 +402,19 @@ class DecoderModel:
 
     # --------------------------------------------------------- inference path
     def init_cache(self, batch_size: int, max_len: int, dtype=None):
-        # head-major [L, B, H, S, Dh] — see ops/attention.decode_attention
+        # head-major, token-pair packed for Dh < 128 — except for models
+        # whose decode always needs the einsum path (ALiBi bias, per-layer
+        # local windows), which keep the plain [L, B, H, S, Dh] form so
+        # every step isn't paying an unpack view (ops/attention.kv_pack_factor)
         c = self.config
         dtype = dtype or self.compute_dtype
-        shape = (c.num_layers, batch_size, c.num_heads, max_len, c.head_dim)
-        return {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype),
+        packed = not (c.alibi or c.attn_layer_pattern)
+        return {"k": alloc_kv_cache(c.num_layers, batch_size, c.num_heads,
+                                    max_len, c.head_dim, dtype,
+                                    packed=packed),
+                "v": alloc_kv_cache(c.num_layers, batch_size, c.num_heads,
+                                    max_len, c.head_dim, dtype,
+                                    packed=packed),
                 "index": jnp.zeros((), jnp.int32)}
 
     def forward_with_cache(self, params, input_ids, cache):

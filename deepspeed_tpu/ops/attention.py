@@ -51,6 +51,88 @@ def multihead_attention(
     return jnp.einsum("bhts,bshd->bthd", probs, v)
 
 
+def kv_pack_factor(head_dim: int) -> int:
+    """Token-pair packing factor for the stacked KV cache. TPU HBM tiles
+    bf16 buffers T(8, 128): a [.., S, Dh] cache with Dh < 128 is
+    lane-PADDED to 128 in HBM (2x the footprint and stream traffic at
+    Dh = 64). Packing ``pair = 128 / Dh`` adjacent tokens into one
+    [.., S/pair, Dh*pair] row keeps the buffer dense and gives the fused
+    decode kernel (ops/decode_step.py) 128-aligned DMA slices."""
+    if head_dim >= 128 or 128 % head_dim:
+        return 1
+    return 128 // head_dim
+
+
+def alloc_kv_cache(num_layers: int, batch: int, num_kv_heads: int,
+                   max_len: int, head_dim: int, dtype, *,
+                   packed: bool = True):
+    """Zeros for one stacked cache tensor (call twice for K and V).
+    Packed shape [L, B, H, S/pair, Dh*pair] unless ``packed=False``
+    (models whose decode always needs the einsum path — ALiBi bias or
+    per-layer windows — keep the plain [L, B, H, S, Dh] form). Batch-1
+    caches with Dh < 128 also stay unpacked: there the fused kernel's
+    fixed per-layer overhead loses to the einsum (measured 0.60 vs 0.46
+    ms/tok at 125M B=1), and the allocation shape is what routes
+    :func:`cached_attention`. A ``max_len`` the fused kernel can't
+    stream (not 128-aligned) also stays unpacked — a packed cache the
+    kernel rejects would pay the unpack view EVERY step."""
+    pair = (kv_pack_factor(head_dim)
+            if (packed and batch >= 2 and max_len % 128 == 0) else 1)
+    assert max_len % max(pair, 1) == 0, (max_len, pair)
+    return jnp.zeros((num_layers, batch, num_kv_heads, max_len // pair,
+                      head_dim * pair), dtype)
+
+
+def cache_seq_len(k_full, head_dim: int) -> int:
+    """Max sequence length of a (possibly packed) stacked cache."""
+    return k_full.shape[3] * (k_full.shape[4] // head_dim)
+
+
+def cached_attention(q, k_full, v_full, k_new, v_new, layer, idx, *,
+                     scale=None, bias=None, window=None):
+    """One cached-attention layer step: write the new block's K/V into the
+    full stacked [L, B, Hkv, S, Dh] caches (possibly token-pair packed,
+    see :func:`kv_pack_factor`), attend, return ``(attn, k_full, v_full)``.
+
+    Single-token decode on TPU routes to the fused Pallas step
+    (ops/decode_step.py): the kernel owns BOTH the cache write and the
+    streaming read, so XLA keeps the decode loop's cache carry in the
+    default streaming-friendly layout instead of the einsum-oriented one
+    a ``dynamic_update_slice`` write anchors (round-4 root cause of
+    batch-8 decode at half its roofline — PROFILE_DECODE.md). Everything
+    else (prefill blocks, ALiBi bias, sliding windows, CPU) takes the
+    einsum path, view-unpacking packed caches first."""
+    t = q.shape[1]
+    dh = q.shape[3]
+    pair = k_full.shape[4] // dh
+    if (t == 1 and bias is None and window is None
+            and jax.default_backend() == "tpu"
+            # the allocation shape routes: an unpacked Dh<128 cache means
+            # alloc_kv_cache decided the einsum path wins (batch 1)
+            and pair == kv_pack_factor(dh)):
+        from deepspeed_tpu.ops.decode_step import fused_decode_step, supports
+
+        if supports(q.shape[2], k_full.shape[2],
+                    k_full.shape[3] * pair, dh):
+            return fused_decode_step(q, k_full, v_full, k_new, v_new,
+                                     layer, idx, scale=scale)
+    if pair > 1:  # unpack for the einsum path (free on CPU; prefill-only
+        # on TPU, where the repack copy is once per generate, not per step)
+        l, b, hkv, sp, dhp = k_full.shape
+        shape = (l, b, hkv, sp * pair, dh)
+        ku, vu, kl, vl = write_kv_cache(
+            k_full.reshape(shape), v_full.reshape(shape), k_new, v_new,
+            layer, idx)
+        attn = decode_attention(q, kl, vl, idx, scale=scale, bias=bias,
+                                window=window)
+        return attn, ku.reshape(k_full.shape), vu.reshape(v_full.shape)
+    k_full, v_full, kl, vl = write_kv_cache(k_full, v_full, k_new, v_new,
+                                            layer, idx)
+    attn = decode_attention(q, kl, vl, idx, scale=scale, bias=bias,
+                            window=window)
+    return attn, k_full, v_full
+
+
 def write_kv_cache(k_full, v_full, k_new, v_new, layer, idx):
     """Write one block's new K/V ([B, T, Hkv, Dh]) into the full stacked
     head-major [L, B, Hkv, S, Dh] caches at (layer, idx) — the per-token

@@ -1,0 +1,336 @@
+"""Fused single-token decode step: KV-cache write + attention, one Pallas
+invocation per layer, manual double-buffered DMA over the full stacked cache.
+
+Reference counterpart: ``softmax_context`` + the inference_context.h KV
+workspace (csrc/transformer/inference/includes/inference_context.h:287 —
+the reference's workspace exists precisely to CONTROL the KV layout that
+its fused decode kernels stream). Here the same control is exercised
+through Pallas: because every access to the decode loop's cache carry is
+a Pallas op (this kernel owns both the write and the read), XLA's layout
+assignment keeps the carry in the default row-major [L, B, H, S, Dh]
+order — each (layer, batch, head) panel's [S, Dh] block contiguous in
+HBM — instead of the einsum-oriented ``{4,2,1,3,0}`` layout it picks when
+a ``dynamic_update_slice`` write anchors the carry (measured round 4:
+that layout S-strides cache reads by 12 KB and capped batch-8 decode at
+2.6x batch-1 vs a ~5x streaming roofline; PROFILE_DECODE.md).
+
+Why manual DMA instead of a gridded ``pallas_call``: the gridded decode
+kernels measured ~2 us of per-grid-cell overhead, which at 125M shapes
+(40 cells/layer) cost 5x more than the cache streaming itself. Here the
+whole layer-step is ONE invocation: a dynamic ``fori_loop`` walks the
+VALID prefix of the cache in token chunks (one strided DMA covers all
+batch rows), double-buffered so the VPU/MXU math overlaps the next
+chunk's fetch, with the online-softmax state in VMEM scratch.
+
+Head-dim handling: Mosaic requires DMA slices of the minor dim to be
+128-aligned, so for Dh < 128 the cache is VIEWED as token-pairs
+``[L, B, Hkv, S/pair, Dh*pair]`` (a free bitcast of the row-major
+buffer; ``pair = 128 // Dh``). Packed sub-tokens are never interleaved
+back: each of the ``pair`` lane slices keeps its own position mask and
+feeds the shared online-softmax state. The new token's write is a
+read-modify-write of the 8-aligned pair-row window (HBM tiling forbids
+single-row writes), a ~100 KB round-trip per layer step.
+
+MHA (rep == 1) scores/PV run as VPU broadcast-multiply + reduce;
+GQA (rep > 1) runs batched MXU ``dot_general`` ([rep, Dh] x [Dh, CS]
+slabs per kv head). Serving-only: no VJP (training uses
+ops/flash_attention.py).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_NEG = float("-inf")
+
+# per-slot chunk budget: 4 chunk buffers live (2 slots x {K, V}) plus the
+# compute temporaries of one chunk must fit the 16 MB/core VMEM. Measured
+# at 125M B=8 (bf16): 1.57 MB chunks compiled to a 16.06 MB stack — 60 KB
+# over the limit — so the budget sits just under that (bg=4, cs=128
+# there: 0.79 MB chunks, 0.91 ms/tok in-engine).
+_CHUNK_BUDGET = 1_500_000  # just under the 1.5 MiB chunk that OOM'd
+
+
+def supports(hq: int, hkv: int, s_max: int, dh: int) -> bool:
+    """Shapes the fused kernel can stream: minor dim must tile to 128
+    (dh a multiple of 128, or dh*pair == 128 with s_max % pair == 0)."""
+    if hq % hkv:
+        return False
+    if dh >= 128:
+        return dh % 128 == 0 and s_max % 128 == 0
+    # s_max % 128 == 0 implies s_max % (128 // dh) == 0 for any dh | 128
+    return 128 % dh == 0 and s_max % 128 == 0
+
+
+def _plan(b: int, hkv: int, s_max: int, dh: int, itemsize: int):
+    """(bg, cs): batch-group and S-chunk (token) sizes. One DMA moves a
+    [bg, hkv, (cs/pair), dh*pair] chunk — exactly bg*hkv*cs*dh elements
+    (the packed view keeps the minor dim >= 128 lanes, so no VMEM lane
+    padding). Prefer covering all of B per DMA (fewer loop iterations,
+    one warmup stall) and the fattest cs that divides s_max."""
+
+    def bytes_of(bg, cs):
+        return bg * hkv * cs * dh * itemsize
+
+    for bg in (b, b // 2, b // 4, b // 8, 1):
+        if bg < 1 or b % max(bg, 1):
+            continue
+        for cs in (512, 256, 128):
+            if s_max % cs == 0 and bytes_of(bg, cs) <= _CHUNK_BUDGET:
+                return bg, cs
+    return 1, 128
+
+
+def _kernel(layer_ref, idx_ref, q_ref, kn_ref, vn_ref, _kin_ref, _vin_ref,
+            attn_ref, k_ref, v_ref,
+            kbuf, vbuf, kwin, vwin, m_ref, l_ref, acc_ref, wsem, rsem,
+            *, b: int, bg: int, cs: int, hq: int, hkv: int, dh: int,
+            pair: int, scale: float):
+    layer = layer_ref[0]
+    idx = idx_ref[0]
+    rep = hq // hkv
+    csp = cs // pair          # pair-rows per chunk
+    dhp = dh * pair           # packed minor dim (>= 128)
+
+    # ---- write the new token's K/V into the cache (in place: k_ref/v_ref
+    # alias the input cache buffers). HBM tiling forbids single-row
+    # writes, so read-modify-write the 8-aligned pair-row window (fetch ->
+    # vector-select insert -> write back). The write is for FUTURE steps
+    # only and runs fully async: this step's attention walk splices the
+    # new token into the loaded chunk IN-REGISTER (see `body`), so no
+    # read waits on the write-back (a serialized RMW measured +0.13
+    # ms/tok at B=1 — pure DMA latency, 12 layers x 4 chained waits).
+    w0 = (idx // pair // 8) * 8
+    fk = pltpu.make_async_copy(
+        k_ref.at[layer, :, :, pl.ds(w0, 8), :], kwin, wsem.at[0])
+    fv = pltpu.make_async_copy(
+        v_ref.at[layer, :, :, pl.ds(w0, 8), :], vwin, wsem.at[1])
+    fk.start()
+    fv.start()
+
+    def finish_write():
+        """Insert the token into the fetched window and write it back —
+        called after the first chunk DMAs are in flight."""
+        fk.wait()
+        fv.wait()
+        row = idx // pair - w0
+        half = idx - (idx // pair) * pair
+        sel = (jax.lax.broadcasted_iota(
+            jnp.int32, (b, hkv, 8, dhp), 2) == row)
+        if pair > 1:
+            sel &= (jax.lax.broadcasted_iota(
+                jnp.int32, (b, hkv, 8, dhp), 3) // dh == half)
+        kwin[...] = jnp.where(sel, kn_ref[...], kwin[...])
+        vwin[...] = jnp.where(sel, vn_ref[...], vwin[...])
+        pltpu.make_async_copy(
+            kwin, k_ref.at[layer, :, :, pl.ds(w0, 8), :], wsem.at[0]).start()
+        pltpu.make_async_copy(
+            vwin, v_ref.at[layer, :, :, pl.ds(w0, 8), :], wsem.at[1]).start()
+
+    nchunks = idx // cs + 1  # valid-prefix walk: dead chunks never fetched
+
+    for g in range(b // bg):  # static unroll over batch groups
+        b0 = g * bg
+
+        def chunk_dma(slot, c, src, buf, t):
+            return pltpu.make_async_copy(
+                src.at[layer, pl.ds(b0, bg), :, pl.ds(c * csp, csp), :],
+                buf.at[slot], rsem.at[slot, t])
+
+        m_ref[...] = jnp.full_like(m_ref, _NEG)
+        l_ref[...] = jnp.zeros_like(l_ref)
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+        chunk_dma(0, 0, k_ref, kbuf, 0).start()
+        chunk_dma(0, 0, v_ref, vbuf, 1).start()
+        if g == 0:
+            finish_write()  # overlaps with chunk 0's flight
+        qv = q_ref[pl.ds(b0, bg)]                    # [bg, Hq, 1, Dh] bf16
+        # (the unit dim comes pre-shaped from the wrapper: Mosaic cannot
+        # reshape bf16 vectors to add one before the minor dim)
+
+        def body(c, _, splice=False):
+            slot = jax.lax.rem(c, 2)
+            nxt = 1 - slot
+
+            @pl.when(c + 1 < nchunks)
+            def _prefetch():
+                chunk_dma(nxt, c + 1, k_ref, kbuf, 0).start()
+                chunk_dma(nxt, c + 1, v_ref, vbuf, 1).start()
+
+            chunk_dma(slot, c, k_ref, kbuf, 0).wait()
+            chunk_dma(slot, c, v_ref, vbuf, 1).wait()
+
+            kc = kbuf[slot]                         # [bg, Hkv, CSP, Dh*pair]
+            vc = vbuf[slot]                         # bf16: products run in
+            # bf16 with f32 accumulation — the same precision contract as
+            # the einsum path's MXU (bf16 multiply, f32 accumulate); a full
+            # f32 materialization of both chunks measured ~2x the VPU time
+            if splice:
+                # in-register splice of the new token (its async cache
+                # write may still be in flight; every other row is
+                # unchanged, so a read/write race can only return
+                # identical bytes). Only the final chunk contains idx —
+                # the prefix walk never pays this vector work.
+                rowg = c * csp + jax.lax.broadcasted_iota(
+                    jnp.int32, (bg, hkv, csp, dhp), 2)
+                spl = rowg == idx // pair
+                if pair > 1:
+                    spl &= (jax.lax.broadcasted_iota(
+                        jnp.int32, (bg, hkv, csp, dhp), 3) // dh
+                            == idx - (idx // pair) * pair)
+                kc = jnp.where(spl, kn_ref[pl.ds(b0, bg)], kc)
+                vc = jnp.where(spl, vn_ref[pl.ds(b0, bg)], vc)
+            # scores for each packed lane slice (its own position stream)
+            ss = []
+            for h in range(pair):
+                k = kc[..., h * dh:(h + 1) * dh]    # [bg, Hkv, CSP, Dh]
+                if rep == 1:
+                    s = jnp.sum(qv * k, -1,
+                                dtype=jnp.float32)         # VPU [bg, H, CSP]
+                else:
+                    qg = qv.reshape(bg * hkv, rep, dh)     # 1 batch dim
+                    kg = k.reshape(bg * hkv, csp, dh)      # (Mosaic limit)
+                    s = jax.lax.dot_general(               # MXU
+                        qg, kg, (((2,), (2,)), ((0,), (0,))),
+                        preferred_element_type=jnp.float32)
+                    s = s.reshape(bg, hq, csp)
+                s = s * scale
+                pos = c * cs + pair * jax.lax.broadcasted_iota(
+                    jnp.int32, s.shape, 2) + h
+                ss.append(jnp.where(pos <= idx, s, _NEG))
+
+            m_prev = m_ref[...]                            # [bg, Hq]
+            m_new = m_prev
+            for s in ss:
+                m_new = jnp.maximum(m_new, s.max(-1))
+            corr = jnp.exp(m_prev - m_new)
+            l_new = l_ref[...] * corr
+            acc = acc_ref[...] * corr[:, :, None]
+            for h, s in enumerate(ss):
+                p = jnp.exp(s - m_new[:, :, None])
+                l_new = l_new + p.sum(-1)
+                v = vc[..., h * dh:(h + 1) * dh]
+                if rep == 1:
+                    pb = p[:, :, :, None].astype(v.dtype)  # None-insert in
+                    # f32 (bf16 unit-dim reshape is unsupported), cast after
+                    pv = jnp.sum(pb * v, 2,
+                                 dtype=jnp.float32)        # VPU [bg, H, Dh]
+                else:
+                    pg = p.reshape(bg * hkv, rep, csp).astype(v.dtype)
+                    vg = v.reshape(bg * hkv, csp, dh)
+                    pv = jax.lax.dot_general(              # MXU
+                        pg, vg, (((2,), (1,)), ((0,), (0,))),
+                        preferred_element_type=jnp.float32)
+                    pv = pv.reshape(bg, hq, dh)
+                acc = acc + pv
+            l_ref[...] = l_new
+            acc_ref[...] = acc
+            m_ref[...] = m_new
+            return 0
+
+        jax.lax.fori_loop(0, nchunks - 1, body, 0)
+        body(nchunks - 1, 0, splice=True)
+        l_safe = jnp.maximum(l_ref[...], 1e-20)
+        attn_ref[pl.ds(b0, bg)] = (acc_ref[...] / l_safe[:, :, None]) \
+            .astype(attn_ref.dtype)
+
+    # drain the async write-back before the kernel exits
+    pltpu.make_async_copy(
+        kwin, k_ref.at[layer, :, :, pl.ds(w0, 8), :], wsem.at[0]).wait()
+    pltpu.make_async_copy(
+        vwin, v_ref.at[layer, :, :, pl.ds(w0, 8), :], wsem.at[1]).wait()
+
+
+def fused_decode_step(q: jax.Array, k_full: jax.Array, v_full: jax.Array,
+                      k_new: jax.Array, v_new: jax.Array,
+                      layer, idx, *, scale: Optional[float] = None,
+                      interpret: Optional[bool] = None):
+    """One decode layer-step against the FULL stacked cache.
+
+    q:            [B, 1, Hq, Dh]  — the new token's queries
+    k_full/v_full:[L, B, Hkv, S, Dh] head-major stacked caches (carry)
+    k_new/v_new:  [B, 1, Hkv, Dh]  — the new token's K/V (not yet written)
+    layer, idx:   scalar int32 — layer index / first free cache position
+
+    Returns ``(attn [B, 1, Hq, Dh], k_full, v_full)`` with the caches
+    updated in place (the returned caches alias the inputs).
+    """
+    b, t, hq, dh = q.shape
+    assert t == 1, "fused_decode_step is the single-token path"
+    l, _, hkv, s_rows, d_last = k_full.shape
+    pair = d_last // dh          # caller may pass an already-packed cache
+    s_max = s_rows * pair
+    assert supports(hq, hkv, s_max, dh), (hq, hkv, s_max, dh)
+    assert pair in (1, 128 // dh if dh < 128 else 1), (d_last, dh)
+    want_pair = 128 // dh if dh < 128 else 1
+    sc = float(scale) if scale is not None else dh ** -0.5
+    bg, cs = _plan(b, hkv, s_max, dh, jnp.dtype(k_full.dtype).itemsize)
+
+    qf = q.transpose(0, 2, 1, 3)                   # [B, Hq, 1, Dh]
+    kn = k_new.transpose(0, 2, 1, 3)               # [B, Hkv, 1, Dh]
+    vn = v_new.transpose(0, 2, 1, 3)
+    if want_pair > 1:
+        # pair-row window select needs the token's Dh values present in
+        # every lane slice
+        kn = jnp.concatenate([kn] * want_pair, axis=-1)
+        vn = jnp.concatenate([vn] * want_pair, axis=-1)
+    if pair == want_pair:
+        kview, vview = k_full, v_full              # already packed (models
+        # allocate the packed form so no repack copy rides the carry)
+    else:
+        kview = k_full.reshape(l, b, hkv, s_max // want_pair, dh * want_pair)
+        vview = v_full.reshape(l, b, hkv, s_max // want_pair, dh * want_pair)
+    pair = want_pair
+    layer_a = jnp.asarray(layer, jnp.int32).reshape(1)
+    idx_a = jnp.asarray(idx, jnp.int32).reshape(1)
+
+    kernel = functools.partial(
+        _kernel, b=b, bg=bg, cs=cs, hq=hq, hkv=hkv, dh=dh, pair=pair,
+        scale=sc)
+    attn, k_out, v_out = pl.pallas_call(
+        kernel,
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),   # layer
+            pl.BlockSpec(memory_space=pltpu.SMEM),   # idx
+            pl.BlockSpec(memory_space=pltpu.VMEM),   # q
+            pl.BlockSpec(memory_space=pltpu.VMEM),   # k_new
+            pl.BlockSpec(memory_space=pltpu.VMEM),   # v_new
+            pl.BlockSpec(memory_space=pl.ANY),       # k_full (aliased)
+            pl.BlockSpec(memory_space=pl.ANY),       # v_full (aliased)
+        ],
+        out_specs=[
+            pl.BlockSpec(memory_space=pltpu.VMEM),
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec(memory_space=pl.ANY),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b, hq, dh), q.dtype),
+            jax.ShapeDtypeStruct(kview.shape, k_full.dtype),
+            jax.ShapeDtypeStruct(vview.shape, v_full.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((2, bg, hkv, cs // pair, dh * pair), k_full.dtype),
+            pltpu.VMEM((2, bg, hkv, cs // pair, dh * pair), v_full.dtype),
+            pltpu.VMEM((b, hkv, 8, dh * pair), k_full.dtype),  # write window
+            pltpu.VMEM((b, hkv, 8, dh * pair), v_full.dtype),
+            pltpu.VMEM((bg, hq), jnp.float32),                 # running max
+            pltpu.VMEM((bg, hq), jnp.float32),                 # running sum
+            pltpu.VMEM((bg, hq, dh), jnp.float32),             # accumulator
+            pltpu.SemaphoreType.DMA((2,)),                     # write sems
+            pltpu.SemaphoreType.DMA((2, 2)),                   # read sems
+        ],
+        input_output_aliases={5: 1, 6: 2},
+        interpret=(jax.default_backend() != "tpu" if interpret is None
+                   else interpret),
+    )(layer_a, idx_a, qf, kn, vn, kview, vview)
+    if k_out.shape != k_full.shape:
+        k_out = k_out.reshape(k_full.shape)
+        v_out = v_out.reshape(v_full.shape)
+    return attn[:, None], k_out, v_out

@@ -1,0 +1,72 @@
+"""In-engine decode measurement (PROFILE_DECODE dual-length differencing).
+
+Usage: python scripts/measure_decode.py [bf16|int8] [batches...]
+Prints per-config ms/tok + the decode program's KV carry layout.
+"""
+import os
+import re
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+import deepspeed_tpu
+from deepspeed_tpu.models.gpt2 import GPT2Config, GPT2Model
+from deepspeed_tpu.utils import groups
+
+PROMPT = 512
+LONG, SHORT = 128, 8
+TRIALS = 7
+
+
+def measure(dtype, batch, cfg=None):
+    groups.reset()
+    cfg = cfg or GPT2Config.gpt2_125m()
+    rs = np.random.RandomState(0)
+
+    def fresh():
+        return rs.randint(0, cfg.vocab_size, size=(batch, PROMPT)).astype(np.int32)
+
+    engine = deepspeed_tpu.init_inference(
+        GPT2Model(cfg), dtype=dtype, max_out_tokens=PROMPT + LONG + 1)
+    temp = jnp.float32(1.0)
+    med = {}
+    for mn in (SHORT, LONG):
+        pf, dec = engine.compiled_programs(batch, PROMPT, mn)
+        # warm compile
+        rng = jax.random.PRNGKey(0)
+        tok, cache, rng = pf(engine.params, jnp.asarray(fresh()), temp, rng)
+        _ = np.asarray(jax.device_get(dec(engine.params, tok, cache, temp, rng)))
+        ts = []
+        for i in range(TRIALS):
+            rng = jax.random.PRNGKey(i)
+            tok, cache, rng = pf(engine.params, jnp.asarray(fresh()), temp, rng)
+            _ = np.asarray(jax.device_get(tok))
+            t0 = time.perf_counter()
+            toks = dec(engine.params, tok, cache, temp, rng)
+            _ = np.asarray(jax.device_get(toks))
+            ts.append(time.perf_counter() - t0)
+        ts.sort()
+        med[mn] = ts[len(ts) // 2]
+    per_tok = (med[LONG] - med[SHORT]) / (LONG - SHORT)
+    print(f"dtype={dtype} B={batch}: {per_tok*1e3:.3f} ms/tok "
+          f"({batch/per_tok:.0f} tok/s aggregate)  "
+          f"[med_short={med[SHORT]*1e3:.1f}ms med_long={med[LONG]*1e3:.1f}ms]")
+    del engine
+    return per_tok
+
+
+if __name__ == "__main__":
+    dtypes = [sys.argv[1]] if len(sys.argv) > 1 else ["bf16"]
+    batches = [int(a) for a in sys.argv[2:]] or [1, 8]
+    res = {}
+    for dt in dtypes:
+        for b in batches:
+            res[(dt, b)] = measure(dt, b)
+    if ("bf16", 1) in res and ("bf16", 8) in res:
+        r = 8 * res[("bf16", 1)] / res[("bf16", 8)]
+        print(f"bf16 batch8/batch1 aggregate ratio: {r:.2f}x")

@@ -1,0 +1,62 @@
+"""Standalone per-call timing of fused_decode_step at 125M B=8 shapes
+(chained-scan differencing: dispatch constant cancels)."""
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deepspeed_tpu.ops.decode_step import fused_decode_step
+from deepspeed_tpu.ops.attention import write_kv_cache, decode_attention
+
+B, L, H, S, DH = 8, 12, 12, 640, 64
+IDX = 543
+
+
+def chain(n, fused=True):
+    pair = 128 // DH
+    rng = np.random.RandomState(0)
+    if fused:
+        kf = jnp.asarray(rng.randn(L, B, H, S // pair, DH * pair), jnp.bfloat16)
+        vf = jnp.asarray(rng.randn(L, B, H, S // pair, DH * pair), jnp.bfloat16)
+    else:
+        kf = jnp.asarray(rng.randn(L, B, H, S, DH), jnp.bfloat16)
+        vf = jnp.asarray(rng.randn(L, B, H, S, DH), jnp.bfloat16)
+    q0 = jnp.asarray(rng.randn(B, 1, H, DH), jnp.bfloat16)
+
+    @jax.jit
+    def run(q, kf, vf):
+        def step(carry, i):
+            q, kf, vf = carry
+            layer = jax.lax.rem(i, L)
+            if fused:
+                attn, kf, vf = fused_decode_step(
+                    q, kf, vf, q, q, layer, jnp.int32(IDX))
+            else:
+                kf, vf, kl, vl = write_kv_cache(kf, vf, q, q, layer,
+                                                jnp.int32(IDX))
+                attn = decode_attention(q, kl, vl, jnp.int32(IDX))
+            # feed attn back so steps serialize
+            return (attn, kf, vf), None
+
+        (q, kf, vf), _ = jax.lax.scan(step, (q, kf, vf),
+                                      jnp.arange(n, dtype=jnp.int32))
+        return q.astype(jnp.float32).sum()
+
+    float(jax.device_get(run(q0, kf, vf)))
+    best = float("inf")
+    for _ in range(5):
+        t0 = time.perf_counter()
+        float(jax.device_get(run(q0, kf, vf)))
+        best = min(best, time.perf_counter() - t0)
+    return best
+
+
+for name, fused in (("fused", True), ("einsum", False)):
+    t1, t2 = chain(24, fused), chain(144, fused)
+    per = (t2 - t1) / 120
+    print(f"{name}: {per*1e6:.1f} us/call  ({per*12*1e3:.3f} ms per 12-layer step)")

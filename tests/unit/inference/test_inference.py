@@ -116,10 +116,13 @@ def test_compiled_programs_accessor_and_kv_padding():
     pf, dec = engine.compiled_programs(2, 8, 6)
     tok, cache, rng = pf(engine.params, jnp.asarray(ids),
                          jnp.float32(1.0), jax.random.PRNGKey(0))
-    # padded cache: every cache leaf's sequence dim is a multiple of 128
+    # padded cache: every cache leaf's TOKEN capacity is a multiple of 128
+    # (caches may be token-pair packed [L, B, H, S/pair, Dh*pair] —
+    # ops/attention.kv_pack_factor)
     for leaf in jax.tree_util.tree_leaves(cache):
         if getattr(leaf, "ndim", 0) >= 4:
-            assert leaf.shape[-2] % 128 == 0, leaf.shape
+            tokens = leaf.shape[-2] * (leaf.shape[-1] // cfg.head_dim)
+            assert tokens % 128 == 0, leaf.shape
     toks = dec(engine.params, tok, cache, jnp.float32(1.0), rng)
     np.testing.assert_array_equal(np.asarray(toks), ref[:, 8:])
 

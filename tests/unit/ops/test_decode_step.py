@@ -1,0 +1,128 @@
+"""Fused decode step (ops/decode_step.py) + packed KV-cache semantics.
+
+The TPU numerics of the Mosaic kernel are exercised on-chip by
+scripts/check_decode_step.py; here the interpret-mode kernel and the
+packed-cache routing/fallback contract are pinned on CPU.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepspeed_tpu.ops.attention import (
+    alloc_kv_cache, cache_seq_len, cached_attention, decode_attention,
+    kv_pack_factor, write_kv_cache)
+from deepspeed_tpu.ops.decode_step import fused_decode_step, supports
+
+
+def test_kv_pack_factor():
+    assert kv_pack_factor(64) == 2
+    assert kv_pack_factor(32) == 4
+    assert kv_pack_factor(128) == 1
+    assert kv_pack_factor(256) == 1
+    assert kv_pack_factor(96) == 1  # 128 % 96 != 0 -> unpacked
+
+
+def test_alloc_kv_cache_shapes():
+    # packed: dh=64 pair=2 at batch >= 2
+    c = alloc_kv_cache(4, 2, 8, 256, 64, jnp.bfloat16)
+    assert c.shape == (4, 2, 8, 128, 128)
+    assert cache_seq_len(c, 64) == 256
+    # batch 1 stays unpacked (einsum decode path wins there)
+    c1 = alloc_kv_cache(4, 1, 8, 256, 64, jnp.bfloat16)
+    assert c1.shape == (4, 1, 8, 256, 64)
+    # explicit unpacked (ALiBi / windowed models)
+    cu = alloc_kv_cache(4, 2, 8, 256, 64, jnp.bfloat16, packed=False)
+    assert cu.shape == (4, 2, 8, 256, 64)
+    # dh >= 128 never packs
+    c128 = alloc_kv_cache(4, 2, 8, 256, 128, jnp.bfloat16)
+    assert c128.shape == (4, 2, 8, 256, 128)
+
+
+def test_supports():
+    assert supports(12, 12, 640, 64)
+    assert supports(32, 4, 640, 128)
+    assert not supports(12, 12, 636, 64)   # S not 128-aligned
+    assert not supports(12, 12, 640, 96)   # dh doesn't tile
+    assert not supports(12, 5, 640, 64)    # hq % hkv
+
+
+def _ref_step(q, kf, vf, kn, vn, layer, idx):
+    kf, vf, kl, vl = write_kv_cache(kf, vf, kn, vn, layer, idx)
+    return decode_attention(q, kl, vl, idx), kf, vf
+
+
+@pytest.mark.parametrize("b,l,hq,hkv,s,dh,idx", [
+    (2, 3, 4, 4, 256, 64, 100),    # MHA packed (pair=2)
+    (2, 2, 8, 2, 256, 128, 200),   # GQA rep=4, dh=128
+    (1, 2, 4, 4, 256, 128, 0),     # first decode step
+    (2, 2, 4, 4, 256, 64, 255),    # last position
+])
+def test_fused_decode_step_matches_einsum(b, l, hq, hkv, s, dh, idx):
+    rng = np.random.RandomState(0)
+    pair = kv_pack_factor(dh)
+    q = jnp.asarray(rng.randn(b, 1, hq, dh), jnp.bfloat16)
+    kf = jnp.asarray(rng.randn(l, b, hkv, s, dh), jnp.bfloat16)
+    vf = jnp.asarray(rng.randn(l, b, hkv, s, dh), jnp.bfloat16)
+    kn = jnp.asarray(rng.randn(b, 1, hkv, dh), jnp.bfloat16)
+    vn = jnp.asarray(rng.randn(b, 1, hkv, dh), jnp.bfloat16)
+    layer = jnp.int32(l - 1)
+    a0, k0, v0 = _ref_step(q, kf, vf, kn, vn, layer, jnp.int32(idx))
+    packed = (l, b, hkv, s // pair, dh * pair)
+    a1, k1, v1 = fused_decode_step(
+        q, kf.reshape(packed), vf.reshape(packed), kn, vn, layer,
+        jnp.int32(idx), interpret=True)
+    np.testing.assert_allclose(
+        np.asarray(a1, np.float32), np.asarray(a0, np.float32), atol=0.06)
+    np.testing.assert_array_equal(
+        np.asarray(k1.reshape(kf.shape), np.float32),
+        np.asarray(k0, np.float32))
+    np.testing.assert_array_equal(
+        np.asarray(v1.reshape(vf.shape), np.float32),
+        np.asarray(v0, np.float32))
+
+
+def test_cached_attention_packed_fallback_matches_unpacked():
+    """On CPU the fused kernel is not routed; cached_attention must give
+    identical results for packed and unpacked allocations (the unpack
+    view path)."""
+    rng = np.random.RandomState(1)
+    b, l, h, s, dh = 2, 3, 4, 256, 64
+    q = jnp.asarray(rng.randn(b, 1, h, dh), jnp.bfloat16)
+    kf = jnp.asarray(rng.randn(l, b, h, s, dh), jnp.bfloat16)
+    vf = jnp.asarray(rng.randn(l, b, h, s, dh), jnp.bfloat16)
+    kn = jnp.asarray(rng.randn(b, 1, h, dh), jnp.bfloat16)
+    vn = jnp.asarray(rng.randn(b, 1, h, dh), jnp.bfloat16)
+    layer, idx = jnp.int32(1), jnp.int32(77)
+    a0, k0, v0 = cached_attention(q, kf, vf, kn, vn, layer, idx)
+    pk = kf.reshape(l, b, h, s // 2, dh * 2)
+    pv = vf.reshape(l, b, h, s // 2, dh * 2)
+    a1, k1, v1 = cached_attention(q, pk, pv, kn, vn, layer, idx)
+    np.testing.assert_array_equal(np.asarray(a0, np.float32),
+                                  np.asarray(a1, np.float32))
+    np.testing.assert_array_equal(np.asarray(k0, np.float32),
+                                  np.asarray(k1.reshape(kf.shape), np.float32))
+    assert k1.shape == pk.shape and v1.shape == pv.shape
+
+
+def test_generate_packed_cache_end_to_end():
+    """GPT-2 tiny generate() with a batch-2 (packed-cache) prompt matches
+    the no-cache full forward argmax at each step (greedy)."""
+    import deepspeed_tpu
+    from deepspeed_tpu.models.gpt2 import GPT2Config, GPT2Model
+    from deepspeed_tpu.utils import groups
+
+    groups.reset()
+    cfg = GPT2Config.tiny()
+    engine = deepspeed_tpu.init_inference(GPT2Model(cfg), dtype="fp32",
+                                          max_out_tokens=64)
+    ids = np.random.RandomState(0).randint(0, cfg.vocab_size,
+                                           size=(2, 8)).astype(np.int32)
+    out = engine.generate(ids, max_new_tokens=4)
+    cur = ids
+    for _ in range(4):
+        logits = np.asarray(engine.forward(cur), np.float32)
+        nxt = logits[:, -1].argmax(-1).astype(np.int32)
+        cur = np.concatenate([cur, nxt[:, None]], axis=1)
+    np.testing.assert_array_equal(out, cur)
