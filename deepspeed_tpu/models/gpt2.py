@@ -20,7 +20,7 @@ from typing import Any, Dict, Optional
 import jax
 import jax.numpy as jnp
 
-from deepspeed_tpu.models.base import ATTN_IMPLS, cross_entropy_loss, gelu, layer_norm, qdot, sp_attention
+from deepspeed_tpu.models.base import ATTN_IMPLS, cross_entropy_loss, gelu, layer_norm, layer_view, qdot, sp_attention
 from deepspeed_tpu.ops.attention import alloc_kv_cache, cached_attention, multihead_attention
 
 
@@ -55,6 +55,10 @@ class GPT2Config:
     @classmethod
     def gpt2_350m(cls, **kw):
         return cls(num_layers=24, hidden_size=1024, num_heads=16, **kw)
+
+    @classmethod
+    def gpt2_774m(cls, **kw):
+        return cls(num_layers=36, hidden_size=1280, num_heads=20, **kw)
 
     @classmethod
     def gpt2_1b3(cls, **kw):
@@ -193,7 +197,7 @@ class GPT2Model:
         return self._block_impl(x, blk, rng, train, None)[0]
 
     def forward_hidden(self, params, input_ids, *, rngs=None, train: bool = False,
-                       pld_theta=None):
+                       pld_theta=None, ltd_keep=None):
         c = self.config
         b, t = input_ids.shape
         x = params["wte"].astype(self.compute_dtype)[input_ids]
@@ -205,6 +209,41 @@ class GPT2Model:
 
             block_fn = jax.checkpoint(block_fn, policy=checkpoint_policy(self.remat_policy),
                                       static_argnums=(3,))
+
+        rng0 = rngs.get("dropout") if isinstance(rngs, dict) else rngs
+        if (ltd_keep is not None and train and ltd_keep < t
+                and c.num_layers >= 3):
+            # random-LTD token routing (reference data_routing/
+            # basic_layer.py RandomLayerTokenDrop): every layer except the
+            # first and last runs on a per-layer random SORTED subset of
+            # ``ltd_keep`` tokens — gather -> block -> scatter, with the
+            # dropped tokens' hidden states passing through unchanged.
+            # Sorted indices keep the reduced sequence causal w.r.t. the
+            # original token order, so the block's causal mask is exact.
+            assert rng0 is not None, "random-LTD needs a dropout rng"
+            assert pld_theta is None, \
+                "random-LTD and progressive_layer_drop are exclusive"
+            from deepspeed_tpu.runtime.data_pipeline.random_ltd import (
+                gather_tokens, sample_token_indices, scatter_tokens)
+
+            first = jax.tree_util.tree_map(lambda p: p[0], params["blocks"])
+            last = jax.tree_util.tree_map(lambda p: p[-1], params["blocks"])
+            mid = jax.tree_util.tree_map(lambda p: p[1:-1], params["blocks"])
+            rng0, sub = jax.random.split(rng0)
+            x = block_fn(x, first, sub, train)
+
+            def ltd_body(carry, blk):
+                x, rng = carry
+                rng, r_idx, r_blk = jax.random.split(rng, 3)
+                idx = sample_token_indices(r_idx, b, t, ltd_keep)
+                kept = block_fn(gather_tokens(x, idx), blk, r_blk, train)
+                return (scatter_tokens(x, kept, idx), rng), None
+
+            (x, rng0), _ = jax.lax.scan(ltd_body, (x, rng0), mid)
+            rng0, sub = jax.random.split(rng0)
+            x = block_fn(x, last, sub, train)
+            return layer_norm(x, params["ln_f_scale"], params["ln_f_bias"],
+                              c.eps)
 
         use_pld = pld_theta is not None and train
         layer_idx = jnp.arange(c.num_layers)
@@ -244,9 +283,10 @@ class GPT2Model:
         return jnp.einsum("btd,dv->btv", hidden, params["lm_head"].astype(hidden.dtype))
 
     def apply(self, params, batch, *, rngs=None, train: bool = False,
-              pld_theta=None):
+              pld_theta=None, ltd_keep=None):
         hidden = self.forward_hidden(params, batch["input_ids"], rngs=rngs,
-                                     train=train, pld_theta=pld_theta)
+                                     train=train, pld_theta=pld_theta,
+                                     ltd_keep=ltd_keep)
         c = self.config
         if c.loss_chunk:
             from deepspeed_tpu.runtime.zero.tiling import (
@@ -291,15 +331,20 @@ class GPT2Model:
         pos = idx + jnp.arange(t)
         x = x + params["wpe"].astype(self.compute_dtype)[pos][None]
 
-        def scan_body(carry, blk):
+        def scan_body(carry, _):
             x, kc, vc, layer = carry
+            # counter-indexed blocks: layer_view keeps int8 weight dicts
+            # whole so qdot's kernel DMA-slices the layer in-kernel (a
+            # host-side int8 operand slice copies the weight every step)
+            blk = layer_view(params["blocks"], layer)
             x, kc, vc = self._block_cached(x, blk, kc, vc, layer, idx)
             return (x, kc, vc, layer + 1), None
 
         (x, k_new, v_new, _), _ = jax.lax.scan(
             scan_body,
             (x, cache["k"], cache["v"], jnp.zeros((), jnp.int32)),
-            params["blocks"], unroll=self.decode_unroll if t == 1 else 1)
+            None, length=c.num_layers,
+            unroll=self.decode_unroll if t == 1 else 1)
         hidden = layer_norm(x, params["ln_f_scale"], params["ln_f_bias"], c.eps)
         logits = self.logits(params, hidden)
         return logits, {"k": k_new, "v": v_new, "index": idx + t}

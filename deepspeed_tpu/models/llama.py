@@ -14,7 +14,7 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 
-from deepspeed_tpu.models.base import ATTN_IMPLS, cross_entropy_loss, qdot, rms_norm, sp_attention  # noqa: E501
+from deepspeed_tpu.models.base import ATTN_IMPLS, cross_entropy_loss, layer_view, qdot, rms_norm, sp_attention  # noqa: E501
 from deepspeed_tpu.ops.attention import alloc_kv_cache, cached_attention, multihead_attention
 from deepspeed_tpu.ops.rotary import apply_rotary_pos_emb, rope_frequencies
 
@@ -215,15 +215,21 @@ class LlamaModel:
         x = params["embed"].astype(self.compute_dtype)[input_ids]
         cos, sin = rope_frequencies(c.head_dim, c.max_seq_len, c.rope_theta)
 
-        def scan_body(carry, blk):
+        def scan_body(carry, _):
             x, kc, vc, layer = carry
+            # blocks are indexed by the carried counter (not scan xs):
+            # layer_view keeps int8 weight dicts WHOLE so qdot's kernel
+            # DMA-slices the layer in-kernel instead of paying a full
+            # per-step operand copy (models/base.layer_view)
+            blk = layer_view(params["blocks"], layer)
             x, kc, vc = self._block_cached(x, blk, kc, vc, layer, idx, cos, sin)
             return (x, kc, vc, layer + 1), None
 
         (x, k_new, v_new, _), _ = jax.lax.scan(
             scan_body,
             (x, cache["k"], cache["v"], jnp.zeros((), jnp.int32)),
-            params["blocks"], unroll=self.decode_unroll if t == 1 else 1)
+            None, length=c.num_layers,
+            unroll=self.decode_unroll if t == 1 else 1)
         hidden = rms_norm(x, params["final_norm"], c.eps)
         logits = self.logits(params, hidden)
         return logits, {"k": k_new, "v": v_new, "index": idx + t}

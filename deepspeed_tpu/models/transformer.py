@@ -28,7 +28,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from deepspeed_tpu.models.base import cross_entropy_loss, gelu, layer_norm, qdot
+from deepspeed_tpu.models.base import cross_entropy_loss, gelu, layer_norm, layer_view, qdot
 from deepspeed_tpu.ops.attention import alloc_kv_cache, cache_seq_len, cached_attention, multihead_attention
 from deepspeed_tpu.ops.rotary import apply_rotary_pos_emb, rope_frequencies
 
@@ -428,9 +428,12 @@ class DecoderModel:
         else:
             use_flags = True
 
-        def scan_body(carry, layer_in):
+        def scan_body(carry, flag):
             x, kc, vc, layer = carry
-            blk, flag = layer_in
+            # counter-indexed blocks: layer_view keeps int8 weight dicts
+            # whole so qdot's kernel DMA-slices the layer in-kernel (a
+            # host-side int8 operand slice copies the weight every step)
+            blk = layer_view(params["blocks"], layer)
             x, kc, vc = self._block_impl(
                 x, blk, (kc, vc, layer, idx),
                 local_flag=flag if use_flags else None)
@@ -440,8 +443,7 @@ class DecoderModel:
         (x, k_new, v_new, _), _ = jax.lax.scan(
             scan_body,
             (x, cache["k"], cache["v"], jnp.zeros((), jnp.int32)),
-            (params["blocks"], flags),
-            unroll=self.decode_unroll if t == 1 else 1)
+            flags, unroll=self.decode_unroll if t == 1 else 1)
         if c.final_ln:
             x = layer_norm(x, params["ln_f_scale"], params["ln_f_bias"],
                            c.eps)

@@ -229,6 +229,15 @@ class DeepSpeedConfig:
         self.bfloat16_enabled = self.bf16_config.enabled
         if self.fp16_enabled and self.bfloat16_enabled:
             raise DeepSpeedConfigError("fp16 and bf16 cannot both be enabled")
+        # grad-accumulation dtype (reference "data_types": {"grad_accum_dtype"}
+        # — config.py get_data_types): fp32 (default) or bf16; bf16 halves
+        # the accumulation buffer (the difference between fitting and
+        # OOMing a 774M full step on one 16 GB chip)
+        gad = d.get("data_types", {}).get("grad_accum_dtype")
+        if gad not in (None, "fp32", "bf16"):
+            raise DeepSpeedConfigError(
+                f"data_types.grad_accum_dtype must be fp32 or bf16, got {gad!r}")
+        self.grad_accum_dtype = gad or "fp32"
         self.gradient_clipping = float(d.get(C.GRADIENT_CLIPPING, C.GRADIENT_CLIPPING_DEFAULT))
         self.prescale_gradients = d.get(C.PRESCALE_GRADIENTS, C.PRESCALE_GRADIENTS_DEFAULT)
         self.gradient_predivide_factor = d.get(
@@ -262,6 +271,15 @@ class DeepSpeedConfig:
         self.aio_config = AIOConfig(**d.get("aio", {}))
         self.hybrid_engine = HybridEngineConfig(**d.get("hybrid_engine", {}))
         self.pld_config = PLDConfig(**d.get("progressive_layer_drop", {}))
+        # random-LTD token routing (reference config shape:
+        # data_efficiency.data_routing.random_ltd — data_pipeline/config.py)
+        de = d.get("data_efficiency", {})
+        dr = de.get("data_routing", {})
+        rltd = dr.get("random_ltd", {})
+        self.random_ltd_enabled = (bool(de.get("enabled", True))
+                                   and bool(dr.get("enabled", False))
+                                   and bool(rltd.get("enabled", False)))
+        self.random_ltd_params = rltd
         # legacy curriculum learning (reference config.py
         # curriculum_enabled_legacy; engine.py:1653 injects curriculum_seqlen)
         cl = d.get("curriculum_learning", {})

@@ -207,6 +207,36 @@ class DeepSpeedEngine:
                          "accept pld_theta — schedule tracked but layers "
                          "are NOT dropped", ranks=[0])
 
+        # ---- random-LTD token routing (reference data_routing wiring,
+        # basic_layer.py RandomLayerTokenDrop): the scheduler's kept-token
+        # count is passed to model.apply as a STATIC ``ltd_keep`` so the
+        # gather->block->scatter shapes stay compile-time constants (one
+        # compile per schedule granule, like the legacy curriculum).
+        self.random_ltd_scheduler = None
+        self._use_random_ltd = False
+        if config.random_ltd_enabled:
+            from deepspeed_tpu.runtime.data_pipeline.random_ltd import (
+                RandomLTDScheduler)
+            import inspect
+
+            self.random_ltd_scheduler = RandomLTDScheduler(
+                config.random_ltd_params)
+            self._use_random_ltd = "ltd_keep" in inspect.signature(
+                model.apply).parameters
+            if not self._use_random_ltd:
+                log_dist("random_ltd: model.apply does not accept "
+                         "ltd_keep — schedule tracked but tokens are NOT "
+                         "dropped", ranks=[0])
+            elif self._use_pld:
+                log_dist("random_ltd and progressive_layer_drop are "
+                         "mutually exclusive; disabling random_ltd",
+                         ranks=[0])
+                self._use_random_ltd = False
+            elif self._onebit_compressed:
+                log_dist("random_ltd is not supported on the 1-bit "
+                         "compressed path; disabling", ranks=[0])
+                self._use_random_ltd = False
+
         # XLA:CPU's collective rendezvous keys executions by (run_id, op_id)
         # only; on a starved host a straggler async step can join the NEXT
         # step's rendezvous and deadlock both.  The CPU (test) backend
@@ -378,12 +408,14 @@ class DeepSpeedEngine:
         return jax.tree_util.tree_map(cast, params, specs)
 
     def _micro_loss_and_grads(self, params, batch, scale, rng, pld_theta=None,
-                              constrain=True):
+                              constrain=True, ltd_keep=None):
         """Single microbatch loss+grads in compute dtype; grads carry the
         stage-dependent sharding constraint (→ reduce-scatter from stage 2).
         ``constrain=False`` drops the NamedSharding constraints for callers
         already inside a shard_map manual context (the 1-bit path)."""
         kwargs = {"pld_theta": pld_theta} if pld_theta is not None else {}
+        if ltd_keep is not None:
+            kwargs["ltd_keep"] = ltd_keep
 
         def loss_fn(master_params):
             cparams = self._cast_for_compute(master_params) if constrain else \
@@ -396,14 +428,18 @@ class DeepSpeedEngine:
             return loss * scale, metrics
 
         (scaled_loss, metrics), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
+        # grads accumulate in grad_accum_dtype (reference data_types.
+        # grad_accum_dtype): bf16 halves the accumulation buffer
+        acc_dt = jnp.bfloat16 if self.config.grad_accum_dtype == "bf16" \
+            else jnp.float32
         if constrain:
             grads = jax.tree_util.tree_map(
                 lambda g, s: jax.lax.with_sharding_constraint(
-                    g.astype(jnp.float32), NamedSharding(self.mesh, s)),
+                    g.astype(acc_dt), NamedSharding(self.mesh, s)),
                 grads, self.grad_specs)
         else:
             grads = jax.tree_util.tree_map(
-                lambda g: g.astype(jnp.float32), grads)
+                lambda g: g.astype(acc_dt), grads)
         return scaled_loss, grads, metrics
 
     def _apply_grads(self, state: TrainState, grads, lr):
@@ -431,7 +467,7 @@ class DeepSpeedEngine:
 
     # ---------------------------------------------------- shared step pieces
     def _scan_micro_grads(self, state: TrainState, batch, rng, pld_theta=None,
-                          constrain=True, rng_fold=None):
+                          constrain=True, rng_fold=None, ltd_keep=None):
         """Grad-accumulation scan over the gas microbatches (shared by the
         fused device step, the host-offload grad step and the 1-bit
         shard_map step). ``rng_fold(rng, i)`` customizes the per-microbatch
@@ -444,18 +480,21 @@ class DeepSpeedEngine:
             mb, i = mb_and_i
             sub = rng_fold(rng, i)
             _, grads, metrics = self._micro_loss_and_grads(
-                state.params, mb, scale, sub, pld_theta, constrain=constrain)
+                state.params, mb, scale, sub, pld_theta, constrain=constrain,
+                ltd_keep=ltd_keep)
             grads_acc = jax.tree_util.tree_map(jnp.add, grads_acc, grads)
             return (grads_acc, loss_acc + metrics["loss"]), None
 
+        acc_dt = jnp.bfloat16 if self.config.grad_accum_dtype == "bf16" \
+            else jnp.float32
         if constrain:
             grads0 = jax.tree_util.tree_map(
                 lambda p, s: jax.lax.with_sharding_constraint(
-                    jnp.zeros(p.shape, jnp.float32), NamedSharding(self.mesh, s)),
+                    jnp.zeros(p.shape, acc_dt), NamedSharding(self.mesh, s)),
                 state.params, self.grad_specs)
         else:
             grads0 = jax.tree_util.tree_map(
-                lambda p: jnp.zeros(p.shape, jnp.float32), state.params)
+                lambda p: jnp.zeros(p.shape, acc_dt), state.params)
         (grads, loss_sum), _ = jax.lax.scan(
             micro, (grads0, jnp.zeros((), jnp.float32)),
             (batch, jnp.arange(self.gas)))
@@ -465,7 +504,8 @@ class DeepSpeedEngine:
         """gas-mean + loss-scale unscale + overflow/norm (shared epilogue of
         both host-step entry points)."""
         inv = 1.0 / (self.gas * scaler.cur_scale)
-        grads = jax.tree_util.tree_map(lambda g: g * inv, grads)
+        grads = jax.tree_util.tree_map(
+            lambda g: g.astype(jnp.float32) * inv, grads)
         overflow = has_inf_or_nan(grads) if self.fp16_enabled \
             else jnp.zeros((), bool)
         return grads, overflow, global_grad_norm(grads)
@@ -476,8 +516,9 @@ class DeepSpeedEngine:
         returns mean unscaled grads + metrics; the optimizer update happens
         on the CPU (ZeRO-Offload semantics)."""
 
-        def grad_step(state: TrainState, batch, rng):
-            grads, loss_sum = self._scan_micro_grads(state, batch, rng)
+        def grad_step(state: TrainState, batch, rng, ltd_keep=None):
+            grads, loss_sum = self._scan_micro_grads(state, batch, rng,
+                                                     ltd_keep=ltd_keep)
             grads, overflow, norm = self._unscale_epilogue(grads, state.scaler)
             # host optimizer consumes grads in the MASTER layout: each
             # process updates exactly the master shards it owns (multi-host
@@ -489,7 +530,9 @@ class DeepSpeedEngine:
                        "grad_norm": norm, "loss_scale": state.scaler.cur_scale}
             return grads, metrics
 
-        self._compiled_grad_step = jax.jit(grad_step)
+        # ltd_keep static: shapes depend on it (same contract as the
+        # fused train step)
+        self._compiled_grad_step = jax.jit(grad_step, static_argnums=(3,))
         return self._compiled_grad_step
 
     def _host_apply(self, grads, overflow: bool, norm: float, lr):
@@ -523,17 +566,25 @@ class DeepSpeedEngine:
             return self._build_onebit_train_step(batch)
         gas = self.gas
 
-        def train_step(state: TrainState, batch, lr, rng, pld_theta=None):
+        def train_step(state: TrainState, batch, lr, rng, pld_theta=None,
+                       ltd_keep=None):
             grads, loss_sum = self._scan_micro_grads(state, batch, rng,
-                                                     pld_theta)
-            grads = jax.tree_util.tree_map(lambda g: g / gas, grads)
+                                                     pld_theta,
+                                                     ltd_keep=ltd_keep)
+            # back to f32 for unscale/clip/optimizer regardless of the
+            # accumulation dtype
+            grads = jax.tree_util.tree_map(
+                lambda g: g.astype(jnp.float32) / gas, grads)
             new_state, overflow, norm = self._apply_grads(state, grads, lr)
             metrics = {"loss": loss_sum / gas, "overflow": overflow, "grad_norm": norm,
                        "loss_scale": state.scaler.cur_scale}
             return new_state, metrics
 
         batch_sharding_fn = self._gas_batch_shardings
-        self._compiled_train_step = jax.jit(train_step, donate_argnums=(0,))
+        # ltd_keep is STATIC (it sets gather/scatter shapes): one compile
+        # per schedule granule, bounded by the scheduler's seq_per_step
+        self._compiled_train_step = jax.jit(train_step, donate_argnums=(0,),
+                                            static_argnums=(5,))
         return self._compiled_train_step
 
     def _build_onebit_train_step(self, batch):
@@ -668,14 +719,25 @@ class DeepSpeedEngine:
         rng = jax.random.fold_in(self._dropout_rng, self.global_steps)
         batch = self._apply_curriculum(batch)
         batch = jax.device_put(batch, self._gas_batch_shardings(batch))
+        ltd_keep = None
+        if self._use_random_ltd:
+            seq_len = int(batch["input_ids"].shape[-1]) \
+                if isinstance(batch, dict) and "input_ids" in batch else None
+            keep = self.random_ltd_scheduler.update_seq(self.global_steps)
+            if seq_len is None or keep < seq_len:
+                ltd_keep = keep
         if self._use_pld:
             theta = jnp.asarray(self.progressive_layer_drop.get_theta(),
                                 jnp.float32)
             self.state, metrics = self._compiled_train_step(
                 self.state, batch, lr, rng, theta)
-        else:
+        elif self._onebit_compressed:
+            # the 1-bit shard_map step has a fixed 4-arg signature
             self.state, metrics = self._compiled_train_step(
                 self.state, batch, lr, rng)
+        else:
+            self.state, metrics = self._compiled_train_step(
+                self.state, batch, lr, rng, None, ltd_keep)
         self._global_grad_norm = metrics["grad_norm"]
         self.micro_steps += self.gas
         self.global_steps += 1
@@ -697,7 +759,15 @@ class DeepSpeedEngine:
         rng = jax.random.fold_in(self._dropout_rng, self.global_steps)
         batch = self._apply_curriculum(batch)
         batch = jax.device_put(batch, self._gas_batch_shardings(batch))
-        grads, metrics = self._compiled_grad_step(self.state, batch, rng)
+        ltd_keep = None
+        if self._use_random_ltd:
+            seq_len = int(batch["input_ids"].shape[-1]) \
+                if isinstance(batch, dict) and "input_ids" in batch else None
+            keep = self.random_ltd_scheduler.update_seq(self.global_steps)
+            if seq_len is None or keep < seq_len:
+                ltd_keep = keep
+        grads, metrics = self._compiled_grad_step(self.state, batch, rng,
+                                                  ltd_keep)
         overflow = bool(jax.device_get(metrics["overflow"]))
         norm = float(jax.device_get(metrics["grad_norm"]))
         self._host_apply(grads, overflow, norm, lr)

@@ -1,0 +1,77 @@
+"""GQA serving evidence on-chip (round-4 VERDICT #9): a 32q/4kv-head
+config through the engine's decode, fused kernel vs einsum, dual-length
+differenced (the bench.py methodology)."""
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+import deepspeed_tpu
+from deepspeed_tpu.models.llama import LlamaConfig, LlamaModel
+from deepspeed_tpu.utils import groups
+
+PROMPT, LONG, SHORT, TRIALS = 512, 128, 8, 7
+
+
+def measure(batch, use_kernel):
+    import deepspeed_tpu.ops.attention as att
+
+    orig = None
+    if not use_kernel:
+        from deepspeed_tpu.ops import decode_step
+
+        orig = decode_step.supports
+        decode_step.supports = lambda *a, **k: False
+    try:
+        groups.reset()
+        cfg = LlamaConfig(num_layers=8, hidden_size=4096, num_heads=32,
+                          num_kv_heads=4, max_seq_len=1024)
+        engine = deepspeed_tpu.init_inference(
+            LlamaModel(cfg), dtype="bf16", max_out_tokens=PROMPT + LONG + 1)
+        rs = np.random.RandomState(0)
+
+        def fresh():
+            return rs.randint(0, cfg.vocab_size,
+                              size=(batch, PROMPT)).astype(np.int32)
+
+        temp = jnp.float32(1.0)
+        med = {}
+        for mn in (SHORT, LONG):
+            pf, dec = engine.compiled_programs(batch, PROMPT, mn)
+            rng = jax.random.PRNGKey(0)
+            tok, cache, rng = pf(engine.params, jnp.asarray(fresh()), temp, rng)
+            _ = np.asarray(jax.device_get(dec(engine.params, tok, cache, temp, rng)))
+            ts = []
+            for i in range(TRIALS):
+                rng = jax.random.PRNGKey(i)
+                tok, cache, rng = pf(engine.params, jnp.asarray(fresh()),
+                                     temp, rng)
+                _ = np.asarray(jax.device_get(tok))
+                t0 = time.perf_counter()
+                out = dec(engine.params, tok, cache, temp, rng)
+                _ = np.asarray(jax.device_get(out))
+                ts.append(time.perf_counter() - t0)
+            ts.sort()
+            med[mn] = ts[len(ts) // 2]
+        per = (med[LONG] - med[SHORT]) / (LONG - SHORT)
+        del engine
+        return per
+    finally:
+        if orig is not None:
+            from deepspeed_tpu.ops import decode_step
+
+            decode_step.supports = orig
+
+
+if __name__ == "__main__":
+    print(jax.devices())
+    for b in (1, 8):
+        k = measure(b, True)
+        e = measure(b, False)
+        print(f"GQA 32q/4kv dh=128 L=8 B={b}: fused {k*1e3:.3f} ms/tok vs "
+              f"einsum {e*1e3:.3f} ms/tok ({e/k:.2f}x)", flush=True)

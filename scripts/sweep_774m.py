@@ -1,0 +1,108 @@
+"""Find the champion single-chip GPT-2-774M training config (VERDICT r5
+ask #4: a headline config big enough to clear 55% MFU-vs-attainable).
+
+Each candidate runs in a FRESH subprocess (RESOURCE_EXHAUSTED poisons the
+client — run_7b.py lesson)."""
+import json
+import os
+import subprocess
+import sys
+import time
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+TAG = "RESULT:"
+
+
+def run_one(mb, gas, remat, policy, gad="fp32", loss_chunk=0, steps=4,
+            windows=3):
+    import jax
+    import numpy as np
+
+    import deepspeed_tpu
+    from deepspeed_tpu.models.gpt2 import GPT2Config, GPT2Model
+    from deepspeed_tpu.utils import groups
+
+    groups.reset()
+    cfg = GPT2Config.gpt2_774m(loss_chunk=loss_chunk)
+    seq = 1024
+    model = GPT2Model(cfg, attn_impl="flash", remat=bool(remat),
+                      remat_policy=policy if remat else None)
+    engine, *_ = deepspeed_tpu.initialize(model=model, config={
+        "train_batch_size": mb * gas,
+        "gradient_accumulation_steps": gas,
+        "bf16": {"enabled": True},
+        "optimizer": {"type": "AdamW",
+                      "params": {"lr": 1e-4, "weight_decay": 0.01}},
+        "gradient_clipping": 1.0,
+        "steps_per_print": 0,
+        "zero_optimization": {"stage": 0},
+        "data_types": {"grad_accum_dtype": gad},
+    })
+    rng = np.random.RandomState(0)
+
+    def make_batch():
+        ids = rng.randint(0, cfg.vocab_size,
+                          size=(gas, mb, seq + 1)).astype(np.int32)
+        return {"input_ids": ids[:, :, :-1], "labels": ids[:, :, 1:]}
+
+    for _ in range(2):
+        loss = engine.train_batch_from_stacked(make_batch())
+    float(jax.device_get(loss))
+    best = float("inf")
+    for _ in range(windows):
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            loss = engine.train_batch_from_stacked(make_batch())
+        float(jax.device_get(loss))
+        best = min(best, time.perf_counter() - t0)
+    tps = mb * gas * seq * steps / best
+    n_params = sum(x.size for x in jax.tree_util.tree_leaves(
+        engine.state.params))
+    flops = (6.0 * n_params + 12 * cfg.num_layers * cfg.hidden_size * seq) \
+        * tps / 1e12
+    return {"mb": mb, "gas": gas, "remat": remat, "policy": policy,
+            "grad_accum_dtype": gad, "loss_chunk": loss_chunk,
+            "tokens_per_sec": round(tps, 1), "tflops": round(flops, 1),
+            "n_params": int(n_params)}
+
+
+def main():
+    if "--one" in sys.argv:
+        i = sys.argv.index("--one")
+        mb, gas, remat, policy, gad, lc = (
+            int(sys.argv[i + 1]), int(sys.argv[i + 2]),
+            int(sys.argv[i + 3]), sys.argv[i + 4], sys.argv[i + 5],
+            int(sys.argv[i + 6]))
+        try:
+            print(TAG + json.dumps(run_one(mb, gas, remat, policy, gad, lc)))
+        except Exception as e:
+            print(TAG + json.dumps({"mb": mb, "gas": gas, "remat": remat,
+                                    "gad": gad, "loss_chunk": lc,
+                                    "error": f"{type(e).__name__}: {e}"[:200]}))
+        return
+
+    cands = [
+        (2, 8, 0, "-", "bf16", 0),
+        (2, 8, 0, "-", "bf16", 512),
+        (4, 4, 1, "save_attn", "bf16", 512),
+        (4, 4, 0, "-", "bf16", 512),
+        (8, 2, 1, "save_attn", "bf16", 512),
+    ]
+    results = []
+    for mb, gas, remat, policy, gad, lc in cands:
+        p = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--one", str(mb),
+             str(gas), str(remat), policy, gad, str(lc)],
+            capture_output=True, text=True, timeout=1200)
+        for line in p.stdout.splitlines():
+            if line.startswith(TAG):
+                r = json.loads(line[len(TAG):])
+                results.append(r)
+                print(r, flush=True)
+    print(json.dumps(results))
+
+
+if __name__ == "__main__":
+    main()

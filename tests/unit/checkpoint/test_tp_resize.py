@@ -1,0 +1,87 @@
+"""TP-resize universal-checkpoint test (round-4 VERDICT missing #3).
+
+Reference counterpart: offline 2D reshaping of megatron tp shards
+(deepspeed/checkpoint/reshape_meg_2d.py). Here checkpoints are global
+arrays, so a tp=1 save must load onto a tp=2 mesh (and back) with
+IDENTICAL logits — resharding happens at load, no offline tool.
+"""
+
+import sys
+from pathlib import Path
+
+import jax
+import numpy as np
+import pytest
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
+
+import deepspeed_tpu  # noqa: E402
+from deepspeed_tpu.models.gpt2 import GPT2Config, GPT2Model  # noqa: E402
+from deepspeed_tpu.parallel.topology import build_topology  # noqa: E402
+from deepspeed_tpu.utils import groups  # noqa: E402
+
+
+def _engine(tp):
+    groups.reset()
+    topo = build_topology(tp=tp)
+    engine, *_ = deepspeed_tpu.initialize(
+        model=GPT2Model(GPT2Config.tiny()), topology=topo, config={
+            "train_batch_size": 16,
+            "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+            "tensor_parallel": {"tp_size": tp},
+            "steps_per_print": 0,
+        })
+    return engine
+
+
+def _batch(seed=0, b=16, t=32, vocab=512):
+    rng = np.random.RandomState(seed)
+    ids = rng.randint(0, vocab, size=(1, b, t + 1)).astype(np.int32)
+    return {"input_ids": ids[:, :, :-1], "labels": ids[:, :, 1:]}
+
+
+def _logits(engine, ids):
+    model = engine.module
+
+    @jax.jit
+    def fwd(params, ids):
+        hidden = model.forward_hidden(params, ids, train=False)
+        return model.logits(params, hidden)
+
+    return np.asarray(jax.device_get(
+        fwd(engine.state.params, ids)), np.float32)
+
+
+@pytest.mark.parametrize("save_tp,load_tp", [(1, 2), (2, 1), (2, 4)])
+def test_tp_resize_checkpoint_identical_logits(tmp_path, save_tp, load_tp):
+    e1 = _engine(save_tp)
+    for i in range(2):
+        e1.train_batch_from_stacked(_batch(seed=i))
+    e1.save_checkpoint(str(tmp_path))
+    ids = _batch(seed=9)["input_ids"][0]
+    ref = _logits(e1, ids)
+    saved_step = e1.global_steps
+
+    e2 = _engine(load_tp)
+    path, _ = e2.load_checkpoint(str(tmp_path))
+    assert path is not None
+    assert e2.global_steps == saved_step
+    # the checkpoint VALUES are bit-identical after resharding
+    # (global-array universality: load only changes placement)
+    for a, b in zip(jax.tree_util.tree_leaves(
+            jax.device_get(e1.state.params)),
+            jax.tree_util.tree_leaves(jax.device_get(e2.state.params))):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    got = _logits(e2, ids)
+    # logits match up to bf16 reduction order (a tp=2 matmul splits the
+    # contraction across devices; bit-identity across different
+    # collective decompositions is not a meaningful bar in bf16)
+    np.testing.assert_allclose(got, ref, atol=0.06, rtol=0.06)
+
+    # the resized engine keeps training under its own plan
+    loss = float(jax.device_get(e2.train_batch_from_stacked(_batch(seed=5))))
+    assert np.isfinite(loss)
+    # and its TP sharding is real
+    if load_tp > 1:
+        spec = str(e2.state.params["blocks"]["mlp_fc_w"].sharding.spec)
+        assert "model" in spec, spec

@@ -40,6 +40,50 @@ def test_non_divisible_dims_fall_back_to_smaller_blocks():
     assert np.abs(out - ref).max() / np.abs(ref).max() < 0.02
 
 
+@pytest.mark.parametrize("b,d,e", [(1, 256, 512), (4, 768, 2304),
+                                   (1, 384, 1408)])  # 1408 = 11*128
+def test_dma_kernel_matches_dense_dequant(b, d, e):
+    from deepspeed_tpu.ops.int8_matmul import int8_matmul_dma
+
+    x, q, s = mk(b, d, e)
+    out = np.asarray(int8_matmul_dma(x, q, s, interpret=True), np.float32)
+    ref = np.asarray((x.astype(jnp.float32) @ q.astype(jnp.float32))
+                     * s.reshape(-1), np.float32)
+    assert np.abs(out - ref).max() / np.abs(ref).max() < 0.02
+
+
+def test_dma_kernel_stacked_layer_slicing():
+    """Stacked [L, D, E] weights + scalar layer: the kernel DMA-slices
+    the layer itself (models/base.layer_view contract)."""
+    from deepspeed_tpu.ops.int8_matmul import int8_matmul_dma
+
+    rng = np.random.RandomState(0)
+    l, b, d, e = 3, 2, 256, 512
+    x = jnp.asarray(rng.randn(b, d), jnp.bfloat16)
+    q = jnp.asarray(rng.randint(-127, 128, (l, d, e)), jnp.int8)
+    s = jnp.asarray(np.abs(rng.randn(l, 1, e)) * 0.01, jnp.float32)
+    for layer in range(l):
+        out = np.asarray(int8_matmul_dma(x, q, s, jnp.int32(layer),
+                                         interpret=True), np.float32)
+        ref = np.asarray((x.astype(jnp.float32)
+                          @ q[layer].astype(jnp.float32))
+                         * s[layer].reshape(-1), np.float32)
+        assert np.abs(out - ref).max() / np.abs(ref).max() < 0.02, layer
+
+
+def test_dma_plan_prefers_full_rows():
+    from deepspeed_tpu.ops.int8_matmul import _dma_plan
+
+    bd, be = _dma_plan(11008, 4096)
+    assert be == 4096            # full rows -> contiguous tiles
+    bd, be = _dma_plan(4096, 11008)
+    assert be == 11008
+    # dims with no 128-aligned divisor tiling must be rejected, not
+    # silently mis-tiled (11072 = 64 * 173)
+    assert _dma_plan(4096, 11008 + 64) is None
+    assert _dma_plan(11008 + 64, 4096) is None
+
+
 def test_qdot_routes_decode_through_kernel_shapes():
     """qdot's fast-path predicate: standard einsum form + 2D weights +
     <=32 activation rows. On CPU it stays on the einsum path (backend
