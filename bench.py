@@ -23,6 +23,7 @@ round-over-round continuity; serving metrics ride in the same object.
 from __future__ import annotations
 
 import json
+import sys
 import time
 
 import numpy as np
@@ -117,6 +118,69 @@ def _bench_zero_flash_longseq(on_tpu: bool):
         best = min(best, time.perf_counter() - t0)
     return {"seq_len": seq, "zero_stage": 2, "attn": "flash+save_attn",
             "tokens_per_sec": round(batch * gas * seq * steps / best, 1)}
+
+
+def _bench_774m(on_tpu: bool):
+    """Second tracked training config (round-4 VERDICT #4): the largest
+    single-chip-feasible dense model. GPT-2-774M (L=36, d=1280) full
+    AdamW step on one 16 GB chip — fits via bf16 grad accumulation
+    (data_types.grad_accum_dtype, halves the accumulation buffer) +
+    save_attn remat; champion of scripts/sweep_774m.py (mb2 x gas8,
+    15.2k tok/s / 79.4 TF in the 2026-07-31 sweep; mb4 variants OOM)."""
+    import time
+
+    import jax
+
+    import deepspeed_tpu
+    from deepspeed_tpu.models.gpt2 import GPT2Config, GPT2Model
+    from deepspeed_tpu.utils import groups
+
+    groups.reset()
+    if on_tpu:
+        cfg = GPT2Config.gpt2_774m()
+        batch, seq, steps, gas, windows = 2, 1024, 4, 8, 3
+    else:
+        cfg = GPT2Config(vocab_size=2048, max_seq_len=512, num_layers=3,
+                         hidden_size=256, num_heads=8)
+        batch, seq, steps, gas, windows = 1, 256, 2, 2, 1
+    model = GPT2Model(cfg, attn_impl="flash" if on_tpu else "dense",
+                      remat=True, remat_policy="save_attn")
+    engine, *_ = deepspeed_tpu.initialize(model=model, config={
+        "train_batch_size": batch * gas,
+        "gradient_accumulation_steps": gas,
+        "bf16": {"enabled": True},
+        "optimizer": {"type": "AdamW",
+                      "params": {"lr": 1e-4, "weight_decay": 0.01}},
+        "gradient_clipping": 1.0,
+        "steps_per_print": 0,
+        "zero_optimization": {"stage": 0},
+        "data_types": {"grad_accum_dtype": "bf16"},
+    })
+    rng = np.random.RandomState(0)
+
+    def make_batch():
+        ids = rng.randint(0, cfg.vocab_size,
+                          size=(gas, batch, seq + 1)).astype(np.int32)
+        return {"input_ids": ids[:, :, :-1], "labels": ids[:, :, 1:]}
+
+    for _ in range(2):
+        loss = engine.train_batch_from_stacked(make_batch())
+    float(jax.device_get(loss))
+    best = float("inf")
+    for _ in range(windows):
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            loss = engine.train_batch_from_stacked(make_batch())
+        float(jax.device_get(loss))
+        best = min(best, time.perf_counter() - t0)
+    tps = batch * gas * seq * steps / best
+    n_params = sum(x.size for x in jax.tree_util.tree_leaves(
+        engine.state.params))
+    flops_tok = 6.0 * n_params + 12 * cfg.num_layers * cfg.hidden_size * seq
+    return {"n_params": int(n_params), "micro_batch": batch, "gas": gas,
+            "remat": "save_attn", "grad_accum_dtype": "bf16",
+            "tokens_per_sec": round(tps, 1),
+            "achieved_tflops": round(tps * flops_tok / 1e12, 1)}
 
 
 def _bench_serving(on_tpu: bool):
@@ -231,8 +295,48 @@ def _bench_serving(on_tpu: bool):
     return out
 
 
+def _bench_774m_isolated(on_tpu: bool):
+    """774M needs a FRESH process on the shared chip: in-process after the
+    serving engines it RESOURCE_EXHAUSTs (their allocations + fragmentation
+    eat the ~2 GB of headroom the full step needs), and a transient
+    neighbor OOM poisons the whole client (run_7b.py lesson). The child
+    also measures attainable-TFLOPs so the MFU ratio comes from the same
+    uncontended-ish window."""
+    import json as _json
+    import subprocess
+    import sys
+
+    if not on_tpu:
+        return _bench_774m(False), None
+    try:
+        p = subprocess.run(
+            [sys.executable, __file__, "--774m"], capture_output=True,
+            text=True, timeout=1500)
+        for line in p.stdout.splitlines():
+            if line.startswith("RESULT_774M:"):
+                d = _json.loads(line[len("RESULT_774M:"):])
+                return d["train_774m"], d.get("attainable_tflops_per_chip")
+        return {"error": f"no result line (rc={p.returncode}): "
+                         f"{p.stdout[-200:]}"}, None
+    except Exception as e:
+        return {"error": f"{type(e).__name__}: {e}"[:300]}, None
+
+
 def main():
     import jax
+
+    if "--774m" in sys.argv:
+        import json as _json
+
+        on_tpu = any(d.platform in ("tpu", "axon") or "TPU" in str(d.device_kind)
+                     for d in jax.devices())
+        out = {"train_774m": _bench_774m(on_tpu)}
+        try:
+            out["attainable_tflops_per_chip"] = round(_attainable_tflops(), 1)
+        except Exception:
+            out["attainable_tflops_per_chip"] = None
+        print("RESULT_774M:" + _json.dumps(out))
+        return
 
     on_tpu = any(d.platform in ("tpu", "axon") or "TPU" in str(d.device_kind)
                  for d in jax.devices())
@@ -310,12 +414,15 @@ def main():
         longseq = _bench_zero_flash_longseq(on_tpu)
     except Exception as e:
         longseq = {"error": f"{type(e).__name__}: {e}"}
+    train_774m, attainable_774m = _bench_774m_isolated(on_tpu)
     attainable = None
     if on_tpu:
         try:
             attainable = round(_attainable_tflops(), 1)
         except Exception:
             pass
+    if attainable is None:
+        attainable = attainable_774m  # child's probe (same methodology)
 
     print(json.dumps({
         "metric": "gpt2_125m_train_tokens_per_sec_per_chip" if on_tpu
@@ -334,6 +441,15 @@ def main():
                               if attainable else None),
         "serving": serving,
         "train_zero2_flash_longseq": longseq,  # seq_len inside the value
+        # second headline config (the 125M line is a model-shape wall at
+        # ~44% MFU — PROFILE_TRAIN.md; MFU-vs-attainable rises with size)
+        "train_774m": dict(
+            train_774m,
+            mfu_vs_attainable=(round(train_774m["achieved_tflops"] /
+                                     (attainable_774m or attainable), 3)
+                               if (attainable_774m or attainable)
+                               and "achieved_tflops" in train_774m
+                               else None)),
     }))
 
 

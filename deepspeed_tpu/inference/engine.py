@@ -223,14 +223,33 @@ class InferenceEngine:
                 del get(tree, path[:-1])[path[-1]]
             return tree
 
-        params = jax.jit(rest)(key)
+        # the non-quantized remainder honors the same placement/cast
+        # contract as the init-then-quantize path (_shard_and_cast:
+        # serving-dtype recast + device_put under the plan's
+        # NamedSharding) — this path is gated to tp=1/ep=1, where the
+        # specs are replicated, but the contract should not silently
+        # diverge between init paths
+        params = self._shard_and_cast(jax.jit(rest)(key))
         for path, qleaf in quantized.items():
             get(params, path[:-1])[path[-1]] = qleaf
         return params, len(qpaths)
 
     def _shard_and_cast(self, params):
+        axes = self.logical_axes
+
+        def prune(ax, tree):
+            """Logical-axes subtree matching ``tree`` (the stream-init
+            path shards a PARTIAL tree whose quantized leaves were
+            carved out)."""
+            if isinstance(ax, dict) and isinstance(tree, dict):
+                return {k: prune(ax[k], v) for k, v in tree.items()
+                        if k in ax}
+            return ax
+
+        if axes is not None:
+            axes = prune(axes, params)
         specs = self.plan.compute_specs(
-            jax.eval_shape(lambda: params), self.logical_axes)
+            jax.eval_shape(lambda: params), axes)
 
         def put(p, spec):
             arr = jnp.asarray(p)
