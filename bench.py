@@ -125,8 +125,10 @@ def _bench_774m(on_tpu: bool):
     single-chip-feasible dense model. GPT-2-774M (L=36, d=1280) full
     AdamW step on one 16 GB chip — fits via bf16 grad accumulation
     (data_types.grad_accum_dtype, halves the accumulation buffer) +
-    save_attn remat; champion of scripts/sweep_774m.py (mb2 x gas8,
-    15.2k tok/s / 79.4 TF in the 2026-07-31 sweep; mb4 variants OOM)."""
+    dots_no_batch remat (saves matmul outputs, so the remat tax is mostly
+    elementwise recompute) + chunked CE; champion of scripts/sweep_774m.py
+    (mb2 x gas8: 16.7k tok/s / 87.0 TF in the 2026-07-31 sweep vs 79.4 TF
+    for save_attn; every mb4 variant OOMs)."""
     import time
 
     import jax
@@ -137,14 +139,14 @@ def _bench_774m(on_tpu: bool):
 
     groups.reset()
     if on_tpu:
-        cfg = GPT2Config.gpt2_774m()
+        cfg = GPT2Config.gpt2_774m(loss_chunk=512)
         batch, seq, steps, gas, windows = 2, 1024, 4, 8, 3
     else:
         cfg = GPT2Config(vocab_size=2048, max_seq_len=512, num_layers=3,
                          hidden_size=256, num_heads=8)
         batch, seq, steps, gas, windows = 1, 256, 2, 2, 1
     model = GPT2Model(cfg, attn_impl="flash" if on_tpu else "dense",
-                      remat=True, remat_policy="save_attn")
+                      remat=True, remat_policy="dots_no_batch")
     engine, *_ = deepspeed_tpu.initialize(model=model, config={
         "train_batch_size": batch * gas,
         "gradient_accumulation_steps": gas,
@@ -178,7 +180,8 @@ def _bench_774m(on_tpu: bool):
         engine.state.params))
     flops_tok = 6.0 * n_params + 12 * cfg.num_layers * cfg.hidden_size * seq
     return {"n_params": int(n_params), "micro_batch": batch, "gas": gas,
-            "remat": "save_attn", "grad_accum_dtype": "bf16",
+            "remat": "dots_no_batch", "loss_chunk": cfg.loss_chunk,
+            "grad_accum_dtype": "bf16",
             "tokens_per_sec": round(tps, 1),
             "achieved_tflops": round(tps * flops_tok / 1e12, 1)}
 

@@ -50,11 +50,12 @@ from jax.experimental.pallas import tpu as pltpu
 _NEG = float("-inf")
 
 # per-slot chunk budget: 4 chunk buffers live (2 slots x {K, V}) plus the
-# compute temporaries of one chunk must fit the 16 MB/core VMEM. Measured
-# at 125M B=8 (bf16): 1.57 MB chunks compiled to a 16.06 MB stack — 60 KB
-# over the limit — so the budget sits just under that (bg=4, cs=128
-# there: 0.79 MB chunks, 0.91 ms/tok in-engine).
-_CHUNK_BUDGET = 1_500_000  # just under the 1.5 MiB chunk that OOM'd
+# compute temporaries of one chunk. The kernel raises Mosaic's scoped
+# VMEM limit (vmem_limit_bytes below) past the 16 MB default, so the
+# budget targets covering all of B in ONE batch group (one DMA warmup
+# stall per layer instead of B/bg).
+_CHUNK_BUDGET = 3_300_000
+_VMEM_LIMIT = 40 * 1024 * 1024
 
 
 def supports(hq: int, hkv: int, s_max: int, dh: int) -> bool:
@@ -327,6 +328,8 @@ def fused_decode_step(q: jax.Array, k_full: jax.Array, v_full: jax.Array,
             pltpu.SemaphoreType.DMA((2, 2)),                   # read sems
         ],
         input_output_aliases={5: 1, 6: 2},
+        compiler_params=pltpu.CompilerParams(
+            vmem_limit_bytes=_VMEM_LIMIT),
         interpret=(jax.default_backend() != "tpu" if interpret is None
                    else interpret),
     )(layer_a, idx_a, qf, kn, vn, kview, vview)
