@@ -585,6 +585,9 @@ class DeepSpeedEngine:
         # per schedule granule, bounded by the scheduler's seq_per_step
         self._compiled_train_step = jax.jit(train_step, donate_argnums=(0,),
                                             static_argnums=(5,))
+        # subclass step builders (pipeline engine) and the 1-bit path keep
+        # the 4-arg signature; _run_fused_step checks this flag
+        self._step_takes_extra_args = True
         return self._compiled_train_step
 
     def _build_onebit_train_step(self, batch):
@@ -731,8 +734,14 @@ class DeepSpeedEngine:
                                 jnp.float32)
             self.state, metrics = self._compiled_train_step(
                 self.state, batch, lr, rng, theta)
-        elif self._onebit_compressed:
-            # the 1-bit shard_map step has a fixed 4-arg signature
+        elif not getattr(self, "_step_takes_extra_args", False):
+            # 1-bit shard_map step and subclass (pipeline) step builders
+            # keep the 4-arg signature
+            if ltd_keep is not None and not getattr(self, "_ltd_warned", False):
+                log_dist("random_ltd: this engine's train step does not "
+                         "route tokens — schedule tracked but NOT applied",
+                         ranks=[0])
+                self._ltd_warned = True
             self.state, metrics = self._compiled_train_step(
                 self.state, batch, lr, rng)
         else:

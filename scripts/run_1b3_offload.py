@@ -171,6 +171,11 @@ def phase_offload_e2e():
             "host_adam_gbps": round(adam_bytes / t_host_adam / 1e9, 2),
             "host_adam_threads": n_threads,
             "host_stream_copy_gbps": round(stream_gbps, 2),
+            "host_adam_note": (
+                "this sandbox exposes ONE core: the fused sweep is "
+                "core-compute-bound there (~10+ flops/param of Adam math "
+                "+ bf16 decode per 26 bytes), not bandwidth-bound; the "
+                "OMP-parallel kernel scales with cores on a real host"),
             "engine_init_sec": round(t_init, 1),
             "tunnel_d2h_mb_per_sec": round(d2h_bps / 1e6, 1)}
 

@@ -188,6 +188,12 @@ def compose(results):
 
     out = {"metric": "llama_6b7_single_chip",
            "serving": {"prompt_len": 512, "decode_len": 64, "batch": 1,
+                       "method": "dual_length_differencing(generate[128]-"
+                                 "generate[8])/120, medians — the bench.py/"
+                                 "PROFILE_DECODE.md methodology; int8 "
+                                 "streams ALL block matmuls (qkv, wo, "
+                                 "gate/up/down) through the manual-DMA "
+                                 "kernel with in-kernel layer slicing",
                        "int8": results["serve_int8"],
                        "bf16": results["serve_bf16"]}}
     l2, l6 = results["train_l2"], results["train_l6"]
