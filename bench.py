@@ -448,6 +448,7 @@ def main():
         # ~44% MFU — PROFILE_TRAIN.md; MFU-vs-attainable rises with size)
         "train_774m": dict(
             train_774m,
+            attainable_tflops_same_window=attainable_774m,
             mfu_vs_attainable=(round(train_774m["achieved_tflops"] /
                                      (attainable_774m or attainable), 3)
                                if (attainable_774m or attainable)
