@@ -10,7 +10,12 @@ from deepspeed_tpu.elasticity.elasticity import (
     get_candidate_batch_sizes,
     get_valid_gpus,
 )
+from deepspeed_tpu.elasticity.preemption import (
+    PREEMPTION_EXIT_CODE,
+    PreemptionHandler,
+)
 
 __all__ = ["ElasticityConfig", "ElasticityConfigError", "ElasticityError",
            "ElasticityIncompatibleWorldSize", "compute_elastic_config",
-           "elasticity_enabled", "get_candidate_batch_sizes", "get_valid_gpus"]
+           "elasticity_enabled", "get_candidate_batch_sizes", "get_valid_gpus",
+           "PREEMPTION_EXIT_CODE", "PreemptionHandler"]

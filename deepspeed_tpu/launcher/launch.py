@@ -39,6 +39,14 @@ def parse_args(args=None):
     parser.add_argument("--master_port", type=int, required=True)
     parser.add_argument("--elastic", action="store_true")
     parser.add_argument("--max_restarts", type=int, default=3)
+    parser.add_argument("--restart_window", type=float, default=None,
+                        help="rolling budget window in seconds: only restarts "
+                             "inside the trailing window count against "
+                             "--max_restarts (default: unbounded)")
+    parser.add_argument("--preemption_grace", type=float, default=120.0,
+                        help="seconds workers get after a SIGTERM (TPU "
+                             "maintenance/preemption notice) to finish their "
+                             "final checkpoint before being killed")
     parser.add_argument("user_script", type=str)
     parser.add_argument("user_args", nargs=argparse.REMAINDER)
     return parser.parse_args(args=args)
@@ -138,23 +146,54 @@ def main(args=None):
     world_info = decode_world_info(args.world_info)
     current: List[subprocess.Popen] = []
 
-    def handle(sig, frame):
+    def handle_int(sig, frame):
+        """User abort: tear everything down immediately."""
         for p in current:
             terminate_process_tree(p.pid)
         sys.exit(128 + sig)
 
-    signal.signal(signal.SIGINT, handle)
-    signal.signal(signal.SIGTERM, handle)
+    def handle_term(sig, frame):
+        """Preemption notice: forward SIGTERM to the workers so their
+        PreemptionHandler writes a final checkpoint, wait out the grace
+        window, then exit with the restartable preemption code if any
+        worker finished its graceful shutdown — killing workers instantly
+        here (the old behavior) truncated the final save mid-write and the
+        supervising elastic agent never saw the restartable code."""
+        from deepspeed_tpu.elasticity.preemption import PREEMPTION_EXIT_CODE
+
+        for p in current:
+            try:
+                os.killpg(os.getpgid(p.pid), signal.SIGTERM)
+            except (ProcessLookupError, PermissionError):
+                pass
+        deadline = time.time() + args.preemption_grace
+        rcs = []
+        for p in current:
+            try:
+                rcs.append(p.wait(timeout=max(0.0, deadline - time.time())))
+            except subprocess.TimeoutExpired:
+                logger.error(f"worker pid {p.pid} did not finish its final "
+                             f"checkpoint within {args.preemption_grace}s; killing")
+                terminate_process_tree(p.pid)
+                rcs.append(128 + signal.SIGKILL)
+        restartable = any(rc == PREEMPTION_EXIT_CODE for rc in rcs)
+        sys.exit(PREEMPTION_EXIT_CODE if restartable else 128 + sig)
+
+    signal.signal(signal.SIGINT, handle_int)
+    signal.signal(signal.SIGTERM, handle_term)
 
     if args.elastic:
         from deepspeed_tpu.elasticity.elastic_agent import ElasticAgent
+        from deepspeed_tpu.elasticity.preemption import PREEMPTION_EXIT_CODE
 
         def spawn_tracked():
             current[:] = spawn_workers(args, world_info)
             return current
 
         agent = ElasticAgent(spawn_fn=spawn_tracked, monitor_fn=monitor,
-                             max_restarts=args.max_restarts)
+                             max_restarts=args.max_restarts,
+                             restart_window_s=args.restart_window,
+                             restartable_exit_codes=(PREEMPTION_EXIT_CODE,))
         rc = agent.run()
     else:
         current[:] = spawn_workers(args, world_info)

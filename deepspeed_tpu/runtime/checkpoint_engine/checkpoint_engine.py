@@ -6,13 +6,24 @@ with Torch/Nebula engines replaced by Native (npz) and Orbax backends.
 from __future__ import annotations
 
 import abc
+import copy
 import json
 import os
-from typing import Any, Dict, Optional
+import zlib
+from typing import Any, Dict, Optional, Tuple
 
 import numpy as np
 
-from deepspeed_tpu.utils.logging import log_dist
+from deepspeed_tpu.utils import fs
+from deepspeed_tpu.utils.logging import log_dist, logger
+
+MANIFEST_KEY = "__integrity__"
+MANIFEST_VERSION = 1
+
+
+class CheckpointCorruptionError(RuntimeError):
+    """A checkpoint failed integrity verification (truncated file, checksum
+    mismatch, missing/extra arrays, or absent manifest where required)."""
 
 
 class CheckpointEngine(abc.ABC):
@@ -102,17 +113,77 @@ def _unflatten_into(tree_like, flat: Dict[str, np.ndarray], strict: bool = True)
     return jax.tree_util.tree_unflatten(treedef, out), missing
 
 
+def _array_checksum(arr: np.ndarray) -> Dict[str, Any]:
+    """Per-array integrity record over the *stored* representation."""
+    return {"crc32": zlib.crc32(np.ascontiguousarray(arr).tobytes()) & 0xFFFFFFFF,
+            "dtype": str(arr.dtype),
+            "shape": list(arr.shape)}
+
+
+def _build_manifest(stored: Dict[str, np.ndarray]) -> Dict[str, Any]:
+    return {"version": MANIFEST_VERSION,
+            "arrays": {k: _array_checksum(v) for k, v in stored.items()}}
+
+
+def _verify_manifest(manifest: Dict[str, Any],
+                     stored: Dict[str, np.ndarray]) -> Tuple[bool, str]:
+    """Check ``stored`` arrays against ``manifest``; returns (ok, reason)."""
+    expected = manifest.get("arrays", {})
+    missing = sorted(set(expected) - set(stored))
+    extra = sorted(set(stored) - set(expected))
+    if missing or extra:
+        return False, (f"array set mismatch (missing {missing[:5]}, "
+                       f"unexpected {extra[:5]})")
+    bad = []
+    for key, rec in expected.items():
+        got = _array_checksum(stored[key])
+        if got != rec:
+            bad.append(f"{key} (expected {rec}, got {got})")
+    if bad:
+        return False, f"checksum mismatch: {'; '.join(bad[:3])}"
+    return True, "ok"
+
+
+def verify_checkpoint(path: str, require_manifest: bool = True) -> Tuple[bool, str]:
+    """Standalone integrity check of a native ``state.npz``: readable zip,
+    manifest present, every array's crc32/dtype/shape matches. Never raises —
+    returns ``(ok, reason)`` so auto-resume can log *why* a tag was skipped."""
+    if not os.path.exists(path):
+        return False, "missing state file"
+    try:
+        data = fs.retry_io(lambda: np.load(path, allow_pickle=False),
+                           description=f"open {path}")
+        stored = {k: data[k] for k in data.files if k != "__meta__"}
+        meta = json.loads(str(data["__meta__"])) if "__meta__" in data.files else {}
+    except Exception as e:  # truncated zip, bad header, I/O error, ...
+        return False, f"unreadable ({type(e).__name__}: {e})"
+    manifest = meta.get(MANIFEST_KEY)
+    if manifest is None:
+        if require_manifest:
+            return False, "no integrity manifest"
+        return True, "ok (no manifest; unverified)"
+    return _verify_manifest(manifest, stored)
+
+
 class NativeCheckpointEngine(CheckpointEngine):
     """npz-based global-array checkpoints: one logical checkpoint keyed by
     parameter path, independent of mesh/ZeRO layout — "universal by default"
     (the reference needs a whole conversion subsystem, deepspeed/checkpoint/,
-    to get this property; see SURVEY §5.4)."""
+    to get this property; see SURVEY §5.4).
+
+    Durability contract: the npz is serialized in memory, written to
+    ``path + '.tmp'`` with retries, and atomically renamed onto ``path`` —
+    a crash mid-save never leaves a torn file at the final name. Every
+    stored array's crc32/dtype/shape is recorded in a manifest inside
+    ``__meta__`` and verified on load."""
 
     def save(self, state_dict: Dict[str, Any], path: str, on_success=None):
         import jax
         import ml_dtypes
 
-        self.makedirs(os.path.dirname(path))
+        dirname = os.path.dirname(path)
+        if dirname:  # bare filename → cwd; os.makedirs("") would raise
+            self.makedirs(dirname)
         arrays = {}
         meta = {}
         for section, tree in state_dict.items():
@@ -130,7 +201,14 @@ class NativeCheckpointEngine(CheckpointEngine):
             else:
                 out[k] = v
         if jax.process_index() == 0:  # gather above is collective; write once
-            np.savez(path, __meta__=json.dumps(meta), **out)
+            meta = dict(meta)  # don't mutate the caller's meta
+            # manifest only on the writing process: checksumming the whole
+            # gathered state on every non-writing host would be pure waste
+            meta[MANIFEST_KEY] = _build_manifest(out)
+            # streamed: the serialized zip never exists in host memory —
+            # at multi-GB scale the gathered arrays alone are the budget
+            fs.atomic_stream_write(
+                path, lambda f: np.savez(f, __meta__=json.dumps(meta), **out))
         log_dist(f"[native-ckpt] saved {len(arrays)} arrays to {path}", ranks=[0])
         if on_success is not None:
             on_success()
@@ -140,14 +218,33 @@ class NativeCheckpointEngine(CheckpointEngine):
 
         if not os.path.exists(path):
             raise FileNotFoundError(path)
-        data = np.load(path, allow_pickle=False)
-        out: Dict[str, Dict[str, np.ndarray]] = {}
-        meta = {}
-        for key in data.files:
-            if key == "__meta__":
-                meta = json.loads(str(data[key]))
-                continue
-            arr = data[key]
+        try:
+            data = fs.retry_io(lambda: np.load(path, allow_pickle=False),
+                               description=f"open {path}")
+            files = list(data.files)
+            out: Dict[str, Dict[str, np.ndarray]] = {}
+            meta = {}
+            stored: Dict[str, np.ndarray] = {}
+            for key in files:
+                if key == "__meta__":
+                    meta = json.loads(str(data[key]))
+                    continue
+                stored[key] = data[key]
+        except Exception as e:
+            raise CheckpointCorruptionError(
+                f"checkpoint {path} is unreadable "
+                f"({type(e).__name__}: {e}) — likely a truncated or torn write"
+            ) from e
+        manifest = meta.get(MANIFEST_KEY)
+        if manifest is None:
+            logger.warning(f"checkpoint {path} has no integrity manifest; "
+                           f"loading unverified (pre-manifest checkpoint?)")
+        else:
+            ok, reason = _verify_manifest(manifest, stored)
+            if not ok:
+                raise CheckpointCorruptionError(
+                    f"checkpoint {path} failed integrity verification: {reason}")
+        for key, arr in stored.items():
             if key.endswith("@bf16"):
                 key, arr = key[:-5], arr.view(ml_dtypes.bfloat16)
             elif key.endswith("@f16"):
@@ -188,7 +285,9 @@ class AsyncCheckpointEngine(CheckpointEngine):
         snapshot: Dict[str, Any] = {}
         for section, tree in state_dict.items():
             if section == "__meta__":
-                snapshot[section] = dict(tree)
+                # deep copy: a shallow dict() would alias nested dicts that
+                # the caller mutates during the overlapped write
+                snapshot[section] = copy.deepcopy(tree)
             else:
                 snapshot[section] = {k: np.array(v, copy=True)
                                      for k, v in _flatten_state(tree).items()}
@@ -220,9 +319,12 @@ class AsyncCheckpointEngine(CheckpointEngine):
             t.join()
         self._pending.clear()
         if self._errors:
-            err = self._errors[0]
+            errs = list(self._errors)
             self._errors.clear()
-            raise RuntimeError(f"async checkpoint write failed: {err}") from err
+            detail = "; ".join(f"{type(e).__name__}: {e}" for e in errs)
+            raise RuntimeError(
+                f"async checkpoint write failed ({len(errs)} error"
+                f"{'s' if len(errs) != 1 else ''}): {detail}") from errs[0]
 
 
 class OrbaxCheckpointEngine(CheckpointEngine):
@@ -243,8 +345,7 @@ class OrbaxCheckpointEngine(CheckpointEngine):
         state_dict = dict(state_dict)  # don't mutate the caller's dict
         meta = state_dict.pop("__meta__", {})
         self._ckptr.save(os.path.abspath(path) + ".orbax", state_dict, force=True)
-        with open(path + ".meta.json", "w") as f:
-            json.dump(meta, f)
+        fs.atomic_write_text(path + ".meta.json", json.dumps(meta))
         if on_success is not None:
             on_success()
 

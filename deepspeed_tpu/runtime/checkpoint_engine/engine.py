@@ -22,10 +22,14 @@ import jax
 import numpy as np
 
 from deepspeed_tpu.runtime.checkpoint_engine.checkpoint_engine import (
+    MANIFEST_KEY,
+    CheckpointCorruptionError,
     NativeCheckpointEngine,
     _flatten_state,
     _unflatten_into,
+    verify_checkpoint,
 )
+from deepspeed_tpu.utils import fs
 from deepspeed_tpu.utils.logging import log_dist, logger
 
 
@@ -87,13 +91,14 @@ def save_engine_checkpoint(engine, save_dir: str, tag: Optional[str] = None,
 
     def finalize():
         """Runs only after the state is durably written — an async engine
-        must never publish 'latest' for a failed write."""
+        must never publish 'latest' for a failed write. Both sidecars are
+        published atomically (tmp + rename) so a crash here can't leave a
+        torn 'latest' pointing nowhere or a half-written client state."""
         if jax.process_index() == 0:
-            with open(os.path.join(save_dir, tag, "client_state.json"), "w") as f:
-                json.dump(cs, f, indent=2)
+            fs.atomic_write_text(os.path.join(save_dir, tag, "client_state.json"),
+                                 json.dumps(cs, indent=2))
             if save_latest:
-                with open(os.path.join(save_dir, "latest"), "w") as f:
-                    f.write(tag)
+                fs.atomic_write_text(os.path.join(save_dir, "latest"), tag)
 
     ckpt_engine.save(state_dict, path, on_success=finalize)
     ckpt_engine.commit(tag)
@@ -101,21 +106,182 @@ def save_engine_checkpoint(engine, save_dir: str, tag: Optional[str] = None,
     return True
 
 
+def list_checkpoint_tags(load_dir: str):
+    """Tag directories under ``load_dir`` that look like checkpoints (native
+    npz or orbax layout), newest state file first."""
+    try:
+        names = os.listdir(load_dir)
+    except OSError:
+        return []
+    found = []
+    for name in names:
+        tag_dir = os.path.join(load_dir, name)
+        if not os.path.isdir(tag_dir):
+            continue
+        for probe in ("state.npz", "state.npz.orbax", "state.npz.meta.json"):
+            p = os.path.join(tag_dir, probe)
+            try:
+                found.append((os.path.getmtime(p), name))
+                break
+            except OSError:  # vanished between listdir and stat (cleanup race)
+                continue
+    return [name for _, name in sorted(found, reverse=True)]
+
+
+def validate_checkpoint_tag(load_dir: str, tag: str):
+    """Cheap structural + integrity validation of one tag; (ok, reason).
+    Native checkpoints must carry a manifest with passing checksums; orbax
+    checkpoints (self-verified by orbax) just need their directory."""
+    npz = os.path.join(load_dir, tag, "state.npz")
+    if os.path.exists(npz):
+        return verify_checkpoint(npz, require_manifest=True)
+    if os.path.exists(npz + ".orbax"):
+        return True, "ok (orbax, self-verified)"
+    return False, "missing state.npz"
+
+
+_NO_MANIFEST = "no integrity manifest"
+
+
+def _read_client_state(load_dir: str, tag: str):
+    """Parse a tag's client_state.json; None when absent or unreadable.
+    Explicit-tag loads resume from checkpoint meta alone when the sidecar
+    is torn (pre-atomic writer) — the state itself loaded fine."""
+    cs_path = os.path.join(load_dir, tag, "client_state.json")
+    if not os.path.exists(cs_path):
+        return None
+    try:
+        return json.loads(fs.read_bytes_with_retry(cs_path).decode())
+    except Exception as e:
+        logger.warning(f"client_state.json for tag '{tag}' unreadable "
+                       f"({type(e).__name__}: {e}); resuming from "
+                       f"checkpoint meta only")
+        return None
+
+
+def _read_latest_tag(load_dir: str):
+    """Best-effort read of the 'latest' pointer; None when absent or
+    unreadable (an unreadable pointer must not kill auto-resume — the
+    candidate scan still finds every tag on disk)."""
+    latest_path = os.path.join(load_dir, "latest")
+    if not os.path.exists(latest_path):
+        return None
+    try:
+        return fs.read_bytes_with_retry(latest_path).decode().strip() or None
+    except (OSError, UnicodeDecodeError) as e:  # unreadable OR bit-rotted binary
+        logger.warning(f"auto-resume: 'latest' pointer unreadable "
+                       f"({type(e).__name__}: {e}); scanning candidate tags")
+        return None
+
+
+def _try_load_candidate(load_dir: str, tag: str, ckpt_engine):
+    """One verified load attempt of ``tag``. Returns ``(loaded, cs,
+    reason)``: the loaded dict + parsed client_state (or None when absent)
+    with reason 'ok' (checksum-verified) or the no-manifest marker
+    (readable legacy checkpoint), else ``(None, None, why)``. The sidecar
+    client_state.json, when present, must parse — a torn sidecar from a
+    pre-atomic-writer crash invalidates the candidate."""
+    npz = os.path.join(load_dir, tag, "state.npz")
+    if not (os.path.exists(npz) or os.path.exists(npz + ".orbax")):
+        return None, None, "missing state.npz"
+    try:
+        loaded = ckpt_engine.load(npz)  # native engines checksum-verify here
+    except Exception as e:
+        return None, None, f"unloadable ({type(e).__name__}: {e})"
+    cs = None
+    cs_path = os.path.join(load_dir, tag, "client_state.json")
+    if os.path.exists(cs_path):
+        try:
+            cs = json.loads(fs.read_bytes_with_retry(cs_path).decode())
+        except Exception as e:
+            return None, None, f"corrupt client_state.json ({type(e).__name__}: {e})"
+    if os.path.exists(npz) and MANIFEST_KEY not in loaded.get("__meta__", {}):
+        return loaded, cs, _NO_MANIFEST
+    return loaded, cs, "ok"
+
+
+def _auto_resume_load(load_dir: str, ckpt_engine):
+    """Load the newest *valid* checkpoint under ``load_dir``: the 'latest'
+    pointer is tried first, then every other candidate tag newest-first —
+    each candidate (state + sidecar) is read at most once. Returns
+    ``(tag, loaded, client_state)``; ``(None, None, None)`` when the
+    directory holds no candidates at all. Manifest-verified candidates win;
+    if none exists, the newest *readable* pre-manifest checkpoint (written
+    before integrity manifests existed) is accepted with a warning so
+    upgrading never strands an existing run. Raises
+    :class:`CheckpointCorruptionError` when candidates exist but none is
+    loadable (silently restarting from scratch after data loss is worse
+    than failing loudly)."""
+    latest_tag = _read_latest_tag(load_dir)
+    candidates = list_checkpoint_tags(load_dir)
+    ordered = ([latest_tag] if latest_tag else []) + \
+        [t for t in candidates if t != latest_tag]
+    if not ordered:
+        return None, None, None
+    skipped = []
+    legacy = None  # newest readable pre-manifest candidate, held as last resort
+    for t in ordered:
+        loaded, cs, reason = _try_load_candidate(load_dir, t, ckpt_engine)
+        if loaded is not None and reason == "ok":
+            if skipped or t != latest_tag:
+                logger.warning(
+                    f"auto-resume: falling back to checkpoint '{t}' "
+                    f"(latest='{latest_tag}'); skipped: "
+                    + "; ".join(f"{s}: {r}" for s, r in skipped))
+            return t, loaded, cs
+        if loaded is not None and legacy is None:
+            legacy = (t, loaded, cs)
+        skipped.append((t, reason))
+        logger.warning(f"auto-resume: skipping checkpoint '{t}': {reason}")
+    if legacy is not None:
+        t, loaded, cs = legacy
+        logger.warning(
+            f"auto-resume: no manifest-verified checkpoint under {load_dir}; "
+            f"resuming from pre-manifest checkpoint '{t}' "
+            f"(unverified — re-save to gain integrity checking)")
+        return t, loaded, cs
+    raise CheckpointCorruptionError(
+        f"no valid checkpoint under {load_dir} "
+        f"(latest='{latest_tag}'); candidates rejected: "
+        + "; ".join(f"{t}: {r}" for t, r in skipped))
+
+
 def load_engine_checkpoint(engine, load_dir: str, tag: Optional[str] = None,
                            load_optimizer_states: bool = True,
                            load_lr_scheduler_states: bool = True,
                            load_module_only: bool = False,
                            checkpoint_engine=None):
-    if tag is None:
-        latest = os.path.join(load_dir, "latest")
-        if not os.path.exists(latest):
-            logger.warning(f"no 'latest' file under {load_dir}; nothing loaded")
-            return None, {}
-        with open(latest) as f:
-            tag = f.read().strip()
-    path = os.path.join(load_dir, tag, "state.npz")
     ckpt_engine = checkpoint_engine or NativeCheckpointEngine()
-    loaded = ckpt_engine.load(path)
+    if tag is None:
+        # Per-host resolution from each host's own filesystem view: every
+        # host must reach the agreement collective below no matter its
+        # local outcome (early return or raise here would strand peers in
+        # the collective), and with divergent views the tag check either
+        # raises everywhere (tag_validation=Fail) or logs loudly — hosts
+        # silently resuming different steps is the one unacceptable result.
+        err = None
+        try:
+            tag, loaded, cs = _auto_resume_load(load_dir, ckpt_engine)
+        except CheckpointCorruptionError as e:
+            tag, loaded, cs, err = None, None, None, e
+        _validate_tag(engine, tag if tag is not None else
+                      ("<corrupt>" if err is not None else "<none>"))
+        if err is not None:
+            raise err
+        if tag is None:
+            logger.warning(f"no checkpoint found under {load_dir}; nothing loaded")
+            return None, {}
+    else:
+        base = os.path.join(load_dir, tag, "state.npz")
+        if not (os.path.exists(base) or os.path.exists(base + ".orbax")):
+            latest = _read_latest_tag(load_dir) or "<absent>"
+            avail = list_checkpoint_tags(load_dir)
+            raise FileNotFoundError(
+                f"checkpoint tag '{tag}' not found under {load_dir}: no "
+                f"{base}; 'latest' points to '{latest}'; available tags: "
+                f"{avail if avail else 'none'}")
+        loaded = ckpt_engine.load(base)
+        cs = _read_client_state(load_dir, tag)
 
     # universal-by-default: re-shard global arrays onto the *current* plan
     from deepspeed_tpu.runtime.engine import TrainState
@@ -161,10 +327,7 @@ def load_engine_checkpoint(engine, load_dir: str, tag: Optional[str] = None,
     engine.global_steps = gstep
 
     client_state = {}
-    cs_path = os.path.join(load_dir, tag, "client_state.json")
-    if os.path.exists(cs_path):
-        with open(cs_path) as f:
-            cs = json.load(f)
+    if cs is not None:
         engine.global_steps = cs.get("global_steps", gstep)
         engine.micro_steps = cs.get("micro_steps", 0)
         engine.skipped_steps = cs.get("skipped_steps", 0)
@@ -181,14 +344,13 @@ def load_params_for_inference(load_dir: str, template, tag: Optional[str] = None
     """Load ONLY the model params from an engine checkpoint, re-keyed onto
     ``template``'s pytree structure (reference InferenceEngine checkpoint-dict
     loading, inference/engine.py:338 load_model_with_checkpoint)."""
+    ckpt_engine = NativeCheckpointEngine()
     if tag is None:
-        latest = os.path.join(load_dir, "latest")
-        if os.path.exists(latest):
-            with open(latest) as f:
-                tag = f.read().strip()
-        else:
-            raise FileNotFoundError(f"no 'latest' file under {load_dir}")
-    loaded = NativeCheckpointEngine().load(os.path.join(load_dir, tag, "state.npz"))
+        tag, loaded, _ = _auto_resume_load(load_dir, ckpt_engine)
+        if tag is None:
+            raise FileNotFoundError(f"no checkpoint found under {load_dir}")
+    else:
+        loaded = ckpt_engine.load(os.path.join(load_dir, tag, "state.npz"))
     params, _ = _unflatten_into(template, loaded.get("params", {}))
     return params
 

@@ -712,6 +712,9 @@ class DeepSpeedEngine:
         return self._run_fused_step(batch)
 
     def _run_fused_step(self, batch):
+        h = getattr(self, "_preemption_handler", None)
+        if h is not None:
+            h.poll()  # deferred preemption: final save at the step boundary
         if self._host_opt is not None:
             return self._run_host_step(batch)
         if self._compiled_train_step is None:
@@ -1041,3 +1044,44 @@ class DeepSpeedEngine:
         from deepspeed_tpu.runtime.checkpoint_engine.engine import save_16bit_model
 
         return save_16bit_model(self, save_dir, save_filename)
+
+    def install_preemption_handler(self, save_dir, tag=None, defer=None,
+                                   **handler_kw):
+        """SIGTERM (TPU maintenance/preemption notice) → final synchronous
+        checkpoint to ``save_dir`` → exit with the restartable preemption
+        code, which the elastic agent restarts without burning budget.
+        Returns the installed handler (also usable as a maintenance-event
+        callback via ``handler.trigger()``).
+
+        On multi-host meshes the final save is deferred to the next step
+        boundary (the engine polls the handler each train step): the save's
+        gather collectives must not launch from an arbitrary
+        signal-interrupt point where they could interleave with in-flight
+        step collectives differently on each host. Single-host defaults to
+        immediate. Override via ``defer``."""
+        from deepspeed_tpu.elasticity.preemption import PreemptionHandler
+
+        def final_save():
+            self.save_checkpoint(save_dir, tag=tag)
+            ck = self._checkpoint_engine()
+            if ck is not None and hasattr(ck, "wait"):
+                ck.wait()  # async engine: durable before the process dies
+
+        if defer is None:
+            defer = jax.process_count() > 1
+        if defer and jax.process_count() > 1 and \
+                "consensus_fn" not in handler_kw:
+            # per-step scalar allgather: hosts agree who saw a notice, so
+            # the save's collectives start on every host at the SAME step
+            # boundary — the cost is opt-in (handler installed) and tiny
+            def consensus(local_flag):
+                from jax.experimental import multihost_utils
+
+                votes = multihost_utils.process_allgather(
+                    np.int32(bool(local_flag)))
+                return bool(np.max(votes))
+
+            handler_kw["consensus_fn"] = consensus
+        self._preemption_handler = PreemptionHandler(
+            final_save, defer=defer, **handler_kw).install()
+        return self._preemption_handler

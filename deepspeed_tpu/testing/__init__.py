@@ -1,0 +1,9 @@
+from deepspeed_tpu.testing.fault_injection import (
+    FakeClock,
+    FaultInjector,
+    ScriptedWorkerGroup,
+    SimulatedCrash,
+)
+
+__all__ = ["FakeClock", "FaultInjector", "ScriptedWorkerGroup",
+           "SimulatedCrash"]

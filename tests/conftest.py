@@ -9,10 +9,36 @@ tests/unit/common.py, could not do).
 import os
 
 # Must be set before the first jax backend initialisation.
+_COLLECTIVE_FLAGS = ("--xla_cpu_collective_call_terminate_timeout_seconds=300"
+                     " --xla_cpu_collective_timeout_seconds=300")
+
+
+def _collective_flags_supported() -> bool:
+    """XLA treats unknown XLA_FLAGS as FATAL (parse_flags_from_env.cc aborts
+    the process), and the collective-timeout flags exist only in some jaxlib
+    builds — adding them blindly turns every test process into an instant
+    SIGABRT. Probe once in a subprocess; children inherit the cached verdict
+    via the environment."""
+    cached = os.environ.get("DSTPU_XLA_COLLECTIVE_FLAGS_OK")
+    if cached is not None:
+        return cached == "1"
+    import subprocess
+    import sys
+    env = dict(os.environ, XLA_FLAGS=_COLLECTIVE_FLAGS, JAX_PLATFORMS="cpu")
+    try:
+        ok = subprocess.run(
+            [sys.executable, "-c", "import jax; jax.devices()"],
+            env=env, capture_output=True, timeout=120).returncode == 0
+    except Exception:
+        ok = False
+    os.environ["DSTPU_XLA_COLLECTIVE_FLAGS_OK"] = "1" if ok else "0"
+    return ok
+
+
 _flags = os.environ.get("XLA_FLAGS", "")
 if "host_platform_device_count" not in _flags:
     _flags += " --xla_force_host_platform_device_count=8"
-if "collective_call_terminate" not in _flags:
+if "collective_call_terminate" not in _flags and _collective_flags_supported():
     # this sandbox exposes ONE cpu core: 8 virtual-device collective threads
     # timeshare it, and long XLA compiles can starve a rendezvous past the
     # default ~20/40s warn/terminate deadlines → spurious hard aborts.
@@ -23,8 +49,7 @@ if "collective_call_terminate" not in _flags:
     # 300s (not more): with the per-module subprocess isolation below, a
     # genuinely wedged collective should abort the CHILD quickly so the
     # parent can retry the module, rather than stall the suite for 15 min.
-    _flags += (" --xla_cpu_collective_call_terminate_timeout_seconds=300"
-               " --xla_cpu_collective_timeout_seconds=300")
+    _flags += " " + _COLLECTIVE_FLAGS
 os.environ["XLA_FLAGS"] = _flags
 os.environ["DSTPU_ACCELERATOR"] = "cpu"
 

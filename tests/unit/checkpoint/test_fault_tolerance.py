@@ -1,0 +1,343 @@
+"""Fault-tolerant checkpointing: atomic publication, integrity manifest,
+and auto-resume fallback to the newest valid tag — driven by the
+fault-injection harness (no subprocesses; tier-1-safe)."""
+
+import os
+import sys
+from pathlib import Path
+
+import jax
+import numpy as np
+import pytest
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
+from simple_model import SimpleModel, random_batch  # noqa: E402
+
+import deepspeed_tpu  # noqa: E402
+from deepspeed_tpu.runtime.checkpoint_engine.checkpoint_engine import (  # noqa: E402
+    CheckpointCorruptionError,
+    NativeCheckpointEngine,
+    verify_checkpoint,
+)
+from deepspeed_tpu.runtime.checkpoint_engine.engine import (  # noqa: E402
+    list_checkpoint_tags,
+    validate_checkpoint_tag,
+)
+from deepspeed_tpu.testing.fault_injection import (  # noqa: E402
+    FaultInjector,
+    SimulatedCrash,
+)
+
+pytestmark = pytest.mark.fault
+
+
+def make_engine():
+    engine, *_ = deepspeed_tpu.initialize(
+        model=SimpleModel(hidden_dim=8),
+        config={"train_batch_size": 8,
+                "optimizer": {"type": "Adam", "params": {"lr": 1e-2}},
+                "steps_per_print": 0})
+    return engine
+
+
+def train_steps(engine, n, seed0=0):
+    for i in range(n):
+        b = random_batch(batch_size=8, hidden_dim=8, seed=seed0 + i)
+        engine.train_batch_from_stacked(jax.tree_util.tree_map(lambda x: x[None], b))
+
+
+def params_equal(a, b):
+    fa = jax.tree_util.tree_leaves(jax.device_get(a))
+    fb = jax.tree_util.tree_leaves(jax.device_get(b))
+    return all(np.allclose(x, y) for x, y in zip(fa, fb))
+
+
+def truncate_file(path, keep=120):
+    raw = path.read_bytes()
+    assert len(raw) > keep
+    path.write_bytes(raw[:keep])
+
+
+class TestIntegrityManifest:
+    def test_saved_checkpoint_verifies(self, tmp_path):
+        eng = NativeCheckpointEngine()
+        eng.save({"params": {"w": np.arange(12, dtype=np.float32)},
+                  "__meta__": {"global_step": 3}}, str(tmp_path / "state.npz"))
+        ok, reason = verify_checkpoint(str(tmp_path / "state.npz"))
+        assert ok, reason
+
+    def test_meta_not_mutated_by_save(self, tmp_path):
+        eng = NativeCheckpointEngine()
+        meta = {"global_step": 3}
+        eng.save({"params": {"w": np.ones(4, np.float32)}, "__meta__": meta},
+                 str(tmp_path / "state.npz"))
+        assert meta == {"global_step": 3}  # manifest added to a copy only
+
+    def test_truncated_file_fails_verification_and_load(self, tmp_path):
+        eng = NativeCheckpointEngine()
+        path = tmp_path / "state.npz"
+        eng.save({"params": {"w": np.arange(1000, dtype=np.float32)}}, str(path))
+        truncate_file(path)
+        ok, reason = verify_checkpoint(str(path))
+        assert not ok and "unreadable" in reason
+        with pytest.raises(CheckpointCorruptionError, match="truncated or torn"):
+            eng.load(str(path))
+
+    def test_missing_array_fails_manifest_check(self, tmp_path):
+        """Corruption that survives the zip layer (valid archive, wrong
+        contents) is caught by the per-array manifest."""
+        eng = NativeCheckpointEngine()
+        path = tmp_path / "state.npz"
+        eng.save({"params": {"w": np.ones(8, np.float32),
+                             "b": np.zeros(8, np.float32)}}, str(path))
+        data = np.load(str(path), allow_pickle=False)
+        keys = sorted(k for k in data.files if k != "__meta__")
+        np.savez(str(path), __meta__=str(data["__meta__"]),
+                 **{k: data[k] for k in keys[1:]})  # drop one array
+        ok, reason = verify_checkpoint(str(path))
+        assert not ok and "array set mismatch" in reason
+        with pytest.raises(CheckpointCorruptionError, match="integrity"):
+            eng.load(str(path))
+
+    def test_modified_array_fails_checksum(self, tmp_path):
+        eng = NativeCheckpointEngine()
+        path = tmp_path / "state.npz"
+        eng.save({"params": {"w": np.ones(8, np.float32)}}, str(path))
+        data = np.load(str(path), allow_pickle=False)
+        arrays = {k: data[k] for k in data.files if k != "__meta__"}
+        (key, arr), = arrays.items()
+        np.savez(str(path), __meta__=str(data["__meta__"]), **{key: arr * 2.0})
+        ok, reason = verify_checkpoint(str(path))
+        assert not ok and "checksum mismatch" in reason
+
+    def test_manifest_less_checkpoint_second_class_but_resumable(self, tmp_path):
+        """Pre-manifest (legacy) checkpoints fail strict validation and lose
+        to any manifest-verified candidate, but remain loadable explicitly
+        AND as an auto-resume last resort — upgrading the code must never
+        strand an existing run."""
+        from deepspeed_tpu.runtime.checkpoint_engine.engine import (
+            _auto_resume_load)
+
+        (tmp_path / "legacy").mkdir()
+        path = tmp_path / "legacy" / "state.npz"
+        np.savez(str(path), **{"params::w": np.ones(4, np.float32)})
+        ok, reason = validate_checkpoint_tag(str(tmp_path), "legacy")
+        assert not ok and "manifest" in reason
+        eng = NativeCheckpointEngine()
+        loaded = eng.load(str(path))  # explicit: allowed
+        np.testing.assert_array_equal(loaded["params"]["w"], np.ones(4))
+        # alone, it is the auto-resume fallback (unverified)
+        tag, loaded, _ = _auto_resume_load(str(tmp_path), eng)
+        assert tag == "legacy"
+        np.testing.assert_array_equal(loaded["params"]["w"], np.ones(4))
+        # a manifest-verified candidate wins even though it is older
+        eng.save({"params": {"w": np.zeros(4, np.float32)}},
+                 str(tmp_path / "verified" / "state.npz"))
+        os.utime(tmp_path / "verified" / "state.npz", (1, 1))
+        tag, loaded, _ = _auto_resume_load(str(tmp_path), eng)
+        assert tag == "verified"
+        np.testing.assert_array_equal(loaded["params"]["w"], np.zeros(4))
+
+    def test_torn_client_state_invalidates_candidate(self, tmp_path):
+        eng = NativeCheckpointEngine()
+        from deepspeed_tpu.runtime.checkpoint_engine.engine import (
+            _auto_resume_load)
+
+        eng.save({"params": {"w": np.ones(4, np.float32)}},
+                 str(tmp_path / "good" / "state.npz"))
+        os.utime(tmp_path / "good" / "state.npz", (1, 1))
+        eng.save({"params": {"w": np.zeros(4, np.float32)}},
+                 str(tmp_path / "torn" / "state.npz"))
+        (tmp_path / "torn" / "client_state.json").write_text('{"global_steps"')
+        (tmp_path / "latest").write_text("torn")
+        tag, loaded, _ = _auto_resume_load(str(tmp_path), eng)
+        assert tag == "good"
+        np.testing.assert_array_equal(loaded["params"]["w"], np.ones(4))
+
+    def test_bare_filename_save(self, tmp_path, monkeypatch):
+        """Regression: save('state.npz') used to call os.makedirs('')."""
+        monkeypatch.chdir(tmp_path)
+        NativeCheckpointEngine().save({"params": {"w": np.ones(2, np.float32)}},
+                                      "state.npz")
+        assert os.path.exists("state.npz")
+
+
+class TestAtomicPublish:
+    def test_crash_mid_write_never_publishes_latest(self, tmp_path):
+        """Acceptance: a save interrupted mid-write never moves 'latest' to
+        a broken tag, and the next tag-less load succeeds from the prior
+        valid checkpoint."""
+        ckpt = str(tmp_path / "ck")
+        e1 = make_engine()
+        train_steps(e1, 1)
+        e1.save_checkpoint(ckpt, tag="t1")
+        good = jax.device_get(e1.state.params)
+        train_steps(e1, 1, seed0=1)
+        with FaultInjector() as inj:
+            inj.truncate_write(nth=1, keep_bytes=80)  # dies writing state.npz
+            with pytest.raises(SimulatedCrash):
+                e1.save_checkpoint(ckpt, tag="t2")
+        assert (tmp_path / "ck" / "latest").read_text() == "t1"
+        assert not (tmp_path / "ck" / "t2" / "state.npz").exists()
+
+        e2 = make_engine()
+        path, _ = e2.load_checkpoint(ckpt)
+        assert path is not None and path.endswith("t1")
+        assert params_equal(good, e2.state.params)
+
+    def test_crash_before_rename_preserves_prior_state(self, tmp_path):
+        """Complete tmp write, death at the publish rename: the previous
+        state.npz (and 'latest') stay intact."""
+        ckpt = str(tmp_path / "ck")
+        e1 = make_engine()
+        train_steps(e1, 1)
+        e1.save_checkpoint(ckpt, tag="t1")
+        good = jax.device_get(e1.state.params)
+        train_steps(e1, 1, seed0=1)
+        with FaultInjector() as inj:
+            inj.crash_on_replace(nth=1)
+            with pytest.raises(SimulatedCrash):
+                e1.save_checkpoint(ckpt, tag="t1")  # overwrite same tag
+        ok, reason = validate_checkpoint_tag(ckpt, "t1")
+        assert ok, reason
+        e2 = make_engine()
+        e2.load_checkpoint(ckpt)
+        assert params_equal(good, e2.state.params)
+
+    def test_transient_write_errors_are_retried(self, tmp_path):
+        ckpt = str(tmp_path / "ck")
+        e1 = make_engine()
+        train_steps(e1, 1)
+        with FaultInjector() as inj:
+            inj.fast_retries()
+            inj.fail_writes(nth=1, count=2)  # first two attempts fail
+            e1.save_checkpoint(ckpt, tag="t1")
+        ok, reason = validate_checkpoint_tag(ckpt, "t1")
+        assert ok, reason
+        assert (tmp_path / "ck" / "latest").read_text() == "t1"
+
+
+class TestAutoResume:
+    def test_corrupt_latest_tag_falls_back_to_prior_valid(self, tmp_path):
+        """Acceptance: checksum failure on the 'latest' tag + successful
+        fallback load of the prior tag."""
+        ckpt = str(tmp_path / "ck")
+        e1 = make_engine()
+        train_steps(e1, 1)
+        e1.save_checkpoint(ckpt, tag="t1")
+        good = jax.device_get(e1.state.params)
+        train_steps(e1, 1, seed0=1)
+        e1.save_checkpoint(ckpt, tag="t2")
+        assert (tmp_path / "ck" / "latest").read_text() == "t2"
+        truncate_file(tmp_path / "ck" / "t2" / "state.npz")
+
+        ok, reason = validate_checkpoint_tag(ckpt, "t2")
+        assert not ok, "corrupted tag must fail verification"
+        ok, reason = validate_checkpoint_tag(ckpt, "t1")
+        assert ok, reason
+
+        e2 = make_engine()
+        path, _ = e2.load_checkpoint(ckpt)
+        assert path is not None and path.endswith("t1")
+        assert params_equal(good, e2.state.params)
+        assert e2.global_steps == 1
+
+    def test_silent_torn_write_detected_at_next_load(self, tmp_path):
+        """A torn write that *reports success* (fs bug / partial flush)
+        publishes a broken tag — the manifest catches it at load and
+        auto-resume walks back."""
+        ckpt = str(tmp_path / "ck")
+        e1 = make_engine()
+        train_steps(e1, 1)
+        e1.save_checkpoint(ckpt, tag="t1")
+        good = jax.device_get(e1.state.params)
+        train_steps(e1, 1, seed0=1)
+        with FaultInjector() as inj:
+            inj.truncate_write(nth=1, keep_bytes=200, crash=False)
+            e1.save_checkpoint(ckpt, tag="t2")  # "succeeds"
+        assert (tmp_path / "ck" / "latest").read_text() == "t2"
+
+        e2 = make_engine()
+        path, _ = e2.load_checkpoint(ckpt)
+        assert path is not None and path.endswith("t1")
+        assert params_equal(good, e2.state.params)
+
+    def test_stale_latest_pointer_falls_back_to_scan(self, tmp_path):
+        ckpt = str(tmp_path / "ck")
+        e1 = make_engine()
+        train_steps(e1, 1)
+        e1.save_checkpoint(ckpt, tag="t1")
+        (tmp_path / "ck" / "latest").write_text("ghost_tag")
+        e2 = make_engine()
+        path, _ = e2.load_checkpoint(ckpt)
+        assert path is not None and path.endswith("t1")
+
+    def test_newest_valid_tag_wins(self, tmp_path):
+        ckpt = str(tmp_path / "ck")
+        e1 = make_engine()
+        train_steps(e1, 1)
+        e1.save_checkpoint(ckpt, tag="older")
+        train_steps(e1, 1, seed0=1)
+        e1.save_checkpoint(ckpt, tag="newer")
+        newer = jax.device_get(e1.state.params)
+        # make ordering unambiguous, then break the latest pointer
+        os.utime(tmp_path / "ck" / "older" / "state.npz", (1, 1))
+        os.utime(tmp_path / "ck" / "newer" / "state.npz", (2, 2))
+        (tmp_path / "ck" / "latest").write_text("ghost")
+        assert list_checkpoint_tags(ckpt) == ["newer", "older"]
+        e2 = make_engine()
+        path, _ = e2.load_checkpoint(ckpt)
+        assert path.endswith("newer")
+        assert params_equal(newer, e2.state.params)
+
+    def test_all_candidates_corrupt_raises_loudly(self, tmp_path):
+        ckpt = str(tmp_path / "ck")
+        e1 = make_engine()
+        train_steps(e1, 1)
+        e1.save_checkpoint(ckpt, tag="t1")
+        truncate_file(tmp_path / "ck" / "t1" / "state.npz")
+        e2 = make_engine()
+        with pytest.raises(CheckpointCorruptionError, match="no valid checkpoint"):
+            e2.load_checkpoint(ckpt)
+
+    def test_empty_dir_still_returns_none(self, tmp_path):
+        e = make_engine()
+        path, client = e.load_checkpoint(str(tmp_path / "nothing_here"))
+        assert path is None and client == {}
+
+    def test_explicit_missing_tag_names_alternatives(self, tmp_path):
+        ckpt = str(tmp_path / "ck")
+        e1 = make_engine()
+        train_steps(e1, 1)
+        e1.save_checkpoint(ckpt, tag="t1")
+        (tmp_path / "ck" / "latest").write_text("gone")
+        with pytest.raises(FileNotFoundError) as ei:
+            e1.load_checkpoint(ckpt, tag="gone")
+        msg = str(ei.value)
+        assert "gone" in msg and "t1" in msg and "latest" in msg
+
+
+class TestAsyncFaults:
+    def test_wait_aggregates_all_errors(self):
+        from deepspeed_tpu.runtime.checkpoint_engine.checkpoint_engine import (
+            AsyncCheckpointEngine)
+
+        eng = AsyncCheckpointEngine()
+        eng._errors.extend([IOError("disk full"), IOError("quota exceeded")])
+        with pytest.raises(RuntimeError) as ei:
+            eng.wait()
+        msg = str(ei.value)
+        assert "disk full" in msg and "quota exceeded" in msg and "2 errors" in msg
+
+    def test_meta_deep_copied(self, tmp_path):
+        from deepspeed_tpu.runtime.checkpoint_engine.checkpoint_engine import (
+            AsyncCheckpointEngine)
+
+        eng = AsyncCheckpointEngine()
+        meta = {"global_step": 1, "nested": {"k": 0}}
+        path = str(tmp_path / "state.npz")
+        eng.save({"params": {"w": np.ones(4, np.float32)}, "__meta__": meta}, path)
+        meta["nested"]["k"] = 999  # training mutates caller state immediately
+        eng.wait()
+        loaded = eng.load(path)
+        assert loaded["__meta__"]["nested"]["k"] == 0

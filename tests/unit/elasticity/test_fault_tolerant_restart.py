@@ -1,0 +1,257 @@
+"""Elastic restart fault tolerance: rolling restart-budget window,
+exponential backoff with jitter, restartable preemption exit codes, and the
+preemption handler's final-checkpoint contract."""
+
+import os
+import signal
+import sys
+import time
+from pathlib import Path
+
+import pytest
+
+from deepspeed_tpu.elasticity import PREEMPTION_EXIT_CODE, PreemptionHandler
+from deepspeed_tpu.elasticity.elastic_agent import ElasticAgent
+from deepspeed_tpu.testing.fault_injection import FakeClock, ScriptedWorkerGroup
+
+pytestmark = pytest.mark.fault
+
+
+def make_agent(group, clock, **kw):
+    kw.setdefault("jitter", 0.0)
+    return ElasticAgent(group.spawn, group.monitor,
+                        time_fn=clock.time, sleep_fn=clock.sleep, **kw)
+
+
+class TestRollingRestartWindow:
+    def test_old_restarts_age_out_of_budget(self):
+        """Six crashes 100s apart with a 150s window never exceed a budget
+        of 2 — the job survives to its eventual clean exit."""
+        clock = FakeClock()
+        group = ScriptedWorkerGroup([1] * 6 + [0], clock=clock, run_time_s=100.0)
+        agent = make_agent(group, clock, max_restarts=2, restart_window_s=150.0,
+                          restart_delay_s=0.0)
+        assert agent.run() == 0
+        assert group.spawns == 7
+        assert agent.restart_count == 6  # all counted, few concurrent in window
+
+    def test_unbounded_window_burns_budget(self):
+        """Same failure schedule without a window: budget of 2 exhausts on
+        the third crash."""
+        clock = FakeClock()
+        group = ScriptedWorkerGroup([1] * 6 + [0], clock=clock, run_time_s=100.0)
+        agent = make_agent(group, clock, max_restarts=2, restart_window_s=None,
+                          restart_delay_s=0.0)
+        assert agent.run() == 1
+        assert group.spawns == 3
+
+    def test_burst_inside_window_still_gives_up(self):
+        """A crash loop (instant failures) exhausts the budget even with a
+        window configured — the window forgives slow attrition, not loops."""
+        clock = FakeClock()
+        group = ScriptedWorkerGroup([5], clock=clock, run_time_s=1.0)
+        agent = make_agent(group, clock, max_restarts=3, restart_window_s=3600.0,
+                          restart_delay_s=0.0)
+        assert agent.run() == 5
+        assert group.spawns == 4
+
+
+class TestBackoff:
+    def test_exponential_backoff_delays(self):
+        clock = FakeClock()
+        group = ScriptedWorkerGroup([1, 1, 1, 0], clock=clock)
+        agent = make_agent(group, clock, max_restarts=10, restart_delay_s=1.0,
+                          backoff_factor=2.0)
+        assert agent.run() == 0
+        assert clock.sleeps == [1.0, 2.0, 4.0]
+
+    def test_backoff_capped(self):
+        clock = FakeClock()
+        group = ScriptedWorkerGroup([1] * 6 + [0], clock=clock)
+        agent = make_agent(group, clock, max_restarts=10, restart_delay_s=1.0,
+                          backoff_factor=2.0, max_restart_delay_s=5.0)
+        assert agent.run() == 0
+        assert clock.sleeps == [1.0, 2.0, 4.0, 5.0, 5.0, 5.0]
+
+    def test_jitter_bounds(self):
+        agent = ElasticAgent(lambda: [], lambda p: 0, restart_delay_s=1.0,
+                             jitter=0.5)
+        for k in (1, 2, 3):
+            for _ in range(20):
+                d = agent._backoff_delay(k)
+                base = min(2.0 ** (k - 1), agent.max_restart_delay_s)
+                assert 0.5 * base <= d <= 1.5 * base
+
+    def test_failures_spaced_past_window_restart_backoff_at_base(self):
+        """Crashes a week apart must not escalate to the backoff cap — a
+        gap longer than the budget window resets the consecutive count."""
+        clock = FakeClock()
+        group = ScriptedWorkerGroup([1, 1, 1, 0], clock=clock, run_time_s=500.0)
+        agent = make_agent(group, clock, max_restarts=10, restart_delay_s=1.0,
+                          backoff_factor=2.0, restart_window_s=100.0)
+        assert agent.run() == 0
+        assert clock.sleeps == [1.0, 1.0, 1.0]  # never escalates
+
+    def test_preemption_resets_backoff(self):
+        clock = FakeClock()
+        group = ScriptedWorkerGroup([1, 1, PREEMPTION_EXIT_CODE, 1, 0],
+                                    clock=clock)
+        agent = make_agent(group, clock, max_restarts=10, restart_delay_s=1.0,
+                          backoff_factor=2.0)
+        assert agent.run() == 0
+        # fail(1.0), fail(2.0), preempt(base 1.0), fail(back to 1.0)
+        assert clock.sleeps == [1.0, 2.0, 1.0, 1.0]
+
+
+class TestPreemptionRestartable:
+    def test_preemption_exits_never_burn_budget(self):
+        clock = FakeClock()
+        codes = [PREEMPTION_EXIT_CODE] * 5 + [1, 0]
+        group = ScriptedWorkerGroup(codes, clock=clock, run_time_s=1.0)
+        agent = make_agent(group, clock, max_restarts=1, restart_delay_s=0.0)
+        assert agent.run() == 0
+        assert agent.preemption_restarts == 5
+        assert agent.restart_count == 1  # only the real failure
+
+    def test_custom_restartable_codes(self):
+        clock = FakeClock()
+        group = ScriptedWorkerGroup([42, 42, 0], clock=clock)
+        agent = make_agent(group, clock, max_restarts=0, restart_delay_s=0.0,
+                          restartable_exit_codes=(42,))
+        assert agent.run() == 0
+        assert agent.preemption_restarts == 2 and agent.restart_count == 0
+
+
+class TestPreemptionHandler:
+    def test_trigger_checkpoints_then_exits_restartable(self):
+        events = []
+        h = PreemptionHandler(lambda: events.append("ckpt"),
+                              exit_fn=lambda code: events.append(code))
+        h.trigger()
+        assert events == ["ckpt", PREEMPTION_EXIT_CODE]
+        h.trigger()  # re-entrant notice ignored
+        assert events == ["ckpt", PREEMPTION_EXIT_CODE]
+        assert h.preempted
+
+    def test_checkpoint_failure_still_exits_restartable(self):
+        codes = []
+
+        def bad_ckpt():
+            raise IOError("filesystem already gone")
+
+        PreemptionHandler(bad_ckpt, exit_fn=codes.append).trigger()
+        assert codes == [PREEMPTION_EXIT_CODE]
+
+    def test_deferred_mode_waits_for_poll(self):
+        """Multi-host mode: the notice only flags; the collective-bearing
+        final checkpoint runs at the next step-boundary poll()."""
+        events = []
+        h = PreemptionHandler(lambda: events.append("ckpt"),
+                              exit_fn=lambda code: events.append(code),
+                              defer=True)
+        h.poll()  # no notice yet: cheap no-op
+        assert events == []
+        h.trigger(reason="maintenance event")
+        assert h.preempted and events == []  # nothing ran in handler context
+        h.poll()
+        assert events == ["ckpt", PREEMPTION_EXIT_CODE]
+        h.poll()  # already handled
+        assert events == ["ckpt", PREEMPTION_EXIT_CODE]
+
+    def test_consensus_joins_peer_preemption(self):
+        """With a consensus collective, a host whose local flag is unset
+        still joins the coordinated final checkpoint when a peer voted."""
+        events = []
+        peer_flag = {"v": False}
+        h = PreemptionHandler(lambda: events.append("ckpt"),
+                              exit_fn=lambda code: events.append(code),
+                              defer=True,
+                              consensus_fn=lambda local: local or peer_flag["v"])
+        h.poll()  # nobody preempted anywhere
+        assert events == [] and not h.preempted
+        peer_flag["v"] = True  # another host saw SIGTERM
+        h.poll()
+        assert h.preempted
+        assert events == ["ckpt", PREEMPTION_EXIT_CODE]
+
+    def test_persistent_restartable_exit_eventually_gives_up(self):
+        clock = FakeClock()
+        group = ScriptedWorkerGroup([PREEMPTION_EXIT_CODE], clock=clock)
+        agent = make_agent(group, clock, max_restarts=3, restart_delay_s=0.0,
+                          max_preemption_restarts=5)
+        assert agent.run() == PREEMPTION_EXIT_CODE
+        assert group.spawns == 6  # initial + 5 free restarts
+        assert agent.restart_count == 0  # failure budget untouched
+
+    def test_sigterm_hook_installs_and_restores(self):
+        saves = []
+        prev = signal.getsignal(signal.SIGTERM)
+        with PreemptionHandler(lambda: saves.append(1)) as h:
+            with pytest.raises(SystemExit) as ei:
+                os.kill(os.getpid(), signal.SIGTERM)
+                # signal delivery is asynchronous; give the interpreter a
+                # bytecode boundary + grace to run the handler
+                for _ in range(100):
+                    time.sleep(0.01)
+            assert ei.value.code == PREEMPTION_EXIT_CODE
+            assert saves == [1] and h.preempted
+        assert signal.getsignal(signal.SIGTERM) is prev
+
+
+class TestEnginePreemptionHook:
+    def test_final_checkpoint_written_and_loadable(self, tmp_path):
+        sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
+        from simple_model import SimpleModel
+
+        import deepspeed_tpu
+
+        cfg = {"train_batch_size": 8,
+               "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+               "steps_per_print": 0}
+        engine, *_ = deepspeed_tpu.initialize(model=SimpleModel(hidden_dim=8),
+                                              config=cfg)
+        codes = []
+        ckpt = str(tmp_path / "ck")
+        h = engine.install_preemption_handler(ckpt, exit_fn=codes.append)
+        try:
+            h.trigger(reason="tpu maintenance event")
+        finally:
+            h.uninstall()
+        assert codes == [PREEMPTION_EXIT_CODE]
+        assert (tmp_path / "ck" / "latest").exists()
+
+        engine2, *_ = deepspeed_tpu.initialize(model=SimpleModel(hidden_dim=8),
+                                               config=cfg)
+        path, _ = engine2.load_checkpoint(ckpt)
+        assert path is not None
+
+    def test_deferred_final_save_runs_at_step_boundary(self, tmp_path):
+        """defer=True: trigger() only flags; the engine's next train step
+        polls the handler and performs the final save + restartable exit."""
+        import numpy as np
+
+        sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
+        from simple_model import SimpleModel
+
+        import deepspeed_tpu
+
+        engine, *_ = deepspeed_tpu.initialize(
+            model=SimpleModel(hidden_dim=8),
+            config={"train_batch_size": 8,
+                    "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+                    "steps_per_print": 0})
+        codes = []
+        ckpt = str(tmp_path / "ck")
+        h = engine.install_preemption_handler(ckpt, defer=True,
+                                              exit_fn=codes.append)
+        try:
+            h.trigger(reason="maintenance event mid-step")
+            assert codes == [] and not (tmp_path / "ck").exists()
+            rng = np.random.RandomState(0)
+            engine.train_batch_from_stacked(
+                {"x": rng.randn(1, 8, 8).astype(np.float32),
+                 "y": rng.randn(1, 8).astype(np.float32)})
+        finally:
+            h.uninstall()
+        assert codes == [PREEMPTION_EXIT_CODE]
+        assert (tmp_path / "ck" / "latest").exists()

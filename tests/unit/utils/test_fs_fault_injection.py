@@ -1,0 +1,163 @@
+"""The durable-fs layer and the fault-injection harness itself: retry with
+backoff, atomic publish, and every injector mode (error-on-Nth, truncation,
+slow writes, crash-at-rename)."""
+
+import os
+
+import pytest
+
+from deepspeed_tpu.testing.fault_injection import (
+    FakeClock,
+    FaultInjector,
+    ScriptedWorkerGroup,
+    SimulatedCrash,
+)
+from deepspeed_tpu.utils import fs
+
+pytestmark = pytest.mark.fault
+
+
+class TestRetryIO:
+    def test_transient_error_retried_to_success(self, tmp_path):
+        p = str(tmp_path / "f.bin")
+        with FaultInjector() as inj:
+            inj.fast_retries()
+            inj.fail_writes(nth=1, count=2)
+            fs.atomic_write_bytes(p, b"payload")
+            assert inj.write_calls == 3  # 2 failures + 1 success
+        assert open(p, "rb").read() == b"payload"
+
+    def test_exhausted_retries_raise_and_clean_tmp(self, tmp_path):
+        p = str(tmp_path / "f.bin")
+        with FaultInjector() as inj:
+            inj.fast_retries()
+            inj.fail_writes(nth=1, count=50)
+            with pytest.raises(OSError, match="injected"):
+                fs.atomic_write_bytes(p, b"payload")
+        assert not os.path.exists(p)
+        assert not os.path.exists(p + fs.TMP_SUFFIX)
+
+    def test_read_retry(self, tmp_path):
+        p = tmp_path / "f.bin"
+        p.write_bytes(b"data")
+        with FaultInjector() as inj:
+            inj.fast_retries()
+            inj.fail_reads(nth=1, count=1)
+            assert fs.read_bytes_with_retry(str(p)) == b"data"
+            assert inj.read_calls == 2
+
+    def test_file_not_found_is_not_retried(self, tmp_path):
+        with FaultInjector() as inj:
+            inj.fast_retries()
+            inj.fail_reads(nth=1, count=50,
+                           exc_factory=lambda: FileNotFoundError("gone"))
+            with pytest.raises(FileNotFoundError):
+                fs.read_bytes_with_retry(str(tmp_path / "missing"))
+            assert inj.read_calls == 1  # permanent error: fail fast
+
+    def test_backoff_delays_grow(self, monkeypatch):
+        sleeps = []
+        monkeypatch.setattr(fs.time, "sleep", sleeps.append)
+        calls = {"n": 0}
+
+        def flaky():
+            calls["n"] += 1
+            if calls["n"] <= 3:
+                raise OSError("flaky")
+            return "ok"
+
+        assert fs.retry_io(flaky, base_delay_s=0.1, max_delay_s=10.0,
+                           jitter=0.0) == "ok"
+        assert sleeps == pytest.approx([0.1, 0.2, 0.4])
+
+    def test_backoff_capped_and_jittered(self):
+        sleeps = []
+        calls = {"n": 0}
+
+        def flaky():
+            calls["n"] += 1
+            if calls["n"] <= 4:
+                raise OSError("flaky")
+            return "ok"
+
+        import unittest.mock as mock
+        with mock.patch.object(fs.time, "sleep", sleeps.append):
+            fs.retry_io(flaky, base_delay_s=1.0, max_delay_s=2.0, jitter=0.5)
+        assert len(sleeps) == 4
+        caps = [1.0, 2.0, 2.0, 2.0]
+        for got, cap in zip(sleeps, caps):
+            assert 0.5 * cap <= got <= 1.5 * cap
+
+
+class TestAtomicWrite:
+    def test_publish_is_all_or_nothing(self, tmp_path):
+        p = str(tmp_path / "f.bin")
+        fs.atomic_write_bytes(p, b"old-version")
+        with FaultInjector() as inj:
+            inj.crash_on_replace(nth=1)
+            with pytest.raises(SimulatedCrash):
+                fs.atomic_write_bytes(p, b"new-version")
+        assert open(p, "rb").read() == b"old-version"
+
+    def test_truncated_crash_leaves_no_final_file(self, tmp_path):
+        p = str(tmp_path / "f.bin")
+        with FaultInjector() as inj:
+            inj.truncate_write(nth=1, keep_bytes=3)
+            with pytest.raises(SimulatedCrash):
+                fs.atomic_write_bytes(p, b"abcdef")
+        assert not os.path.exists(p)
+
+    def test_atomic_write_text_round_trip(self, tmp_path):
+        p = str(tmp_path / "latest")
+        fs.atomic_write_text(p, "global_step42")
+        assert open(p).read() == "global_step42"
+        assert not os.path.exists(p + fs.TMP_SUFFIX)
+
+
+class TestInjectorModes:
+    def test_silent_truncation(self, tmp_path):
+        p = str(tmp_path / "f.bin")
+        with FaultInjector() as inj:
+            inj.truncate_write(nth=1, keep_bytes=3, crash=False)
+            fs.write_bytes(p, b"abcdef")  # reports success
+        assert open(p, "rb").read() == b"abc"
+
+    def test_slow_writes_invoke_sleep(self, tmp_path):
+        slept = []
+        with FaultInjector() as inj:
+            inj.slow_writes(0.25, sleep_fn=slept.append)
+            fs.write_bytes(str(tmp_path / "a"), b"x")
+            fs.write_bytes(str(tmp_path / "b"), b"y")
+        assert slept == [0.25, 0.25] and inj.write_calls == 2
+
+    def test_simulated_crash_not_caught_by_except_exception(self):
+        with pytest.raises(SimulatedCrash):
+            try:
+                raise SimulatedCrash("kill -9")
+            except Exception:  # recovery code must not swallow a kill
+                pytest.fail("SimulatedCrash must escape `except Exception`")
+
+    def test_restore_reinstates_originals(self, tmp_path):
+        orig_write, orig_read = fs.write_bytes, fs.read_bytes
+        with FaultInjector() as inj:
+            inj.fail_writes()
+            inj.fail_reads()
+            assert fs.write_bytes is not orig_write
+        assert fs.write_bytes is orig_write and fs.read_bytes is orig_read
+        fs.write_bytes(str(tmp_path / "ok"), b"fine")  # sanity: works again
+
+
+class TestElasticHelpers:
+    def test_fake_clock(self):
+        clk = FakeClock(start=10.0)
+        clk.sleep(5.0)
+        clk.advance(2.5)
+        assert clk.time() == 17.5 and clk.sleeps == [5.0]
+
+    def test_scripted_worker_group_repeats_last_code(self):
+        clk = FakeClock()
+        grp = ScriptedWorkerGroup([3, 0], clock=clk, run_time_s=7.0)
+        assert grp.monitor(grp.spawn()) == 3
+        assert grp.monitor(grp.spawn()) == 0
+        assert grp.monitor(grp.spawn()) == 0
+        assert clk.time() == 21.0
