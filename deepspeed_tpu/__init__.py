@@ -111,6 +111,8 @@ def _lazy(name: str):
 def __getattr__(name: str):
     if name == "zero":
         return _lazy("deepspeed_tpu.runtime.zero")
+    if name == "serving":
+        return _lazy("deepspeed_tpu.serving")
     if name == "PipelineModule":
         return _lazy("deepspeed_tpu.runtime.pipe.module").PipelineModule
     if name == "moe":

@@ -237,17 +237,34 @@ class InferenceEngine:
     def _shard_and_cast(self, params):
         axes = self.logical_axes
 
-        def prune(ax, tree):
+        missing = []
+
+        def prune(ax, tree, path=""):
             """Logical-axes subtree matching ``tree`` (the stream-init
             path shards a PARTIAL tree whose quantized leaves were
-            carved out)."""
+            carved out). Param keys ABSENT from logical_axes are kept
+            with None (replicated) specs — silently dropping them used
+            to surface as an opaque tree-structure mismatch deep in
+            compute_specs instead of naming the unannotated param."""
             if isinstance(ax, dict) and isinstance(tree, dict):
-                return {k: prune(ax[k], v) for k, v in tree.items()
-                        if k in ax}
+                out = {}
+                for k, v in tree.items():
+                    if k in ax:
+                        out[k] = prune(ax[k], v, f"{path}/{k}")
+                    else:
+                        missing.append(f"{path}/{k}")
+                        out[k] = jax.tree_util.tree_map(lambda _: None, v)
+                return out
             return ax
 
         if axes is not None:
             axes = prune(axes, params)
+            if missing:
+                logger.warning(
+                    "logical_axes is missing entries for %s — treating "
+                    "them as replicated (no TP/ZeRO sharding); annotate "
+                    "them in the model's logical_axes() to shard them",
+                    ", ".join(missing))
         specs = self.plan.compute_specs(
             jax.eval_shape(lambda: params), axes)
 
@@ -413,13 +430,7 @@ class InferenceEngine:
         prompt/token paths)."""
         model = self.module
         total = t + max_new
-
-        def pick(logits, temp, rng):
-            logits = logits.astype(jnp.float32)
-            if not do_sample:
-                return jnp.argmax(logits, axis=-1).astype(jnp.int32)
-            logits = filter_logits(logits / temp, top_k=top_k, top_p=top_p)
-            return jax.random.categorical(rng, logits, axis=-1).astype(jnp.int32)
+        pick = self._make_pick(do_sample, top_k, top_p)
 
         # pad the KV allocation to a multiple of 128 so the flash-decode
         # kernel's sequence blocks tile (ops/attention.decode_attention
@@ -514,6 +525,97 @@ class InferenceEngine:
             return decode_eos_fn(params, tok, cache, temp, rng)
 
         return gen
+
+    def _make_pick(self, do_sample, top_k, top_p):
+        """Token-selection closure shared by generate() and the serving
+        programs: greedy argmax, or top-k/top-p filtered sampling."""
+        def pick(logits, temp, rng):
+            logits = logits.astype(jnp.float32)
+            if not do_sample:
+                return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            logits = filter_logits(logits / temp, top_k=top_k, top_p=top_p)
+            return jax.random.categorical(rng, logits, axis=-1).astype(jnp.int32)
+
+        return pick
+
+    # ------------------------------------------- continuous-batching programs
+    def slot_prefill_program(self, bucket_len: int, num_slots: int,
+                             max_len: int, *, do_sample: bool = False,
+                             top_k: int = 0, top_p: float = 1.0):
+        """Jitted slot-insert prefill for the continuous-batching serving
+        runtime (serving/engine.py): run ONE request's bucket-padded
+        prompt through a fresh bucket-sized cache, copy the prefix KV
+        into slot ``slot`` of the persistent slot-paged cache
+        (ops/attention.write_slot_prefix), set the slot's valid length,
+        and pick the first generated token from the logits at the TRUE
+        last prompt position (pad tokens behind it are causally
+        invisible, so bucket padding cannot change the pick). Slot index
+        and true length are traced scalars — ONE compiled program per
+        bucket serves every slot, length, and arrival pattern.
+
+        Signature of the returned program:
+        ``(params, k_slots, v_slots, lengths, ids[1, bucket], slot,
+        length, temp, rng) -> (k_slots, v_slots, lengths, first_token)``
+        (cache operands donated on TPU)."""
+        from deepspeed_tpu.ops.attention import write_slot_prefix
+
+        key = ("slot_pf", bucket_len, num_slots, max_len, do_sample,
+               top_k, float(top_p))
+        if key not in self._compiled:
+            model = self.module
+            pick = self._make_pick(do_sample, top_k, float(top_p))
+
+            def prefill(params, k_slots, v_slots, lengths, ids, slot,
+                        length, temp, rng):
+                cache = model.init_cache(1, bucket_len, dtype=self.dtype)
+                logits, cache = model.forward_with_cache(params, ids, cache)
+                k_slots, v_slots = write_slot_prefix(
+                    k_slots, v_slots, cache["k"], cache["v"], slot)
+                lengths = jax.lax.dynamic_update_index_in_dim(
+                    lengths, length, slot, 0)
+                last = jax.lax.dynamic_index_in_dim(
+                    logits, length - 1, 1, keepdims=False)       # [1, V]
+                return k_slots, v_slots, lengths, pick(last, temp, rng)[0]
+
+            donate = (1, 2, 3) if jax.default_backend() == "tpu" else ()
+            self._compiled[key] = jax.jit(prefill, donate_argnums=donate)
+        return self._compiled[key]
+
+    def slot_decode_program(self, num_slots: int, max_len: int, *,
+                            do_sample: bool = False, top_k: int = 0,
+                            top_p: float = 1.0, pad_token_id: int = 0):
+        """Jitted persistent-cache decode step for the continuous-batching
+        serving runtime: ONE token for every slot against the slot-paged
+        KV cache with a per-slot valid-length vector
+        (models/base.cache_positions + ops/attention per-slot masking).
+        Inactive slots (``active`` false) keep their length, emit
+        ``pad_token_id``, and their masked garbage write is overwritten
+        by the next prefill into that slot. Fixed slot count + fixed
+        cache shape = exactly one compiled program for the entire decode
+        side of the serving loop, regardless of arrival pattern.
+
+        Signature: ``(params, k_slots, v_slots, lengths[B], tokens[B],
+        active[B] bool, temp, rng) -> (k_slots, v_slots, lengths,
+        next_tokens[B])`` (cache operands donated on TPU)."""
+        key = ("slot_dec", num_slots, max_len, do_sample, top_k,
+               float(top_p), pad_token_id)
+        if key not in self._compiled:
+            model = self.module
+            pick = self._make_pick(do_sample, top_k, float(top_p))
+
+            def decode(params, k_slots, v_slots, lengths, tokens, active,
+                       temp, rng):
+                cache = {"k": k_slots, "v": v_slots, "index": lengths}
+                logits, cache = model.forward_with_cache(
+                    params, tokens[:, None], cache)
+                nxt = jnp.where(active, pick(logits[:, -1], temp, rng),
+                                pad_token_id)
+                lengths = jnp.where(active, lengths + 1, lengths)
+                return cache["k"], cache["v"], lengths, nxt
+
+            donate = (1, 2, 3) if jax.default_backend() == "tpu" else ()
+            self._compiled[key] = jax.jit(decode, donate_argnums=donate)
+        return self._compiled[key]
 
     # ------------------------------------------------------------- utilities
     def compiled_programs(self, batch: int, prompt_len: int, max_new: int,

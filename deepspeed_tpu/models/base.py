@@ -131,6 +131,20 @@ def qdot(eq, x, w):
     return jnp.einsum(eq, x, w.astype(x.dtype))
 
 
+def cache_positions(index, t: int):
+    """Query positions for a KV-cache step — the cache carry API's single
+    point of index polymorphism. ``index`` is the cache dict's ``"index"``
+    entry: a SCALAR (uniform batch — generate()) yields ``[t]`` positions
+    shared by every row; a PER-SLOT ``[B]`` vector (continuous batching —
+    serving/engine.py) yields ``[B, t]`` so every slot is embedded at its
+    own valid length. Models add the returned positions to their position
+    tables (wpe gather / RoPE offset) and pass the raw ``index`` through
+    to ops/attention.cached_attention, which masks each row's prefix."""
+    if jnp.ndim(index) == 1:
+        return index[:, None] + jnp.arange(t)[None, :]
+    return index + jnp.arange(t)
+
+
 def layer_view(blocks, i):
     """Per-layer view of a layer-stacked block tree for a scan body that
     indexes with its own counter: normal ``[L, ...]`` leaves are

@@ -20,7 +20,7 @@ from typing import Any, Dict, Optional
 import jax
 import jax.numpy as jnp
 
-from deepspeed_tpu.models.base import ATTN_IMPLS, cross_entropy_loss, gelu, layer_norm, layer_view, qdot, sp_attention
+from deepspeed_tpu.models.base import ATTN_IMPLS, cache_positions, cross_entropy_loss, gelu, layer_norm, layer_view, qdot, sp_attention
 from deepspeed_tpu.ops.attention import alloc_kv_cache, cached_attention, multihead_attention
 
 
@@ -321,6 +321,9 @@ class GPT2Model:
         """Prefill (T>1) or decode (T=1) step against the KV cache.
         Returns (logits [B,T,V], new_cache).
 
+        ``cache["index"]`` may be a scalar (uniform batch) or a per-slot
+        [B] vector (continuous batching — models/base.cache_positions).
+
         The stacked caches ride the layer-scan CARRY (per-layer slice writes
         XLA keeps in place), not xs/ys — the ys form copied the entire cache
         every step, which dominated decode latency (round-2 weak #2)."""
@@ -328,8 +331,9 @@ class GPT2Model:
         b, t = input_ids.shape
         idx = cache["index"]
         x = params["wte"].astype(self.compute_dtype)[input_ids]
-        pos = idx + jnp.arange(t)
-        x = x + params["wpe"].astype(self.compute_dtype)[pos][None]
+        pos = cache_positions(idx, t)
+        pe = params["wpe"].astype(self.compute_dtype)[pos]
+        x = x + (pe if pos.ndim == 2 else pe[None])
 
         def scan_body(carry, _):
             x, kc, vc, layer = carry

@@ -208,7 +208,11 @@ class LlamaModel:
 
     def forward_with_cache(self, params, input_ids, cache):
         """Prefill (T>1) or decode (T=1) against the KV cache. Stacked caches
-        ride the scan carry with per-layer slice writes (see GPT2Model)."""
+        ride the scan carry with per-layer slice writes (see GPT2Model).
+        ``cache["index"]`` may be a scalar or a per-slot [B] vector
+        (continuous batching): RoPE then rotates each row at its own
+        position (ops/rotary vector offset) and cached_attention masks
+        each row's own prefix."""
         c = self.config
         b, t = input_ids.shape
         idx = cache["index"]

@@ -10,10 +10,25 @@ the op registry on real TPU backends for long sequences.
 
 from __future__ import annotations
 
+import os
 from typing import Optional
 
 import jax
 import jax.numpy as jnp
+
+# B=1 fused-decode routing threshold: bytes of ONE layer's K cache at
+# full allocated length (V doubles the actual stream; the threshold is
+# calibrated in the same K-only unit). The kernel's fixed per-invocation
+# cost (~28 us/call at 125M geometry, PROFILE_DECODE.md) only amortizes
+# when the cache stream is fat enough: measured LOSS at 125M B=1 Dh=64
+# (~1.0 MB K/layer: einsum 0.46 vs kernel 0.60 ms/tok) and WIN at 6.7B
+# B=1 Dh=128 (~5.2 MB K/layer: 19.15 -> 18.25 ms/tok). 2 MB splits the
+# two measured points; scripts/measure_decode.py --b1-dh128 measures the
+# LLaMA geometry directly on hardware, and the env override lets that
+# measurement force either path without a code change (ADVICE round 5:
+# the fixed per-layer DMA overhead was never measured at B=1/Dh>=128).
+_B1_FUSED_MIN_BYTES = int(os.environ.get(
+    "DEEPSPEED_TPU_B1_FUSED_MIN_BYTES", 2 * 1024 * 1024))
 
 
 def multihead_attention(
@@ -94,6 +109,11 @@ def cached_attention(q, k_full, v_full, k_new, v_new, layer, idx, *,
     full stacked [L, B, Hkv, S, Dh] caches (possibly token-pair packed,
     see :func:`kv_pack_factor`), attend, return ``(attn, k_full, v_full)``.
 
+    ``idx`` is the first free cache position: a scalar for the uniform
+    batch-decode path, or a PER-SLOT ``[B]`` vector for the continuous-
+    batching serving runtime (serving/engine.py) — each batch row then
+    writes at and attends over ITS OWN valid prefix.
+
     Single-token decode on TPU routes to the fused Pallas step
     (ops/decode_step.py): the kernel owns BOTH the cache write and the
     streaming read, so XLA keeps the decode loop's cache carry in the
@@ -102,14 +122,20 @@ def cached_attention(q, k_full, v_full, k_new, v_new, layer, idx, *,
     batch-8 decode at half its roofline — PROFILE_DECODE.md). Everything
     else (prefill blocks, ALiBi bias, sliding windows, CPU) takes the
     einsum path, view-unpacking packed caches first."""
-    t = q.shape[1]
+    b, t = q.shape[0], q.shape[1]
     dh = q.shape[3]
     pair = k_full.shape[4] // dh
     if (t == 1 and bias is None and window is None
             and jax.default_backend() == "tpu"
             # the allocation shape routes: an unpacked Dh<128 cache means
             # alloc_kv_cache decided the einsum path wins (batch 1)
-            and pair == kv_pack_factor(dh)):
+            and pair == kv_pack_factor(dh)
+            # B=1 with a thin per-layer cache stream: the kernel's fixed
+            # per-invocation cost loses to the einsum (see
+            # _B1_FUSED_MIN_BYTES above; only Dh>=128 geometries reach
+            # this — Dh<128 B=1 is already routed by allocation shape)
+            and (b >= 2 or k_full.shape[2] * k_full.shape[3] * k_full.shape[4]
+                 * jnp.dtype(k_full.dtype).itemsize >= _B1_FUSED_MIN_BYTES)):
         from deepspeed_tpu.ops.decode_step import fused_decode_step, supports
 
         if supports(q.shape[2], k_full.shape[2],
@@ -138,16 +164,58 @@ def write_kv_cache(k_full, v_full, k_new, v_new, layer, idx):
     head-major [L, B, Hkv, S, Dh] caches at (layer, idx) — the per-token
     slice write that XLA keeps in place on the layer-scan carry. Returns
     (k_full, v_full, k_layer, v_layer) with the per-layer [B, Hkv, S, Dh]
-    views ready for :func:`decode_attention`."""
-    k_full = jax.lax.dynamic_update_slice(
-        k_full, k_new.transpose(0, 2, 1, 3)[None].astype(k_full.dtype),
-        (layer, 0, 0, idx, 0))
-    v_full = jax.lax.dynamic_update_slice(
-        v_full, v_new.transpose(0, 2, 1, 3)[None].astype(v_full.dtype),
-        (layer, 0, 0, idx, 0))
+    views ready for :func:`decode_attention`.
+
+    A per-slot ``[B]`` idx vector (continuous batching, T must be 1)
+    scatters each row's token at its own position instead of one shared
+    slice start."""
+    if jnp.ndim(idx) == 1:
+        assert k_new.shape[1] == 1, \
+            "per-slot cache writes are single-token (decode) only"
+        b = k_new.shape[0]
+        rows = jnp.arange(b)
+        k_full = k_full.at[layer, rows, :, idx, :].set(
+            k_new[:, 0].astype(k_full.dtype))
+        v_full = v_full.at[layer, rows, :, idx, :].set(
+            v_new[:, 0].astype(v_full.dtype))
+    else:
+        k_full = jax.lax.dynamic_update_slice(
+            k_full, k_new.transpose(0, 2, 1, 3)[None].astype(k_full.dtype),
+            (layer, 0, 0, idx, 0))
+        v_full = jax.lax.dynamic_update_slice(
+            v_full, v_new.transpose(0, 2, 1, 3)[None].astype(v_full.dtype),
+            (layer, 0, 0, idx, 0))
     return (k_full, v_full,
             jax.lax.dynamic_index_in_dim(k_full, layer, 0, keepdims=False),
             jax.lax.dynamic_index_in_dim(v_full, layer, 0, keepdims=False))
+
+
+def write_slot_prefix(k_full, v_full, k_pref, v_pref, slot):
+    """Insert a prefilled single-sequence prefix cache into slot ``slot``
+    of the persistent slot-paged caches (serving/kv_slots.py).
+
+    k_pref/v_pref: [L, 1, Hkv, T_bucket, Dh] UNPACKED prefix caches from a
+    batch-1 bucket prefill (alloc_kv_cache never packs batch 1).
+    k_full/v_full: [L, B, Hkv, S/pair, Dh*pair] possibly packed persistent
+    caches. The bucket rows are viewed in the persistent pack factor (a
+    free bitcast — requires T_bucket % pair == 0) and written with ONE
+    dynamic_update_slice at batch position ``slot``, row 0. Rows past the
+    request's true length hold pad-token garbage; the per-slot length
+    vector masks them until the decode loop overwrites them one by one."""
+    l, one, hkv, t_b, dh = k_pref.shape
+    assert one == 1, "slot insert takes a single-sequence prefix cache"
+    pair = k_full.shape[4] // dh
+    if pair > 1:
+        assert t_b % pair == 0, (t_b, pair)
+        k_pref = k_pref.reshape(l, 1, hkv, t_b // pair, dh * pair)
+        v_pref = v_pref.reshape(l, 1, hkv, t_b // pair, dh * pair)
+    slot = jnp.asarray(slot, jnp.int32)
+    zero = jnp.zeros((), jnp.int32)
+    k_full = jax.lax.dynamic_update_slice(
+        k_full, k_pref.astype(k_full.dtype), (zero, slot, zero, zero, zero))
+    v_full = jax.lax.dynamic_update_slice(
+        v_full, v_pref.astype(v_full.dtype), (zero, slot, zero, zero, zero))
+    return k_full, v_full
 
 
 def decode_attention(
@@ -155,6 +223,7 @@ def decode_attention(
     k_cache: jax.Array,  # [B, Hkv, S_max, Dh] — new keys ALREADY written
     v_cache: jax.Array,  # [B, Hkv, S_max, Dh]
     cache_index: jax.Array,  # scalar int — first position of q in the cache
+    #                          (or per-slot [B] vector, continuous batching)
     *,
     scale: Optional[float] = None,
     bias: Optional[jax.Array] = None,    # [H, S_max] additive (alibi)
@@ -185,7 +254,8 @@ def decode_attention(
     via scalar-prefetch block clamping + VMEM online softmax."""
     b, t, hq, dh = q.shape
     rep_ = hq // k_cache.shape[1]
-    if (t == 1 and bias is None and window is None
+    per_slot = jnp.ndim(cache_index) == 1
+    if (t == 1 and bias is None and window is None and not per_slot
             and k_cache.shape[2] % 128 == 0
             and rep_ >= 8
             and jax.default_backend() == "tpu"):
@@ -211,12 +281,24 @@ def decode_attention(
         logits = logits + bias.astype(jnp.float32).reshape(
             1, hkv, rep, 1, s_max)
     # positions <= cache_index + offset are valid (causal within the new block)
-    pos = jnp.arange(s_max)[None, :]  # [1, S]
-    q_pos = cache_index + jnp.arange(t)[:, None]  # [T, 1]
-    valid = pos <= q_pos  # [T, S]
-    if window is not None:
-        valid = valid & (q_pos - pos < window)
-    logits = jnp.where(valid[None, None, None], logits, jnp.finfo(jnp.float32).min)
+    if per_slot:
+        # continuous batching: each slot's own valid-prefix mask
+        pos = jnp.arange(s_max)[None, None, :]                   # [1, 1, S]
+        q_pos = cache_index[:, None, None] + \
+            jnp.arange(t)[None, :, None]                         # [B, T, 1]
+        valid = pos <= q_pos                                     # [B, T, S]
+        if window is not None:
+            valid = valid & (q_pos - pos < window)
+        logits = jnp.where(valid[:, None, None], logits,
+                           jnp.finfo(jnp.float32).min)
+    else:
+        pos = jnp.arange(s_max)[None, :]  # [1, S]
+        q_pos = cache_index + jnp.arange(t)[:, None]  # [T, 1]
+        valid = pos <= q_pos  # [T, S]
+        if window is not None:
+            valid = valid & (q_pos - pos < window)
+        logits = jnp.where(valid[None, None, None], logits,
+                           jnp.finfo(jnp.float32).min)
     probs = jax.nn.softmax(logits, axis=-1).astype(v_cache.dtype)
     out = jnp.einsum("bkrts,bksd->btkrd", probs, v_cache)
     return out.reshape(b, t, hq, dh)

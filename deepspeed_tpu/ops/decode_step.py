@@ -58,6 +58,13 @@ _CHUNK_BUDGET = 3_300_000
 _VMEM_LIMIT = 40 * 1024 * 1024
 
 
+def _compiler_params():
+    try:
+        return pltpu.CompilerParams(vmem_limit_bytes=_VMEM_LIMIT)
+    except Exception:  # older naming (flash_attention._grid_params idiom)
+        return pltpu.TPUCompilerParams(vmem_limit_bytes=_VMEM_LIMIT)
+
+
 def supports(hq: int, hkv: int, s_max: int, dh: int) -> bool:
     """Shapes the fused kernel can stream: minor dim must tile to 128
     (dh a multiple of 128, or dh*pair == 128 with s_max % pair == 0)."""
@@ -92,7 +99,7 @@ def _kernel(layer_ref, idx_ref, q_ref, kn_ref, vn_ref, _kin_ref, _vin_ref,
             attn_ref, k_ref, v_ref,
             kbuf, vbuf, kwin, vwin, m_ref, l_ref, acc_ref, wsem, rsem,
             *, b: int, bg: int, cs: int, hq: int, hkv: int, dh: int,
-            pair: int, scale: float):
+            pair: int, scale: float, per_slot: bool):
     layer = layer_ref[0]
     idx = idx_ref[0]
     rep = hq // hkv
@@ -107,37 +114,104 @@ def _kernel(layer_ref, idx_ref, q_ref, kn_ref, vn_ref, _kin_ref, _vin_ref,
     # new token into the loaded chunk IN-REGISTER (see `body`), so no
     # read waits on the write-back (a serialized RMW measured +0.13
     # ms/tok at B=1 — pure DMA latency, 12 layers x 4 chained waits).
-    w0 = (idx // pair // 8) * 8
-    fk = pltpu.make_async_copy(
-        k_ref.at[layer, :, :, pl.ds(w0, 8), :], kwin, wsem.at[0])
-    fv = pltpu.make_async_copy(
-        v_ref.at[layer, :, :, pl.ds(w0, 8), :], vwin, wsem.at[1])
-    fk.start()
-    fv.start()
+    #
+    # per_slot (continuous batching): idx_ref is a [B] vector of per-slot
+    # valid lengths — each row's window is its own DMA (rows' write
+    # positions are unrelated), and the splice/position masks below go
+    # per-row. The chunk walk streams each batch group to the GROUP MAX
+    # length (shorter slots' tails are masked, not skipped: one strided
+    # DMA still covers all rows of the group).
+    if per_slot:
+        w0s = [(idx_ref[i] // pair // 8) * 8 for i in range(b)]
 
-    def finish_write():
-        """Insert the token into the fetched window and write it back —
-        called after the first chunk DMAs are in flight."""
-        fk.wait()
-        fv.wait()
-        row = idx // pair - w0
-        half = idx - (idx // pair) * pair
-        sel = (jax.lax.broadcasted_iota(
-            jnp.int32, (b, hkv, 8, dhp), 2) == row)
-        if pair > 1:
-            sel &= (jax.lax.broadcasted_iota(
-                jnp.int32, (b, hkv, 8, dhp), 3) // dh == half)
-        kwin[...] = jnp.where(sel, kn_ref[...], kwin[...])
-        vwin[...] = jnp.where(sel, vn_ref[...], vwin[...])
-        pltpu.make_async_copy(
-            kwin, k_ref.at[layer, :, :, pl.ds(w0, 8), :], wsem.at[0]).start()
-        pltpu.make_async_copy(
-            vwin, v_ref.at[layer, :, :, pl.ds(w0, 8), :], wsem.at[1]).start()
+        def kdma(i):
+            return pltpu.make_async_copy(
+                k_ref.at[layer, pl.ds(i, 1), :, pl.ds(w0s[i], 8), :],
+                kwin.at[pl.ds(i, 1)], wsem.at[0, i])
+
+        def vdma(i):
+            return pltpu.make_async_copy(
+                v_ref.at[layer, pl.ds(i, 1), :, pl.ds(w0s[i], 8), :],
+                vwin.at[pl.ds(i, 1)], wsem.at[1, i])
+
+        for i in range(b):
+            kdma(i).start()
+            vdma(i).start()
+
+        def finish_write():
+            for i in range(b):
+                kdma(i).wait()
+                vdma(i).wait()
+            bi = jax.lax.broadcasted_iota(jnp.int32, (b, hkv, 8, dhp), 0)
+            ri = jax.lax.broadcasted_iota(jnp.int32, (b, hkv, 8, dhp), 2)
+            li = jax.lax.broadcasted_iota(jnp.int32, (b, hkv, 8, dhp), 3)
+            sel = bi < 0  # all-false
+            for i in range(b):
+                idx_i = idx_ref[i]
+                sel_i = (bi == i) & (ri == jax.lax.rem(idx_i // pair, 8))
+                if pair > 1:
+                    sel_i &= (li // dh == idx_i - (idx_i // pair) * pair)
+                sel |= sel_i
+            kwin[...] = jnp.where(sel, kn_ref[...], kwin[...])
+            vwin[...] = jnp.where(sel, vn_ref[...], vwin[...])
+            for i in range(b):
+                pltpu.make_async_copy(
+                    kwin.at[pl.ds(i, 1)],
+                    k_ref.at[layer, pl.ds(i, 1), :, pl.ds(w0s[i], 8), :],
+                    wsem.at[0, i]).start()
+                pltpu.make_async_copy(
+                    vwin.at[pl.ds(i, 1)],
+                    v_ref.at[layer, pl.ds(i, 1), :, pl.ds(w0s[i], 8), :],
+                    wsem.at[1, i]).start()
+    else:
+        w0 = (idx // pair // 8) * 8
+        fk = pltpu.make_async_copy(
+            k_ref.at[layer, :, :, pl.ds(w0, 8), :], kwin, wsem.at[0, 0])
+        fv = pltpu.make_async_copy(
+            v_ref.at[layer, :, :, pl.ds(w0, 8), :], vwin, wsem.at[1, 0])
+        fk.start()
+        fv.start()
+
+        def finish_write():
+            """Insert the token into the fetched window and write it back —
+            called after the first chunk DMAs are in flight."""
+            fk.wait()
+            fv.wait()
+            row = idx // pair - w0
+            half = idx - (idx // pair) * pair
+            sel = (jax.lax.broadcasted_iota(
+                jnp.int32, (b, hkv, 8, dhp), 2) == row)
+            if pair > 1:
+                sel &= (jax.lax.broadcasted_iota(
+                    jnp.int32, (b, hkv, 8, dhp), 3) // dh == half)
+            kwin[...] = jnp.where(sel, kn_ref[...], kwin[...])
+            vwin[...] = jnp.where(sel, vn_ref[...], vwin[...])
+            pltpu.make_async_copy(
+                kwin, k_ref.at[layer, :, :, pl.ds(w0, 8), :],
+                wsem.at[0, 0]).start()
+            pltpu.make_async_copy(
+                vwin, v_ref.at[layer, :, :, pl.ds(w0, 8), :],
+                wsem.at[1, 0]).start()
 
     nchunks = idx // cs + 1  # valid-prefix walk: dead chunks never fetched
 
     for g in range(b // bg):  # static unroll over batch groups
         b0 = g * bg
+        if per_slot:
+            gmax = idx_ref[b0]
+            for i in range(1, bg):
+                gmax = jnp.maximum(gmax, idx_ref[b0 + i])
+            nchunks = gmax // cs + 1
+
+        def group_idx_vec(shape):
+            """int32 [shape] with entry (i, ...) == idx_ref[b0 + i] —
+            per-row lengths broadcast into a vector register (built by
+            bg unrolled selects: SMEM scalars can't gather)."""
+            bi = jax.lax.broadcasted_iota(jnp.int32, shape, 0)
+            out = jnp.zeros(shape, jnp.int32)
+            for i in range(bg):
+                out = jnp.where(bi == i, idx_ref[b0 + i], out)
+            return out
 
         def chunk_dma(slot, c, src, buf, t):
             return pltpu.make_async_copy(
@@ -173,7 +247,22 @@ def _kernel(layer_ref, idx_ref, q_ref, kn_ref, vn_ref, _kin_ref, _vin_ref,
             # bf16 with f32 accumulation — the same precision contract as
             # the einsum path's MXU (bf16 multiply, f32 accumulate); a full
             # f32 materialization of both chunks measured ~2x the VPU time
-            if splice:
+            if per_slot:
+                # per-row splice: each slot's new token lands at its own
+                # position, which may fall in ANY chunk of the group walk
+                # — so every chunk pays the select (serving batches are
+                # small; the uniform path keeps its last-chunk-only form)
+                idxm = group_idx_vec((bg, hkv, csp, dhp))
+                rowg = c * csp + jax.lax.broadcasted_iota(
+                    jnp.int32, (bg, hkv, csp, dhp), 2)
+                spl = rowg == idxm // pair
+                if pair > 1:
+                    spl &= (jax.lax.broadcasted_iota(
+                        jnp.int32, (bg, hkv, csp, dhp), 3) // dh
+                            == idxm - (idxm // pair) * pair)
+                kc = jnp.where(spl, kn_ref[pl.ds(b0, bg)], kc)
+                vc = jnp.where(spl, vn_ref[pl.ds(b0, bg)], vc)
+            elif splice:
                 # in-register splice of the new token (its async cache
                 # write may still be in flight; every other row is
                 # unchanged, so a read/write race can only return
@@ -205,7 +294,8 @@ def _kernel(layer_ref, idx_ref, q_ref, kn_ref, vn_ref, _kin_ref, _vin_ref,
                 s = s * scale
                 pos = c * cs + pair * jax.lax.broadcasted_iota(
                     jnp.int32, s.shape, 2) + h
-                ss.append(jnp.where(pos <= idx, s, _NEG))
+                bound = group_idx_vec(s.shape) if per_slot else idx
+                ss.append(jnp.where(pos <= bound, s, _NEG))
 
             m_prev = m_ref[...]                            # [bg, Hq]
             m_new = m_prev
@@ -236,17 +326,33 @@ def _kernel(layer_ref, idx_ref, q_ref, kn_ref, vn_ref, _kin_ref, _vin_ref,
             m_ref[...] = m_new
             return 0
 
-        jax.lax.fori_loop(0, nchunks - 1, body, 0)
-        body(nchunks - 1, 0, splice=True)
+        if per_slot:
+            # every chunk splices (the per-row masks gate it), so the walk
+            # is one uniform loop to the group-max chunk count
+            jax.lax.fori_loop(0, nchunks, body, 0)
+        else:
+            jax.lax.fori_loop(0, nchunks - 1, body, 0)
+            body(nchunks - 1, 0, splice=True)
         l_safe = jnp.maximum(l_ref[...], 1e-20)
         attn_ref[pl.ds(b0, bg)] = (acc_ref[...] / l_safe[:, :, None]) \
             .astype(attn_ref.dtype)
 
     # drain the async write-back before the kernel exits
-    pltpu.make_async_copy(
-        kwin, k_ref.at[layer, :, :, pl.ds(w0, 8), :], wsem.at[0]).wait()
-    pltpu.make_async_copy(
-        vwin, v_ref.at[layer, :, :, pl.ds(w0, 8), :], wsem.at[1]).wait()
+    if per_slot:
+        for i in range(b):
+            pltpu.make_async_copy(
+                kwin.at[pl.ds(i, 1)],
+                k_ref.at[layer, pl.ds(i, 1), :, pl.ds(w0s[i], 8), :],
+                wsem.at[0, i]).wait()
+            pltpu.make_async_copy(
+                vwin.at[pl.ds(i, 1)],
+                v_ref.at[layer, pl.ds(i, 1), :, pl.ds(w0s[i], 8), :],
+                wsem.at[1, i]).wait()
+    else:
+        pltpu.make_async_copy(
+            kwin, k_ref.at[layer, :, :, pl.ds(w0, 8), :], wsem.at[0, 0]).wait()
+        pltpu.make_async_copy(
+            vwin, v_ref.at[layer, :, :, pl.ds(w0, 8), :], wsem.at[1, 0]).wait()
 
 
 def fused_decode_step(q: jax.Array, k_full: jax.Array, v_full: jax.Array,
@@ -258,7 +364,12 @@ def fused_decode_step(q: jax.Array, k_full: jax.Array, v_full: jax.Array,
     q:            [B, 1, Hq, Dh]  — the new token's queries
     k_full/v_full:[L, B, Hkv, S, Dh] head-major stacked caches (carry)
     k_new/v_new:  [B, 1, Hkv, Dh]  — the new token's K/V (not yet written)
-    layer, idx:   scalar int32 — layer index / first free cache position
+    layer:        scalar int32 — layer index
+    idx:          scalar int32 first free cache position, or a PER-SLOT
+                  [B] int32 vector of valid lengths (continuous batching,
+                  serving/engine.py) — each row then writes at and
+                  attends over its own prefix, and each batch group
+                  streams to the group's max length.
 
     Returns ``(attn [B, 1, Hq, Dh], k_full, v_full)`` with the caches
     updated in place (the returned caches alias the inputs).
@@ -290,11 +401,13 @@ def fused_decode_step(q: jax.Array, k_full: jax.Array, v_full: jax.Array,
         vview = v_full.reshape(l, b, hkv, s_max // want_pair, dh * want_pair)
     pair = want_pair
     layer_a = jnp.asarray(layer, jnp.int32).reshape(1)
-    idx_a = jnp.asarray(idx, jnp.int32).reshape(1)
+    idx_a = jnp.asarray(idx, jnp.int32).reshape(-1)
+    assert idx_a.shape[0] in (1, b), (idx_a.shape, b)
+    per_slot = idx_a.shape[0] > 1  # [1] degenerates to the uniform path
 
     kernel = functools.partial(
         _kernel, b=b, bg=bg, cs=cs, hq=hq, hkv=hkv, dh=dh, pair=pair,
-        scale=sc)
+        scale=sc, per_slot=per_slot)
     attn, k_out, v_out = pl.pallas_call(
         kernel,
         in_specs=[
@@ -324,12 +437,12 @@ def fused_decode_step(q: jax.Array, k_full: jax.Array, v_full: jax.Array,
             pltpu.VMEM((bg, hq), jnp.float32),                 # running max
             pltpu.VMEM((bg, hq), jnp.float32),                 # running sum
             pltpu.VMEM((bg, hq, dh), jnp.float32),             # accumulator
-            pltpu.SemaphoreType.DMA((2,)),                     # write sems
+            # write sems: per-row windows in the per-slot path
+            pltpu.SemaphoreType.DMA((2, b if per_slot else 1)),
             pltpu.SemaphoreType.DMA((2, 2)),                   # read sems
         ],
         input_output_aliases={5: 1, 6: 2},
-        compiler_params=pltpu.CompilerParams(
-            vmem_limit_bytes=_VMEM_LIMIT),
+        compiler_params=_compiler_params(),
         interpret=(jax.default_backend() != "tpu" if interpret is None
                    else interpret),
     )(layer_a, idx_a, qf, kn, vn, kview, vview)

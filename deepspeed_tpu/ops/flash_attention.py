@@ -229,8 +229,9 @@ def _sds(*operands_then_args):
     (e.g. the Ulysses head-scatter path)."""
     *operands, shape, dtype = operands_then_args
     vma = frozenset()
-    for op in operands:
-        vma |= frozenset(getattr(jax.typeof(op), "vma", ()) or ())
+    typeof = getattr(jax, "typeof", None)  # absent on older jax: no vma
+    for op in (operands if typeof is not None else ()):
+        vma |= frozenset(getattr(typeof(op), "vma", ()) or ())
     if vma:
         return jax.ShapeDtypeStruct(shape, dtype, vma=vma)
     return jax.ShapeDtypeStruct(shape, dtype)

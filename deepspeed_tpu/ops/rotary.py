@@ -25,16 +25,24 @@ def apply_rotary_pos_emb(x: jax.Array, cos: jax.Array, sin: jax.Array,
 
     Pairs (x[2i], x[2i+1]) rotated by position angle — the interleaved GPT-NeoX
     convention used by LLaMA.
+
+    ``position_offset`` may be a per-slot ``[B]`` vector (continuous
+    batching): each batch row is then rotated at its own position.
     """
     b, t, h, dh = x.shape
-    if isinstance(position_offset, int) and position_offset == 0:
-        c = jax.lax.dynamic_slice_in_dim(cos, 0, t, axis=0)
-        s = jax.lax.dynamic_slice_in_dim(sin, 0, t, axis=0)
+    if not isinstance(position_offset, int) and jnp.ndim(position_offset) == 1:
+        pos = position_offset[:, None] + jnp.arange(t)[None, :]  # [B, T]
+        c = cos[pos][:, :, None, :]  # [B, T, 1, Dh/2]
+        s = sin[pos][:, :, None, :]
     else:
-        c = jax.lax.dynamic_slice_in_dim(cos, position_offset, t, axis=0)
-        s = jax.lax.dynamic_slice_in_dim(sin, position_offset, t, axis=0)
-    c = c[None, :, None, :]  # [1, T, 1, Dh/2]
-    s = s[None, :, None, :]
+        if isinstance(position_offset, int) and position_offset == 0:
+            c = jax.lax.dynamic_slice_in_dim(cos, 0, t, axis=0)
+            s = jax.lax.dynamic_slice_in_dim(sin, 0, t, axis=0)
+        else:
+            c = jax.lax.dynamic_slice_in_dim(cos, position_offset, t, axis=0)
+            s = jax.lax.dynamic_slice_in_dim(sin, position_offset, t, axis=0)
+        c = c[None, :, None, :]  # [1, T, 1, Dh/2]
+        s = s[None, :, None, :]
     x1 = x[..., 0::2].astype(jnp.float32)
     x2 = x[..., 1::2].astype(jnp.float32)
     o1 = x1 * c - x2 * s

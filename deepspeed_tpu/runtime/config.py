@@ -272,13 +272,24 @@ class DeepSpeedConfig:
         self.hybrid_engine = HybridEngineConfig(**d.get("hybrid_engine", {}))
         self.pld_config = PLDConfig(**d.get("progressive_layer_drop", {}))
         # random-LTD token routing (reference config shape:
-        # data_efficiency.data_routing.random_ltd — data_pipeline/config.py)
+        # data_efficiency.data_routing.random_ltd — data_pipeline/config.py).
+        # Reference gating is the INNER flag only (the reference's
+        # get_random_ltd reads random_ltd.enabled directly); requiring the
+        # outer data_efficiency/data_routing 'enabled' flags silently
+        # disabled configs the reference would run — warn on the
+        # contradiction instead of resolving it quietly.
         de = d.get("data_efficiency", {})
         dr = de.get("data_routing", {})
         rltd = dr.get("random_ltd", {})
-        self.random_ltd_enabled = (bool(de.get("enabled", True))
-                                   and bool(dr.get("enabled", False))
-                                   and bool(rltd.get("enabled", False)))
+        self.random_ltd_enabled = bool(rltd.get("enabled", False))
+        if self.random_ltd_enabled and not (
+                bool(de.get("enabled", True))
+                and bool(dr.get("enabled", True))):
+            logger.warning(
+                "random_ltd.enabled is true but an outer data_efficiency/"
+                "data_routing 'enabled' flag is false; matching reference "
+                "semantics the inner flag governs — random-LTD stays "
+                "ENABLED (drop the inner flag to disable it)")
         self.random_ltd_params = rltd
         # legacy curriculum learning (reference config.py
         # curriculum_enabled_legacy; engine.py:1653 injects curriculum_seqlen)

@@ -1,0 +1,16 @@
+"""Continuous-batching serving runtime (ISSUE 2).
+
+Iteration-level scheduling (Orca) over a slot-paged persistent KV cache
+(vLLM's paging specialized to XLA static shapes) with recompile-free
+prefill length buckets: the whole serving loop runs ``len(buckets) + 1``
+compiled programs regardless of arrival pattern. See serving/engine.py.
+"""
+
+from deepspeed_tpu.serving.engine import ServingEngine
+from deepspeed_tpu.serving.kv_slots import SlotKVCache
+from deepspeed_tpu.serving.scheduler import (Request, RequestResult,
+                                             SlotScheduler, pick_bucket,
+                                             poisson_trace)
+
+__all__ = ["ServingEngine", "SlotKVCache", "SlotScheduler", "Request",
+           "RequestResult", "pick_bucket", "poisson_trace"]

@@ -1,0 +1,79 @@
+"""Slot-paged persistent KV cache for continuous batching.
+
+The vLLM PagedAttention idea specialized to XLA's static-shape world: one
+persistent [L, B_slots, Hkv, S_max/pair, Dh*pair] stacked cache pair
+(ops/attention.alloc_kv_cache layout — head-major, token-pair packed for
+Dh < 128) whose BATCH dimension is the page table. Each of the ``B_slots``
+slots holds one in-flight request's KV prefix; a per-slot ``lengths``
+int32 vector replaces the single scalar cache position, so the fused
+decode kernel (ops/decode_step.py) streams only each slot's valid prefix
+and the einsum path masks per row. A finished request's slot is reused by
+the next admission with ZERO cache reshaping — the prefill program simply
+overwrites the slot's prefix rows (ops/attention.write_slot_prefix) and
+resets its length.
+
+Memory model: the cache is allocated ONCE at serving-engine construction
+for the worst case (``num_slots`` sequences of ``max_len`` tokens) and
+never grows, shrinks, or reallocates — 2 * L * B * Hkv * S_max * Dh *
+itemsize bytes of HBM, the same footprint a static batch of the same
+shape would pin, but shared by an unbounded request stream. There is no
+fragmentation because pages are whole slots; the cost of that simplicity
+is internal padding (a short request holds a full slot row) — the
+iteration-level scheduler keeps slots hot, which is where the throughput
+win lives (ISSUE 2 / PROFILE_DECODE.md 4-4.8x batch-8 aggregate).
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax.numpy as jnp
+
+
+class SlotKVCache:
+    """Owns the persistent slot-paged cache arrays + per-slot lengths.
+
+    The arrays are exposed (``k``, ``v``, ``lengths``) so the jitted
+    serving programs can take them as (donated) operands; after every
+    program call the engine stores the returned arrays back via
+    :meth:`update` — the host never mutates them in place.
+    """
+
+    def __init__(self, model, num_slots: int, max_len: int, dtype=None):
+        if num_slots < 1:
+            raise ValueError(f"num_slots must be >= 1, got {num_slots}")
+        base = model.init_cache(num_slots, max_len, dtype=dtype)
+        self.k = base["k"]
+        self.v = base["v"]
+        self.lengths = jnp.zeros((num_slots,), jnp.int32)
+        self.num_slots = num_slots
+        self.max_len = max_len
+        # pack factor the persistent allocation chose (routes the decode
+        # path — see ops/attention.alloc_kv_cache)
+        head_dim = model.config.head_dim
+        self.pair = self.k.shape[4] // head_dim
+
+    # ------------------------------------------------------------- carry
+    def carry(self) -> Tuple:
+        """(k, v, lengths) operands for a serving program call."""
+        return self.k, self.v, self.lengths
+
+    def update(self, k, v, lengths) -> None:
+        """Adopt a serving program's returned cache arrays."""
+        self.k, self.v, self.lengths = k, v, lengths
+
+    # ------------------------------------------------------------ sizing
+    def capacity_for(self, prompt_len: int, max_new_tokens: int) -> bool:
+        """Whether one slot can hold the request end to end (prompt plus
+        every generated token; the decode step writes token i at row
+        prompt_len + i, so the last write lands at row
+        prompt_len + max_new_tokens - 1)."""
+        return prompt_len + max_new_tokens <= self.max_len
+
+    def hbm_bytes(self) -> int:
+        return int(self.k.size * self.k.dtype.itemsize
+                   + self.v.size * self.v.dtype.itemsize)
+
+    def __repr__(self):
+        return (f"SlotKVCache(slots={self.num_slots}, max_len={self.max_len}, "
+                f"pair={self.pair}, hbm={self.hbm_bytes() / 1e6:.1f}MB)")

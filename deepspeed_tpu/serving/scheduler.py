@@ -1,0 +1,148 @@
+"""Iteration-level (continuous-batching) request scheduler.
+
+Orca-style scheduling (PAPERS.md; the reference's serving path has no
+analog — its InferenceEngine runs one static batch to completion): the
+unit of scheduling is ONE DECODE ITERATION, not one batch. Between decode
+steps the scheduler admits waiting requests into whatever slots are free,
+so a drained slot is refilled immediately instead of idling until the
+longest request in a static batch finishes — reclaiming the up-to
+(B-1)/B of aggregate capacity a run-to-completion batch wastes on
+stragglers.
+
+Pure host-side policy: no jax here. The ServingEngine
+(serving/engine.py) owns the compiled programs; this module decides WHO
+runs in WHICH slot and in WHICH prefill bucket.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from collections import deque
+from typing import List, Optional, Sequence, Tuple
+
+
+@dataclasses.dataclass
+class Request:
+    """One generation request in the serving queue."""
+
+    rid: int
+    prompt: Sequence[int]
+    max_new_tokens: int
+    arrival_time: float = 0.0
+
+
+@dataclasses.dataclass
+class RequestResult:
+    """Completed request + latency accounting (times in the engine's
+    clock, same base as Request.arrival_time)."""
+
+    rid: int
+    prompt_len: int
+    tokens: List[int] = dataclasses.field(default_factory=list)
+    arrival_time: float = 0.0
+    admitted_time: float = 0.0
+    first_token_time: float = 0.0
+    finish_time: float = 0.0
+    finish_reason: str = ""  # "eos" | "length"
+
+    @property
+    def latency(self) -> float:
+        return self.finish_time - self.arrival_time
+
+    @property
+    def first_token_latency(self) -> float:
+        return self.first_token_time - self.arrival_time
+
+
+def pick_bucket(prompt_len: int, buckets: Sequence[int]) -> Optional[int]:
+    """Smallest configured prefill bucket that fits the prompt (buckets
+    ascending). None = no bucket fits (reject at submit)."""
+    for b in buckets:
+        if prompt_len <= b:
+            return b
+    return None
+
+
+class SlotScheduler:
+    """FIFO iteration-level scheduler over a fixed slot set.
+
+    Invariants (pinned by tests/unit/serving/test_scheduler.py):
+      * a slot is FREE or holds exactly one request; release() makes it
+        admissible on the very next admit() call (slot reuse after EOS);
+      * admission is FIFO over arrived requests — a later arrival never
+        jumps an earlier one that a free slot could serve;
+      * admit() never admits a request whose arrival_time is in the
+        future, and never over-fills: len(admissions) <= free slots.
+    """
+
+    def __init__(self, num_slots: int):
+        self.num_slots = num_slots
+        self._free: deque = deque(range(num_slots))
+        self._waiting: deque = deque()
+        # accounting for tests / metrics
+        self.admissions_per_slot = [0] * num_slots
+        self.peak_queue_depth = 0
+
+    # ------------------------------------------------------------ queue
+    def submit(self, request: Request) -> None:
+        self._waiting.append(request)
+        self.peak_queue_depth = max(self.peak_queue_depth,
+                                    len(self._waiting))
+
+    @property
+    def waiting(self) -> int:
+        return len(self._waiting)
+
+    @property
+    def free_slots(self) -> int:
+        return len(self._free)
+
+    def next_arrival(self) -> Optional[float]:
+        """Arrival time of the QUEUE HEAD — the next request admit() can
+        actually take (admission is strict FIFO, so the engine must idle
+        until the head arrives even if a later submission has an earlier
+        timestamp)."""
+        if not self._waiting:
+            return None
+        return self._waiting[0].arrival_time
+
+    # -------------------------------------------------------- scheduling
+    def admit(self, now: float) -> List[Tuple[Request, int]]:
+        """Pop (request, slot) pairs: arrived requests into free slots,
+        FIFO order, called between decode iterations."""
+        out: List[Tuple[Request, int]] = []
+        while self._free and self._waiting \
+                and self._waiting[0].arrival_time <= now:
+            slot = self._free.popleft()
+            req = self._waiting.popleft()
+            self.admissions_per_slot[slot] += 1
+            out.append((req, slot))
+        return out
+
+    def release(self, slot: int) -> None:
+        assert slot not in self._free, f"slot {slot} double-released"
+        self._free.append(slot)
+
+
+def poisson_trace(rng, n_requests: int, *, rate: float,
+                  prompt_lens: Sequence[int],
+                  max_new_choices: Sequence[int],
+                  vocab_size: int, start_rid: int = 0) -> List[Request]:
+    """Synthetic mixed-length Poisson arrival trace (the ISSUE-2
+    acceptance workload): exponential inter-arrival gaps at ``rate``
+    requests/sec (CPU-simulatable — a virtual clock works too since only
+    the arrival ORDER and gaps matter), prompts and output budgets drawn
+    uniformly from the given choice sets. ``rng`` is a
+    numpy.random.RandomState so traces are reproducible."""
+    reqs: List[Request] = []
+    t = 0.0
+    for i in range(n_requests):
+        t += float(rng.exponential(1.0 / rate)) if rate > 0 else 0.0
+        plen = int(rng.choice(list(prompt_lens)))
+        reqs.append(Request(
+            rid=start_rid + i,
+            prompt=rng.randint(0, vocab_size, size=plen).astype("int32")
+                      .tolist(),
+            max_new_tokens=int(rng.choice(list(max_new_choices))),
+            arrival_time=t))
+    return reqs

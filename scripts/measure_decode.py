@@ -60,7 +60,70 @@ def measure(dtype, batch, cfg=None):
     return per_tok
 
 
+def measure_b1_dh128():
+    """ADVICE round 5: the fused decode kernel's fixed per-layer DMA cost
+    was never measured at B=1 with Dh>=128 (LLaMA geometry — Dh=128
+    never packs, so the allocation-shape gate that keeps 125M B=1 on the
+    einsum does not apply). Run a mid-size Dh=128 model at B=1 with the
+    kernel forced ON and forced OFF via the byte-threshold env override
+    (ops/attention._B1_FUSED_MIN_BYTES) and print both ms/tok; set the
+    threshold between the two geometries' per-layer stream bytes if the
+    einsum wins."""
+    import subprocess
+
+    env_on = dict(os.environ, DEEPSPEED_TPU_B1_FUSED_MIN_BYTES="0")
+    env_off = dict(os.environ,
+                   DEEPSPEED_TPU_B1_FUSED_MIN_BYTES=str(1 << 40))
+    code = (
+        "import sys; sys.path.insert(0, %r)\n"
+        "from scripts.measure_decode import measure\n"
+        "from deepspeed_tpu.models.llama import LlamaConfig, LlamaModel\n"
+        "import deepspeed_tpu, jax.numpy as jnp, numpy as np, jax, time\n"
+        "from deepspeed_tpu.utils import groups\n"
+        "cfg = LlamaConfig(num_layers=12, hidden_size=1024, num_heads=8,\n"
+        "                  num_kv_heads=8, vocab_size=32000,\n"
+        "                  max_seq_len=2048)\n"
+        "assert cfg.head_dim == 128\n"
+        "groups.reset()\n"
+        "rs = np.random.RandomState(0)\n"
+        "eng = deepspeed_tpu.init_inference(LlamaModel(cfg), dtype='bf16',\n"
+        "    max_out_tokens=512 + 129)\n"
+        "temp = jnp.float32(1.0)\n"
+        "med = {}\n"
+        "for mn in (8, 128):\n"
+        "    pf, dec = eng.compiled_programs(1, 512, mn)\n"
+        "    rng = jax.random.PRNGKey(0)\n"
+        "    ids = jnp.asarray(rs.randint(0, 32000, size=(1, 512),\n"
+        "                      dtype=np.int32))\n"
+        "    tok, cache, rng = pf(eng.params, ids, temp, rng)\n"
+        "    _ = np.asarray(jax.device_get(dec(eng.params, tok, cache,\n"
+        "                                      temp, rng)))\n"
+        "    ts = []\n"
+        "    for i in range(7):\n"
+        "        rng = jax.random.PRNGKey(i)\n"
+        "        tok, cache, rng = pf(eng.params, ids, temp, rng)\n"
+        "        _ = np.asarray(jax.device_get(tok))\n"
+        "        t0 = time.perf_counter()\n"
+        "        _ = np.asarray(jax.device_get(dec(eng.params, tok, cache,\n"
+        "                                          temp, rng)))\n"
+        "        ts.append(time.perf_counter() - t0)\n"
+        "    ts.sort(); med[mn] = ts[len(ts) // 2]\n"
+        "print('PER_TOK_MS=%%.4f' %% ((med[128] - med[8]) / 120 * 1e3))\n"
+    ) % os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    for name, env in (("fused", env_on), ("einsum", env_off)):
+        p = subprocess.run([sys.executable, "-c", code], env=env,
+                           capture_output=True, text=True, timeout=1800)
+        line = [ln for ln in p.stdout.splitlines()
+                if ln.startswith("PER_TOK_MS=")]
+        print(f"B=1 Dh=128 (LLaMA-geometry 12L/1024d) {name}: "
+              f"{line[0].split('=')[1] if line else 'FAILED'} ms/tok"
+              + ("" if line else f"\n{p.stderr[-500:]}"))
+
+
 if __name__ == "__main__":
+    if "--b1-dh128" in sys.argv:
+        measure_b1_dh128()
+        sys.exit(0)
     dtypes = [sys.argv[1]] if len(sys.argv) > 1 else ["bf16"]
     batches = [int(a) for a in sys.argv[2:]] or [1, 8]
     res = {}

@@ -1,0 +1,29 @@
+"""Continuous-batching serving bench (ISSUE 2 acceptance numbers only).
+
+Runs bench.py's serving-comparison section standalone: aggregate
+tokens/sec + p50/p95 per-request latency of the continuous-batching
+runtime (deepspeed_tpu/serving) vs run-to-completion static batching at
+the same slot count, under a mixed-length Poisson arrival trace.
+
+Usage: python scripts/serve_continuous_bench.py
+Prints one JSON object (the "serving_continuous" entry of bench.py).
+"""
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main():
+    import jax
+
+    from bench import _bench_continuous_serving
+
+    on_tpu = any(d.platform in ("tpu", "axon") or "TPU" in str(d.device_kind)
+                 for d in jax.devices())
+    print(json.dumps(_bench_continuous_serving(on_tpu), indent=2))
+
+
+if __name__ == "__main__":
+    main()

@@ -83,6 +83,41 @@ def test_fused_decode_step_matches_einsum(b, l, hq, hkv, s, dh, idx):
         np.asarray(v0, np.float32))
 
 
+@pytest.mark.serving
+@pytest.mark.parametrize("b,l,hq,hkv,s,dh,idxs", [
+    (4, 2, 4, 4, 256, 64, [100, 3, 255, 0]),   # MHA packed, mixed lengths
+    (2, 2, 8, 2, 256, 128, [200, 17]),          # GQA rep=4, dh=128
+    (4, 3, 4, 2, 512, 64, [511, 130, 0, 258]),  # lengths span chunk bounds
+])
+def test_fused_decode_step_per_slot_matches_einsum(b, l, hq, hkv, s, dh,
+                                                   idxs):
+    """Per-slot valid-length vector (continuous batching): the fused
+    kernel's per-row write/splice/masking == the einsum reference with
+    the same vector index."""
+    rng = np.random.RandomState(0)
+    pair = kv_pack_factor(dh)
+    q = jnp.asarray(rng.randn(b, 1, hq, dh), jnp.bfloat16)
+    kf = jnp.asarray(rng.randn(l, b, hkv, s, dh), jnp.bfloat16)
+    vf = jnp.asarray(rng.randn(l, b, hkv, s, dh), jnp.bfloat16)
+    kn = jnp.asarray(rng.randn(b, 1, hkv, dh), jnp.bfloat16)
+    vn = jnp.asarray(rng.randn(b, 1, hkv, dh), jnp.bfloat16)
+    layer = jnp.int32(l - 1)
+    idx = jnp.asarray(idxs, jnp.int32)
+    a0, k0, v0 = _ref_step(q, kf, vf, kn, vn, layer, idx)
+    packed = (l, b, hkv, s // pair, dh * pair)
+    a1, k1, v1 = fused_decode_step(
+        q, kf.reshape(packed), vf.reshape(packed), kn, vn, layer, idx,
+        interpret=True)
+    np.testing.assert_allclose(
+        np.asarray(a1, np.float32), np.asarray(a0, np.float32), atol=0.06)
+    np.testing.assert_array_equal(
+        np.asarray(k1.reshape(kf.shape), np.float32),
+        np.asarray(k0, np.float32))
+    np.testing.assert_array_equal(
+        np.asarray(v1.reshape(vf.shape), np.float32),
+        np.asarray(v0, np.float32))
+
+
 def test_cached_attention_packed_fallback_matches_unpacked():
     """On CPU the fused kernel is not routed; cached_attention must give
     identical results for packed and unpacked allocations (the unpack

@@ -1,0 +1,225 @@
+"""Continuous-batching serving runtime invariants (ISSUE 2 acceptance).
+
+All on CPU with tiny models. Pinned here:
+  * per-slot isolation: a long and a short request in adjacent slots
+    produce EXACTLY the tokens of their solo runs (and of generate());
+  * slot reuse after EOS: early-stopped requests free their slot for the
+    queue, every slot serves multiple requests;
+  * zero recompiles: across a mixed-length Poisson arrival trace the jit
+    cache of every serving program stays at ONE entry, and the program
+    count is len(buckets) + 1 (== 2 with a single bucket);
+  * iteration-level scheduling beats run-to-completion static batching
+    by >= 1.5x in decode iterations per useful token (the deterministic,
+    CPU-noise-free form of the aggregate-tokens/sec acceptance bar —
+    both modes pay one model forward per iteration at the same width).
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import deepspeed_tpu
+from deepspeed_tpu.models.gpt2 import GPT2Config, GPT2Model
+from deepspeed_tpu.models.llama import LlamaConfig, LlamaModel
+from deepspeed_tpu.serving import Request, ServingEngine, poisson_trace
+from deepspeed_tpu.utils import groups
+
+pytestmark = [pytest.mark.serving, pytest.mark.quick]
+
+
+class VirtualClock:
+    """Deterministic monotonic clock: arrival traces replay identically
+    on any machine."""
+
+    def __init__(self, dt=0.001):
+        self.t = 0.0
+        self.dt = dt
+
+    def __call__(self):
+        self.t += self.dt
+        return self.t
+
+
+def _gpt2_serving(num_slots=4, max_len=128, buckets=(16, 32), **kw):
+    groups.reset()
+    cfg = GPT2Config.tiny()
+    eng = deepspeed_tpu.init_inference(GPT2Model(cfg), dtype="fp32",
+                                       max_out_tokens=max_len)
+    srv = ServingEngine(eng, num_slots=num_slots, max_len=max_len,
+                        buckets=buckets, time_fn=VirtualClock(), **kw)
+    return cfg, eng, srv
+
+
+def _prompts(cfg, lens, seed=0):
+    rng = np.random.RandomState(seed)
+    return [rng.randint(0, cfg.vocab_size, size=l).tolist() for l in lens]
+
+
+def test_adjacent_slots_match_solo_and_generate():
+    """Long + short requests sharing the cache produce the same tokens
+    as (a) each request alone through the serving engine and (b)
+    engine.generate — bucket padding and neighbors change nothing."""
+    cfg, eng, srv = _gpt2_serving()
+    prompts = _prompts(cfg, [27, 3, 11, 8, 16])
+    new = [12, 3, 7, 9, 2]
+    reqs = [Request(rid=i, prompt=p, max_new_tokens=n)
+            for i, (p, n) in enumerate(zip(prompts, new))]
+    mixed = {r.rid: r.tokens for r in srv.run(reqs)}
+
+    # (a) solo through a FRESH serving engine (same programs, empty cache)
+    for req in reqs:
+        _, _, solo_srv = _gpt2_serving()
+        [res] = solo_srv.run([Request(rid=req.rid, prompt=req.prompt,
+                                      max_new_tokens=req.max_new_tokens)])
+        assert res.tokens == mixed[req.rid], f"rid {req.rid} solo mismatch"
+    # (b) the static generate() path
+    for req in reqs:
+        out = eng.generate(np.asarray(req.prompt, np.int32)[None],
+                           max_new_tokens=req.max_new_tokens)
+        assert out[0, len(req.prompt):].tolist() == mixed[req.rid], \
+            f"rid {req.rid} generate mismatch"
+
+
+def test_slot_reuse_after_eos():
+    """A request that hits EOS frees its slot immediately; the freed slot
+    serves queued requests on the next iteration."""
+    cfg, eng, srv = _gpt2_serving(num_slots=2)
+    prompt = _prompts(cfg, [9])[0]
+    # discover what this prompt greedily generates, then use its 2nd
+    # token as the EOS id -> deterministic early stop (at its FIRST
+    # occurrence, which is position 1 unless the model repeated itself)
+    probe = eng.generate(np.asarray(prompt, np.int32)[None],
+                         max_new_tokens=4)[0, len(prompt):].tolist()
+    eos = probe[1]
+    stop_at = probe.index(eos)
+
+    cfg, eng, srv = _gpt2_serving(num_slots=2, eos_token_id=eos)
+    other = _prompts(cfg, [5, 7, 12, 6], seed=3)
+    reqs = [Request(rid=0, prompt=prompt, max_new_tokens=30)]
+    reqs += [Request(rid=i + 1, prompt=p, max_new_tokens=6)
+             for i, p in enumerate(other)]
+    results = {r.rid: r for r in srv.run(reqs)}
+    assert len(results) == 5
+    r0 = results[0]
+    assert r0.finish_reason == "eos"
+    assert r0.tokens == probe[:stop_at + 1]  # eos token kept in the output
+    assert len(r0.tokens) <= 2 < 30          # early-stopped, not drained
+    # 5 requests over 2 slots: both slots admitted at least twice
+    assert sum(srv.scheduler.admissions_per_slot) == 5
+    assert all(n >= 2 for n in srv.scheduler.admissions_per_slot)
+
+
+def test_zero_recompiles_across_mixed_arrival_trace():
+    """After warmup, a mixed-length Poisson trace leaves every serving
+    program's jit cache at exactly ONE entry: the serving loop runs
+    len(buckets) + 1 compiled programs, recompile-free."""
+    cfg, eng, srv = _gpt2_serving(buckets=(32,))   # single bucket -> 2
+    srv.warmup()
+    warm = srv.program_cache_sizes()
+    assert srv.program_count == 2
+    assert warm == {"decode": 1, "prefill_32": 1}
+    trace = poisson_trace(np.random.RandomState(5), 18, rate=800.0,
+                          prompt_lens=(3, 7, 14, 25, 32),
+                          max_new_choices=(1, 2, 5, 9),
+                          vocab_size=cfg.vocab_size)
+    results = srv.run(trace, warmup=False)
+    assert len(results) == 18
+    assert srv.program_count == 2
+    assert srv.program_cache_sizes() == warm  # ZERO recompiles
+    # every request respected its budget and slot capacity
+    for r in results:
+        assert 1 <= len(r.tokens) <= 9
+        assert r.prompt_len + len(r.tokens) <= srv.max_len
+
+
+def test_continuous_beats_static_by_1_5x():
+    """>= 1.5x aggregate throughput vs run-to-completion static batching
+    at the same slot count, in deterministic decode-iteration units:
+    both modes run one fixed-width model forward per iteration, so
+    useful-tokens-per-iteration IS aggregate tokens/sec up to the
+    identical per-iteration constant (bench.py measures the wall-clock
+    form of the same quantity)."""
+    slots = 4
+    cfg, eng, srv = _gpt2_serving(num_slots=slots, buckets=(16,))
+    rng = np.random.RandomState(11)
+    # mixed lengths: one straggler per static batch wastes (B-1) slots
+    new_tokens = [24, 3, 4, 2, 20, 2, 5, 3, 22, 4, 2, 3, 18, 3, 2, 5]
+    prompts = _prompts(cfg, [int(rng.randint(3, 15))
+                             for _ in new_tokens], seed=7)
+    reqs = [Request(rid=i, prompt=p, max_new_tokens=n)
+            for i, (p, n) in enumerate(zip(prompts, new_tokens))]
+    results = srv.run(reqs)
+    assert len(results) == len(reqs)
+    useful = sum(new_tokens)
+    assert srv.tokens_generated == useful  # nothing over-generated
+    # continuous: prefill emits a token too, so iterations that produce
+    # tokens = prefills + decode steps
+    cont_iters = srv.decode_steps + srv.prefill_calls
+    # static run-to-completion at the same width: FIFO batches of
+    # `slots`, every batch decodes to ITS max_new (1 prefill + max-1
+    # decode steps), all slots padded along
+    static_iters = 0
+    for i in range(0, len(reqs), slots):
+        static_iters += max(r.max_new_tokens for r in reqs[i:i + slots])
+    ratio = static_iters / cont_iters
+    assert ratio >= 1.5, (ratio, static_iters, cont_iters)
+
+
+def test_llama_gqa_serving_matches_generate():
+    """GQA + RoPE per-slot path (vector rotary offsets) end to end."""
+    groups.reset()
+    cfg = LlamaConfig.tiny()
+    eng = deepspeed_tpu.init_inference(LlamaModel(cfg), dtype="fp32",
+                                       max_out_tokens=128)
+    srv = ServingEngine(eng, num_slots=3, max_len=128, buckets=(16,),
+                        time_fn=VirtualClock())
+    prompts = _prompts(cfg, [13, 4, 9], seed=2)
+    reqs = [Request(rid=i, prompt=p, max_new_tokens=n)
+            for i, (p, n) in enumerate(zip(prompts, [6, 9, 3]))]
+    got = {r.rid: r.tokens for r in srv.run(reqs)}
+    for req in reqs:
+        out = eng.generate(np.asarray(req.prompt, np.int32)[None],
+                           max_new_tokens=req.max_new_tokens)
+        assert out[0, len(req.prompt):].tolist() == got[req.rid]
+
+
+def test_submit_rejections():
+    cfg, eng, srv = _gpt2_serving(num_slots=2, max_len=128, buckets=(16,))
+    with pytest.raises(ValueError, match="empty prompt"):
+        srv.submit(Request(rid=0, prompt=[], max_new_tokens=1))
+    with pytest.raises(ValueError, match="exceeds the largest"):
+        srv.submit(Request(rid=1, prompt=[1] * 17, max_new_tokens=1))
+    with pytest.raises(ValueError, match="slot capacity"):
+        srv.submit(Request(rid=2, prompt=[1] * 10, max_new_tokens=119))
+    with pytest.raises(ValueError, match="max_new_tokens"):
+        srv.submit(Request(rid=3, prompt=[1], max_new_tokens=0))
+    # boundary: exactly fits
+    srv.submit(Request(rid=4, prompt=[1] * 10, max_new_tokens=118))
+
+
+def test_oversized_buckets_clamp_to_max_len():
+    """A bucket past the slot capacity clamps to max_len instead of
+    being dropped — otherwise prompts that FIT the slot would be
+    rejected by a phantom bucket ceiling."""
+    cfg, eng, srv = _gpt2_serving(num_slots=2, max_len=128,
+                                  buckets=(16, 512))
+    assert srv.buckets == (16, 128)
+    srv.submit(Request(rid=0, prompt=[1] * 100, max_new_tokens=4))
+
+
+def test_arrival_gaps_idle_then_resume():
+    """Requests arriving after a full drain are still served (the run
+    loop idles forward to the next arrival on the virtual clock)."""
+    cfg, eng, srv = _gpt2_serving(num_slots=2, buckets=(16,))
+    prompts = _prompts(cfg, [5, 7, 9], seed=4)
+    reqs = [Request(rid=0, prompt=prompts[0], max_new_tokens=2,
+                    arrival_time=0.0),
+            Request(rid=1, prompt=prompts[1], max_new_tokens=2,
+                    arrival_time=50.0),   # long gap: engine fully drains
+            Request(rid=2, prompt=prompts[2], max_new_tokens=2,
+                    arrival_time=50.0)]
+    results = srv.run(reqs)
+    assert sorted(r.rid for r in results) == [0, 1, 2]
+    by = {r.rid: r for r in results}
+    assert by[1].admitted_time >= 50.0
+    assert by[0].finish_time < by[1].admitted_time

@@ -1,0 +1,99 @@
+"""Host-side scheduler policy invariants (no jax)."""
+
+import numpy as np
+import pytest
+
+from deepspeed_tpu.serving.scheduler import (Request, SlotScheduler,
+                                             pick_bucket, poisson_trace)
+
+pytestmark = [pytest.mark.serving, pytest.mark.quick]
+
+
+def test_pick_bucket():
+    assert pick_bucket(1, (128, 512, 2048)) == 128
+    assert pick_bucket(128, (128, 512, 2048)) == 128
+    assert pick_bucket(129, (128, 512, 2048)) == 512
+    assert pick_bucket(2048, (128, 512, 2048)) == 2048
+    assert pick_bucket(2049, (128, 512, 2048)) is None
+
+
+def test_fifo_admission_respects_arrival_times():
+    s = SlotScheduler(2)
+    s.submit(Request(rid=0, prompt=[1], max_new_tokens=1, arrival_time=0.0))
+    s.submit(Request(rid=1, prompt=[1], max_new_tokens=1, arrival_time=5.0))
+    s.submit(Request(rid=2, prompt=[1], max_new_tokens=1, arrival_time=0.1))
+    # at t=1 only rid 0 has arrived at the queue head; rid 1 (future)
+    # BLOCKS rid 2 behind it — FIFO means no jumping the queue
+    adm = s.admit(now=1.0)
+    assert [r.rid for r, _ in adm] == [0]
+    assert s.free_slots == 1
+    adm = s.admit(now=6.0)
+    assert [r.rid for r, _ in adm] == [1]  # one free slot left
+    assert s.free_slots == 0
+    # no slots -> nothing admitted even though rid 2 arrived long ago
+    assert s.admit(now=6.0) == []
+    s.release(0)
+    adm = s.admit(now=6.0)
+    assert [r.rid for r, _ in adm] == [2]
+
+
+def test_next_arrival_is_queue_head_not_minimum():
+    """Admission is strict FIFO, so the engine's idle gating must wait
+    for the HEAD's arrival — a later submission with an earlier
+    timestamp cannot be admitted first and must not defeat the sleep."""
+    s = SlotScheduler(1)
+    s.submit(Request(rid=0, prompt=[1], max_new_tokens=1, arrival_time=10.0))
+    s.submit(Request(rid=1, prompt=[1], max_new_tokens=1, arrival_time=0.0))
+    assert s.next_arrival() == 10.0
+    assert s.admit(now=5.0) == []          # head hasn't arrived
+    adm = s.admit(now=10.0)
+    assert [r.rid for r, _ in adm] == [0]
+
+
+def test_slot_release_and_reuse():
+    s = SlotScheduler(2)
+    for i in range(6):
+        s.submit(Request(rid=i, prompt=[1], max_new_tokens=1))
+    served = []
+    while s.waiting or s.free_slots < 2:
+        for req, slot in s.admit(now=0.0):
+            served.append((req.rid, slot))
+            s.release(slot)  # request "finishes" immediately
+    assert sorted(r for r, _ in served) == list(range(6))
+    # both slots were reused (6 requests over 2 slots)
+    assert all(n >= 2 for n in s.admissions_per_slot)
+    assert sum(s.admissions_per_slot) == 6
+
+
+def test_double_release_asserts():
+    s = SlotScheduler(1)
+    s.submit(Request(rid=0, prompt=[1], max_new_tokens=1))
+    [(_, slot)] = s.admit(now=0.0)
+    s.release(slot)
+    with pytest.raises(AssertionError):
+        s.release(slot)
+
+
+def test_admit_never_overfills():
+    s = SlotScheduler(3)
+    for i in range(10):
+        s.submit(Request(rid=i, prompt=[1], max_new_tokens=1))
+    adm = s.admit(now=0.0)
+    assert len(adm) == 3
+    assert s.free_slots == 0
+    assert {slot for _, slot in adm} == {0, 1, 2}
+
+
+def test_poisson_trace_reproducible_and_sorted():
+    r1 = poisson_trace(np.random.RandomState(7), 20, rate=100.0,
+                       prompt_lens=(4, 8, 16), max_new_choices=(2, 4),
+                       vocab_size=100)
+    r2 = poisson_trace(np.random.RandomState(7), 20, rate=100.0,
+                       prompt_lens=(4, 8, 16), max_new_choices=(2, 4),
+                       vocab_size=100)
+    assert [r.arrival_time for r in r1] == [r.arrival_time for r in r2]
+    assert [r.prompt for r in r1] == [r.prompt for r in r2]
+    times = [r.arrival_time for r in r1]
+    assert times == sorted(times)           # arrivals are cumulative
+    assert all(len(r.prompt) in (4, 8, 16) for r in r1)
+    assert all(r.max_new_tokens in (2, 4) for r in r1)
