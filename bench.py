@@ -413,6 +413,163 @@ def _bench_continuous_serving(on_tpu: bool):
     }
 
 
+def _bench_observability_overhead(on_tpu: bool):
+    """ISSUE-3 acceptance: instrumented vs bare train step and serving
+    decode step (2% overhead budget), plus p50/p95 serving latencies from
+    the telemetry histograms checked against direct measurement of the
+    SAME Poisson trace. Bare = telemetry disabled in config / engine
+    kwarg, i.e. the exact pre-instrumentation code path; both sides use
+    identical warmup + best-of-windows so the comparison cancels
+    co-tenant noise the same way the headline numbers do."""
+    import time
+
+    import jax
+
+    import deepspeed_tpu
+    from deepspeed_tpu import telemetry
+    from deepspeed_tpu.models.gpt2 import GPT2Config, GPT2Model
+    from deepspeed_tpu.serving import ServingEngine, poisson_trace
+    from deepspeed_tpu.utils import groups
+
+    if on_tpu:
+        cfg = GPT2Config.gpt2_125m()
+        dtype = "bf16"
+        batch, seq, steps, gas, windows = 8, 1024, 6, 2, 4
+        slots, max_len, buckets = 8, 1024, (128,)
+        n_req = 32
+        prompt_lens, max_new_choices = (24, 64, 100), (8, 16, 32, 64)
+    else:
+        cfg = GPT2Config(vocab_size=2048, max_seq_len=256, num_layers=2,
+                         hidden_size=128, num_heads=4)
+        dtype = "fp32"
+        # batch 8 = one sample per virtual CPU device (the test mesh)
+        batch, seq, steps, gas, windows = 8, 64, 3, 1, 2
+        slots, max_len, buckets = 4, 256, (16,)
+        n_req = 12
+        prompt_lens, max_new_choices = (4, 8, 14), (2, 3, 4, 10)
+
+    rng = np.random.RandomState(0)
+
+    def make_batch():
+        ids = rng.randint(0, cfg.vocab_size,
+                          size=(gas, batch, seq + 1)).astype(np.int32)
+        return {"input_ids": ids[:, :, :-1], "labels": ids[:, :, 1:]}
+
+    def build_train(instrumented: bool):
+        groups.reset()
+        model = GPT2Model(cfg, attn_impl="flash" if on_tpu else "dense")
+        engine, *_ = deepspeed_tpu.initialize(model=model, config={
+            "train_batch_size": batch * gas,
+            "gradient_accumulation_steps": gas,
+            "bf16": {"enabled": on_tpu},
+            "optimizer": {"type": "AdamW", "params": {"lr": 1e-4}},
+            "steps_per_print": 0,
+            # default sync_interval (50): the periodic fence amortizes
+            # inside the budget; the one-time cost_analysis compile lands
+            # in warmup
+            "telemetry": {"enabled": instrumented},
+        })
+        for _ in range(2):
+            loss = engine.train_batch_from_stacked(make_batch())
+        float(jax.device_get(loss))
+        return engine
+
+    telemetry.reset_registry()
+    # INTERLEAVED best-of-windows: bare and instrumented windows alternate
+    # inside the same time span, so co-tenant drift on the shared chip
+    # hits both sides symmetrically instead of biasing whichever ran
+    # second (the 2% budget is far below this sandbox's A-then-B noise)
+    engines = {"bare": build_train(False), "instr": build_train(True)}
+    best = {"bare": float("inf"), "instr": float("inf")}
+    for _ in range(windows):
+        for name, engine in engines.items():
+            t0 = time.perf_counter()
+            for _ in range(steps):
+                loss = engine.train_batch_from_stacked(make_batch())
+            float(jax.device_get(loss))
+            best[name] = min(best[name], time.perf_counter() - t0)
+    bare_train = batch * gas * seq * steps / best["bare"]
+    instr_train = batch * gas * seq * steps / best["instr"]
+    train_overhead = (bare_train - instr_train) / bare_train * 100.0
+    del engines
+
+    # ---- serving decode: same backlogged trace (arrival_time 0 => pure
+    # decode-bound regime), bare vs instrumented ServingEngine over one
+    # shared InferenceEngine (shared compiled programs: both sides time
+    # steady-state execution, not compilation)
+    trace = poisson_trace(np.random.RandomState(1), n_req, rate=0.0,
+                          prompt_lens=prompt_lens,
+                          max_new_choices=max_new_choices,
+                          vocab_size=cfg.vocab_size)
+    groups.reset()
+    telemetry.reset_registry()
+    ie = deepspeed_tpu.init_inference(GPT2Model(cfg), dtype=dtype,
+                                      max_out_tokens=max_len)
+
+    servers = {
+        "bare": ServingEngine(ie, num_slots=slots, max_len=max_len,
+                              buckets=buckets, telemetry=False),
+        "instr": ServingEngine(ie, num_slots=slots, max_len=max_len,
+                               buckets=buckets, telemetry=True),
+    }
+    for srv in servers.values():
+        srv.warmup()
+    best_ms = {"bare": float("inf"), "instr": float("inf")}
+    results = []  # every instrumented rep: the histogram saw exactly these
+    for _ in range(max(windows, 2)):
+        for name, srv in servers.items():
+            steps_before = srv.decode_steps
+            t0 = time.perf_counter()
+            run_results = srv.run(trace, warmup=False)
+            dt = time.perf_counter() - t0
+            n = srv.decode_steps - steps_before
+            best_ms[name] = min(best_ms[name], dt / max(n, 1) * 1e3)
+            if name == "instr":
+                results.extend(run_results)
+    bare_ms, instr_ms = best_ms["bare"], best_ms["instr"]
+    decode_overhead = (instr_ms - bare_ms) / bare_ms * 100.0
+
+    # ---- histogram agreement: telemetry percentiles vs a direct sort of
+    # the SAME requests' latencies (identical sample set, so any gap is
+    # pure fixed-bucket quantization — bounded by the 1.25x bucket ratio)
+    reg = telemetry.get_registry()
+    lat_h = reg.histogram("serving/latency_ms")
+    ttft_h = reg.histogram("serving/ttft_ms")
+    direct = sorted(r.latency * 1e3 for r in results)
+
+    def pct(xs, p):
+        return xs[min(int(len(xs) * p), len(xs) - 1)]
+
+    d50, d95 = pct(direct, 0.50), pct(direct, 0.95)
+    t50, t95 = lat_h.percentile(0.50), lat_h.percentile(0.95)
+    return {
+        "budget_pct": 2.0,
+        "train": {
+            "bare_tokens_per_sec": round(bare_train, 1),
+            "instrumented_tokens_per_sec": round(instr_train, 1),
+            "overhead_pct": round(train_overhead, 2),
+        },
+        "serving_decode": {
+            "bare_ms_per_decode_step": round(bare_ms, 3),
+            "instrumented_ms_per_decode_step": round(instr_ms, 3),
+            "overhead_pct": round(decode_overhead, 2),
+        },
+        "within_budget": bool(max(train_overhead, 0.0) <= 2.0
+                              and max(decode_overhead, 0.0) <= 2.0),
+        "histogram_agreement": {
+            "n_requests": len(results),
+            "direct_latency_p50_ms": round(d50, 2),
+            "telemetry_latency_p50_ms": round(t50, 2) if t50 else None,
+            "p50_ratio": round(t50 / d50, 3) if (t50 and d50) else None,
+            "direct_latency_p95_ms": round(d95, 2),
+            "telemetry_latency_p95_ms": round(t95, 2) if t95 else None,
+            "p95_ratio": round(t95 / d95, 3) if (t95 and d95) else None,
+            "ttft_p50_ms": (round(ttft_h.percentile(0.50), 2)
+                            if ttft_h.count else None),
+        },
+    }
+
+
 def _bench_774m_isolated(on_tpu: bool):
     """774M needs a FRESH process on the shared chip: in-process after the
     serving engines it RESOURCE_EXHAUSTs (their allocations + fragmentation
@@ -536,6 +693,10 @@ def main():
         longseq = _bench_zero_flash_longseq(on_tpu)
     except Exception as e:
         longseq = {"error": f"{type(e).__name__}: {e}"}
+    try:
+        observability = _bench_observability_overhead(on_tpu)
+    except Exception as e:
+        observability = {"error": f"{type(e).__name__}: {e}"}
     train_774m, attainable_774m = _bench_774m_isolated(on_tpu)
     attainable = None
     if on_tpu:
@@ -567,6 +728,9 @@ def main():
         # Poisson trace)
         "serving_continuous": serving_continuous,
         "train_zero2_flash_longseq": longseq,  # seq_len inside the value
+        # ISSUE-3 acceptance: instrumented vs bare train/decode steps (2%
+        # budget) + telemetry-histogram p50/p95 vs direct measurement
+        "observability_overhead": observability,
         # second headline config (the 125M line is a model-shape wall at
         # ~44% MFU — PROFILE_TRAIN.md; MFU-vs-attainable rises with size)
         "train_774m": dict(

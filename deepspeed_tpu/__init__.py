@@ -113,6 +113,8 @@ def __getattr__(name: str):
         return _lazy("deepspeed_tpu.runtime.zero")
     if name == "serving":
         return _lazy("deepspeed_tpu.serving")
+    if name == "telemetry":
+        return _lazy("deepspeed_tpu.telemetry")
     if name == "PipelineModule":
         return _lazy("deepspeed_tpu.runtime.pipe.module").PipelineModule
     if name == "moe":

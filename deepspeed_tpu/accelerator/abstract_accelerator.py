@@ -124,6 +124,24 @@ class DeepSpeedAccelerator(abc.ABC):
 
         return [jnp.float32, jnp.bfloat16, jnp.float16, jnp.int8]
 
+    # ------------------------------------------------------------- peak flops
+    def peak_tflops(self) -> Optional[float]:
+        """Dense peak TFLOPs per chip in the fast matmul dtype
+        (:meth:`preferred_dtype`) — the MFU denominator
+        (telemetry/mfu.py). Concrete accelerators consult their
+        device-kind table; ``DSTPU_PEAK_TFLOPS`` overrides everywhere
+        (new silicon, derated quotas, CPU test runs). None = unknown, and
+        MFU-vs-peak is simply not reported."""
+        import os
+
+        env = os.environ.get("DSTPU_PEAK_TFLOPS")
+        if env:
+            try:
+                return float(env)
+            except ValueError:
+                pass
+        return None
+
     # ------------------------------------------------------------ profiler hooks
     def range_push(self, msg: str):
         """NVTX analog: jax profiler trace annotation (used by instrument_w_scope)."""

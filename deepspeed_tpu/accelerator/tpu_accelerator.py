@@ -14,6 +14,24 @@ from typing import List, Optional
 from .abstract_accelerator import DeepSpeedAccelerator
 
 
+# Dense bf16 peak TFLOPs per CHIP (not per core) by device-kind substring,
+# from the published TPU system specs. The MFU denominator
+# (telemetry/mfu.py); lookup is case-insensitive longest-match so
+# "TPU v5 lite"/"TPU v5e" both hit the v5e entry. DSTPU_PEAK_TFLOPS
+# (abstract_accelerator.peak_tflops) overrides for unlisted silicon.
+TPU_PEAK_TFLOPS = {
+    "v2": 45.0,
+    "v3": 123.0,
+    "v4": 275.0,
+    "v5 lite": 197.0,
+    "v5litepod": 197.0,
+    "v5e": 197.0,
+    "v5p": 459.0,
+    "v6 lite": 918.0,
+    "v6e": 918.0,
+}
+
+
 class TPU_Accelerator(DeepSpeedAccelerator):
     _name = "tpu"
     _communication_backend_name = "xla-ici"
@@ -54,6 +72,17 @@ class TPU_Accelerator(DeepSpeedAccelerator):
     def is_fp16_supported(self) -> bool:
         # fp16 compute works but bf16 is native; DynamicLossScaler stays optional.
         return True
+
+    def peak_tflops(self):
+        env = super().peak_tflops()
+        if env is not None:
+            return env
+        kind = self.device_kind().lower()
+        best = None
+        for sub, tf in TPU_PEAK_TFLOPS.items():
+            if sub in kind and (best is None or len(sub) > best[0]):
+                best = (len(sub), tf)
+        return best[1] if best else None
 
 
 class CPU_Accelerator(DeepSpeedAccelerator):

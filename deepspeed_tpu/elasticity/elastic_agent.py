@@ -89,6 +89,9 @@ class ElasticAgent:
             if rc == 0:
                 return 0
             if rc in self.restartable_exit_codes:
+                from deepspeed_tpu.telemetry import record_event
+
+                record_event("elastic/preemption_restarts", exit_code=rc)
                 self.preemption_restarts += 1
                 consecutive_preemptions += 1
                 consecutive = 0  # infra churn, not a failing job
@@ -119,6 +122,9 @@ class ElasticAgent:
                 consecutive = 0
             self._last_failure_t = now
             self.restart_count += 1
+            from deepspeed_tpu.telemetry import record_event
+
+            record_event("elastic/restarts", exit_code=rc)
             self._restart_times.append(now)
             spent = self._budget_spent(now)
             if spent > self.max_restarts:

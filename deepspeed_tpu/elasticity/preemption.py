@@ -120,13 +120,17 @@ class PreemptionHandler:
             self._finalize("deferred notice")
 
     def _finalize(self, reason: str):
+        from deepspeed_tpu.telemetry import record_event
+
         self._handled = True
         logger.warning(f"preemption notice ({reason}): writing final checkpoint")
         try:
             self.checkpoint_fn()
+            record_event("elastic/preemption_saves", reason=reason)
             logger.warning(f"preemption: final checkpoint done; exiting with "
                            f"restartable code {self.exit_code}")
         except BaseException:
+            record_event("elastic/preemption_save_failures", reason=reason)
             logger.exception("preemption: final checkpoint failed; exiting "
                              "restartable anyway (prior checkpoint stands)")
         self.exit_fn(self.exit_code)

@@ -642,6 +642,23 @@ class InferenceEngine:
                                   top_k, float(top_p)))
         return pf, dec
 
+    def jit_cache_sizes(self) -> Dict[str, int]:
+        """Compiled-entry count per jitted program this engine has built
+        (recompile accounting for telemetry: a program whose count keeps
+        growing is recompiling — some argument's shape/dtype varies).
+        Composed host-side drivers (``generate``'s gen closures) carry no
+        cache and are skipped."""
+        out: Dict[str, int] = {}
+        for key, fn in self._compiled.items():
+            size_fn = getattr(fn, "_cache_size", None)
+            if size_fn is None:
+                continue
+            try:
+                out[str(key)] = int(size_fn())
+            except Exception:
+                continue
+        return out
+
     @property
     def config(self):
         return self._config

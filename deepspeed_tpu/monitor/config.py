@@ -24,19 +24,33 @@ class CSVConfig(DeepSpeedConfigModel):
     job_name: str = "DeepSpeedJobName"
 
 
+class JsonlConfig(DeepSpeedConfigModel):
+    """Structured JSONL writer (the telemetry subsystem's fourth monitor
+    backend, no reference analog): every scalar event lands as one
+    ``{"kind": "scalar", "tag", "value", "step", "ts"}`` line in
+    ``<output_path>/<job_name>.jsonl`` — render with
+    ``scripts/telemetry_report.py``."""
+
+    enabled: bool = False
+    output_path: str = ""
+    job_name: str = "DeepSpeedJobName"
+
+
 class DeepSpeedMonitorConfig(DeepSpeedConfigModel):
     tensorboard: TensorBoardConfig = TensorBoardConfig()
     wandb: WandbConfig = WandbConfig()
     csv_monitor: CSVConfig = CSVConfig()
+    jsonl_monitor: JsonlConfig = JsonlConfig()
 
     @property
     def enabled(self) -> bool:
-        return self.tensorboard.enabled or self.wandb.enabled or self.csv_monitor.enabled
+        return (self.tensorboard.enabled or self.wandb.enabled or
+                self.csv_monitor.enabled or self.jsonl_monitor.enabled)
 
 
 def get_monitor_config(param_dict: dict) -> DeepSpeedMonitorConfig:
     monitor_dict = {
         k: v for k, v in param_dict.items()
-        if k in ("tensorboard", "wandb", "csv_monitor")
+        if k in ("tensorboard", "wandb", "csv_monitor", "jsonl_monitor")
     }
     return DeepSpeedMonitorConfig(**monitor_dict)

@@ -102,6 +102,34 @@ class csvMonitor(Monitor):
                 f.write(f"{step},{value}\n")
 
 
+class JsonlMonitor(Monitor):
+    """Structured JSONL writer — the telemetry subsystem's fourth backend
+    (deepspeed_tpu/telemetry/sink.py): scalar events append to
+    ``<output_path>/<job_name>.jsonl`` as one record per line, readable by
+    ``scripts/telemetry_report.py`` and any jq/pandas pipeline."""
+
+    def __init__(self, config):
+        super().__init__(config)
+        self.sink = None
+        if config.enabled and _rank() == 0:
+            from deepspeed_tpu.telemetry.sink import JsonlSink
+
+            path = os.path.join(config.output_path or "./telemetry",
+                                f"{config.job_name}.jsonl")
+            try:
+                self.sink = JsonlSink(path)
+                self.enabled = True
+            except Exception as e:
+                logger.warning(f"JSONL monitor disabled: {e}")
+
+    def write_events(self, event_list):
+        if self.sink is None:
+            return
+        for tag, value, step in event_list:
+            self.sink.scalar(tag, float(value), int(step))
+        self.sink.flush()
+
+
 class MonitorMaster(Monitor):
     """Fans out write_events to every enabled writer (reference monitor.py:29)."""
 
@@ -110,12 +138,14 @@ class MonitorMaster(Monitor):
         self.tb_monitor = TensorBoardMonitor(config.tensorboard)
         self.wandb_monitor = WandbMonitor(config.wandb)
         self.csv_monitor = csvMonitor(config.csv_monitor)
+        self.jsonl_monitor = JsonlMonitor(config.jsonl_monitor)
         self.enabled = (self.tb_monitor.enabled or self.wandb_monitor.enabled or
-                        self.csv_monitor.enabled)
+                        self.csv_monitor.enabled or self.jsonl_monitor.enabled)
 
     def write_events(self, event_list: List[Event]):
         if _rank() != 0:
             return
-        for mon in (self.tb_monitor, self.wandb_monitor, self.csv_monitor):
+        for mon in (self.tb_monitor, self.wandb_monitor, self.csv_monitor,
+                    self.jsonl_monitor):
             if mon.enabled:
                 mon.write_events(event_list)

@@ -29,6 +29,7 @@ from deepspeed_tpu.runtime.checkpoint_engine.checkpoint_engine import (
     _unflatten_into,
     verify_checkpoint,
 )
+from deepspeed_tpu.telemetry import record_event
 from deepspeed_tpu.utils import fs
 from deepspeed_tpu.utils.logging import log_dist, logger
 
@@ -102,6 +103,8 @@ def save_engine_checkpoint(engine, save_dir: str, tag: Optional[str] = None,
 
     ckpt_engine.save(state_dict, path, on_success=finalize)
     ckpt_engine.commit(tag)
+    record_event("checkpoint/saves", tag=tag,
+                 global_step=cs["global_steps"])
     log_dist(f"saved checkpoint {tag} to {save_dir}", ranks=[0])
     return True
 
@@ -224,6 +227,9 @@ def _auto_resume_load(load_dir: str, ckpt_engine):
         loaded, cs, reason = _try_load_candidate(load_dir, t, ckpt_engine)
         if loaded is not None and reason == "ok":
             if skipped or t != latest_tag:
+                record_event("checkpoint/corruption_fallbacks",
+                             fallback_tag=t, latest=latest_tag,
+                             skipped=[f"{s}: {r}" for s, r in skipped])
                 logger.warning(
                     f"auto-resume: falling back to checkpoint '{t}' "
                     f"(latest='{latest_tag}'); skipped: "
@@ -235,11 +241,16 @@ def _auto_resume_load(load_dir: str, ckpt_engine):
         logger.warning(f"auto-resume: skipping checkpoint '{t}': {reason}")
     if legacy is not None:
         t, loaded, cs = legacy
+        if skipped and skipped != [(t, _NO_MANIFEST)]:
+            record_event("checkpoint/corruption_fallbacks",
+                         fallback_tag=t, latest=latest_tag, legacy=True)
         logger.warning(
             f"auto-resume: no manifest-verified checkpoint under {load_dir}; "
             f"resuming from pre-manifest checkpoint '{t}' "
             f"(unverified — re-save to gain integrity checking)")
         return t, loaded, cs
+    record_event("checkpoint/load_failures", latest=latest_tag,
+                 rejected=[f"{t}: {r}" for t, r in skipped])
     raise CheckpointCorruptionError(
         f"no valid checkpoint under {load_dir} "
         f"(latest='{latest_tag}'); candidates rejected: "
@@ -334,6 +345,7 @@ def load_engine_checkpoint(engine, load_dir: str, tag: Optional[str] = None,
         if load_lr_scheduler_states and engine.lr_scheduler and cs.get("lr_scheduler"):
             engine.lr_scheduler.load_state_dict(cs["lr_scheduler"])
         client_state = cs.get("client_state", {})
+    record_event("checkpoint/loads", tag=tag, global_step=gstep)
     log_dist(f"loaded checkpoint {tag} from {load_dir} (reshard onto "
              f"{dict(zip(engine.topology.get_axis_names(), engine.topology.mesh_shape))})",
              ranks=[0])

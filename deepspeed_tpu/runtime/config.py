@@ -31,6 +31,7 @@ from deepspeed_tpu.runtime.config_utils import (
     get_scalar_param,
 )
 from deepspeed_tpu.runtime.zero.config import DeepSpeedZeroConfig, ZeroStageEnum
+from deepspeed_tpu.telemetry.config import TelemetryConfig, get_telemetry_config
 from deepspeed_tpu.utils.logging import logger
 
 
@@ -265,6 +266,7 @@ class DeepSpeedConfig:
         self.activation_checkpointing_config = ActivationCheckpointingConfig(
             **d.get(C.ACTIVATION_CHECKPOINTING, {}))
         self.monitor_config: DeepSpeedMonitorConfig = get_monitor_config(d)
+        self.telemetry_config: TelemetryConfig = get_telemetry_config(d)
         self.flops_profiler_config: DeepSpeedFlopsProfilerConfig = get_flops_profiler_config(d)
         self.comms_logger_config = CommsLoggerConfig(**d.get("comms_logger", {}))
         self.checkpoint_config = CheckpointConfig(**d.get(C.CHECKPOINT, {}))
@@ -301,6 +303,10 @@ class DeepSpeedConfig:
 
         # ---------------- misc ------------------------------------------------
         self.steps_per_print = d.get(C.STEPS_PER_PRINT, C.STEPS_PER_PRINT_DEFAULT)
+        # monitor cadence decoupled from print cadence; 0 (default) keeps the
+        # legacy coupling (monitor writes fire with steps_per_print)
+        self.monitor_interval = int(d.get(C.MONITOR_INTERVAL,
+                                          C.MONITOR_INTERVAL_DEFAULT))
         self.wall_clock_breakdown = d.get(C.WALL_CLOCK_BREAKDOWN, C.WALL_CLOCK_BREAKDOWN_DEFAULT)
         self.memory_breakdown = d.get(C.MEMORY_BREAKDOWN, C.MEMORY_BREAKDOWN_DEFAULT)
         self.dump_state = d.get(C.DUMP_STATE, C.DUMP_STATE_DEFAULT)

@@ -75,6 +75,10 @@ GRADIENT_PREDIVIDE_FACTOR_DEFAULT = 1.0
 #############################################
 STEPS_PER_PRINT = "steps_per_print"
 STEPS_PER_PRINT_DEFAULT = 10
+# monitor cadence decoupled from print cadence (ISSUE 3 satellite): 0 =
+# legacy behaviour (monitor writes ride steps_per_print)
+MONITOR_INTERVAL = "monitor_interval"
+MONITOR_INTERVAL_DEFAULT = 0
 WALL_CLOCK_BREAKDOWN = "wall_clock_breakdown"
 WALL_CLOCK_BREAKDOWN_DEFAULT = False
 DUMP_STATE = "dump_state"

@@ -26,6 +26,7 @@ from __future__ import annotations
 
 import dataclasses
 import os
+import time
 from typing import Any, Callable, Dict, Iterator, NamedTuple, Optional, Tuple, Union
 
 import jax
@@ -289,6 +290,30 @@ class DeepSpeedEngine:
         from deepspeed_tpu.monitor.monitor import MonitorMaster
 
         self.monitor = MonitorMaster(config.monitor_config)
+
+        # ---- telemetry (ISSUE 3): in-process metrics registry + optional
+        # JSONL sink. Per-step cost is a few dict ops (2% budget pinned by
+        # bench.py observability_overhead); device-truth metrics (device
+        # step time, MFU, grad-norm, fp16 skips, memory) are sampled at a
+        # periodic block_until_ready fence so async dispatch survives.
+        tcfg = config.telemetry_config
+        self.telemetry = None
+        self._telemetry_flops: Optional[float] = None  # None=unprobed, 0=n/a
+        self._fence_t: Optional[float] = None
+        self._fence_step = 0
+        self._fence_tokens = 0
+        self._owned_sink = None
+        if tcfg.enabled:
+            from deepspeed_tpu import telemetry as _tele
+
+            self.telemetry = _tele.get_registry()
+            if tcfg.jsonl_path and jax.process_index() == 0 \
+                    and self.telemetry.sink is None:
+                try:
+                    self._owned_sink = _tele.JsonlSink(tcfg.jsonl_path)
+                    self.telemetry.attach_sink(self._owned_sink)
+                except Exception as e:
+                    logger.warning(f"telemetry jsonl sink disabled: {e}")
         import deepspeed_tpu.comm as dist
 
         dist.configure(comms_config=None, enabled=config.comms_logger_config.enabled,
@@ -596,7 +621,7 @@ class DeepSpeedEngine:
         the sync — runtime/comm/nccl.py:54). shard_map over the data axis
         keeps grads LOCAL; the optimizer's error-compensated momentum sync
         is the only cross-device traffic (int8 signs over ICI)."""
-        from jax import shard_map
+        from deepspeed_tpu.utils.jax_compat import shard_map
 
         if self._use_pld:
             log_dist("progressive_layer_drop is not supported on the 1-bit "
@@ -721,6 +746,7 @@ class DeepSpeedEngine:
             self._build_train_step(batch)
         self.tput_timer.start()
         self.timers(TRAIN_BATCH_TIMER).start()
+        t_start = time.perf_counter()
         lr = jnp.asarray(self.get_lr()[0], jnp.float32)
         rng = jax.random.fold_in(self._dropout_rng, self.global_steps)
         batch = self._apply_curriculum(batch)
@@ -732,24 +758,26 @@ class DeepSpeedEngine:
             keep = self.random_ltd_scheduler.update_seq(self.global_steps)
             if seq_len is None or keep < seq_len:
                 ltd_keep = keep
-        if self._use_pld:
-            theta = jnp.asarray(self.progressive_layer_drop.get_theta(),
-                                jnp.float32)
-            self.state, metrics = self._compiled_train_step(
-                self.state, batch, lr, rng, theta)
-        elif not getattr(self, "_step_takes_extra_args", False):
-            # 1-bit shard_map step and subclass (pipeline) step builders
-            # keep the 4-arg signature
-            if ltd_keep is not None and not getattr(self, "_ltd_warned", False):
-                log_dist("random_ltd: this engine's train step does not "
-                         "route tokens — schedule tracked but NOT applied",
-                         ranks=[0])
-                self._ltd_warned = True
-            self.state, metrics = self._compiled_train_step(
-                self.state, batch, lr, rng)
-        else:
-            self.state, metrics = self._compiled_train_step(
-                self.state, batch, lr, rng, None, ltd_keep)
+        with jax.profiler.TraceAnnotation("dstpu/train_step"):
+            if self._use_pld:
+                theta = jnp.asarray(self.progressive_layer_drop.get_theta(),
+                                    jnp.float32)
+                self.state, metrics = self._compiled_train_step(
+                    self.state, batch, lr, rng, theta)
+            elif not getattr(self, "_step_takes_extra_args", False):
+                # 1-bit shard_map step and subclass (pipeline) step builders
+                # keep the 4-arg signature
+                if ltd_keep is not None and not getattr(self, "_ltd_warned",
+                                                        False):
+                    log_dist("random_ltd: this engine's train step does not "
+                             "route tokens — schedule tracked but NOT applied",
+                             ranks=[0])
+                    self._ltd_warned = True
+                self.state, metrics = self._compiled_train_step(
+                    self.state, batch, lr, rng)
+            else:
+                self.state, metrics = self._compiled_train_step(
+                    self.state, batch, lr, rng, None, ltd_keep)
         self._global_grad_norm = metrics["grad_norm"]
         self.micro_steps += self.gas
         self.global_steps += 1
@@ -758,6 +786,10 @@ class DeepSpeedEngine:
         self._after_step(metrics)
         self.timers(TRAIN_BATCH_TIMER).stop(record=True)
         self.tput_timer.stop(global_step=True)
+        if self.telemetry is not None:
+            self._record_step_telemetry(
+                metrics, batch, time.perf_counter() - t_start,
+                ltd_keep=ltd_keep)
         if self._sync_each_step:
             jax.block_until_ready(self.state.params)
         return metrics["loss"]
@@ -767,6 +799,7 @@ class DeepSpeedEngine:
             self._build_grad_step()
         self.tput_timer.start()
         self.timers(TRAIN_BATCH_TIMER).start()
+        t_start = time.perf_counter()
         lr = self.get_lr()[0]
         rng = jax.random.fold_in(self._dropout_rng, self.global_steps)
         batch = self._apply_curriculum(batch)
@@ -791,6 +824,11 @@ class DeepSpeedEngine:
         self._after_step(metrics)
         self.timers(TRAIN_BATCH_TIMER).stop(record=True)
         self.tput_timer.stop(global_step=True)
+        if self.telemetry is not None:
+            # host-optimizer path: the update already synchronized on the
+            # grads, so wall time here IS device time
+            self._record_step_telemetry(
+                metrics, batch, time.perf_counter() - t_start)
         if self._sync_each_step:
             jax.block_until_ready(self.state.params)
         return metrics["loss"]
@@ -852,7 +890,10 @@ class DeepSpeedEngine:
         if self.fp16_enabled:
             # host round-trip only when someone asks; keep async by default
             pass
-        if self.monitor.enabled and self.global_steps % max(cfg.steps_per_print, 1) == 0:
+        # monitor cadence decoupled from print cadence (monitor_interval
+        # config key; 0 = legacy coupling to steps_per_print)
+        mon_interval = cfg.monitor_interval or max(cfg.steps_per_print or 0, 1)
+        if self.monitor.enabled and self.global_steps % mon_interval == 0:
             loss = float(jax.device_get(metrics["loss"]))
             events = [("Train/Samples/train_loss", loss, self.global_steps),
                       ("Train/Samples/lr", self.get_lr()[0], self.global_steps)]
@@ -869,6 +910,170 @@ class DeepSpeedEngine:
                                  BACKWARD_GLOBAL_TIMER, STEP_GLOBAL_TIMER],
                                 memory_breakdown=cfg.memory_breakdown)
 
+    # -------------------------------------------------------------- telemetry
+    @staticmethod
+    def _batch_token_count(batch) -> int:
+        """Tokens in one engine step (LM batches); sample count otherwise."""
+        if isinstance(batch, dict) and "input_ids" in batch:
+            try:
+                return int(np.prod(np.shape(batch["input_ids"])))
+            except Exception:
+                pass
+        return 0
+
+    def _record_step_telemetry(self, metrics, batch, wall_dt: float,
+                               ltd_keep=None):
+        """Hot-path accounting: a histogram observe + two counter incs per
+        step. Everything that would force a device sync (grad-norm, fp16
+        skips, memory, device-time MFU) waits for the periodic fence."""
+        reg = self.telemetry
+        tokens = self._batch_token_count(batch)
+        reg.counter("train/steps").inc()
+        if tokens:
+            self._fence_tokens += tokens
+            reg.counter("train/tokens").inc(tokens)
+        # dispatch-bounded under async dispatch (TPU); device truth comes
+        # from the fence-to-fence gauge below
+        reg.histogram("train/step_wall_ms").observe(wall_dt * 1e3)
+        interval = self.config.telemetry_config.sync_interval
+        if interval and (self.global_steps % interval == 0
+                         or self.global_steps == 1):
+            self._telemetry_fence(metrics, batch, ltd_keep)
+
+    def _reset_telemetry_window(self):
+        """Invalidate the fence-to-fence device-rate baseline. Called
+        around work that is NOT training steps (checkpoint save/load) so
+        a multi-second blocking save between fences is never charged to
+        train/device_step_time_ms or train/mfu."""
+        self._fence_t = None
+        self._fence_step = self.global_steps
+        self._fence_tokens = 0
+
+    def _telemetry_fence(self, metrics, batch, ltd_keep=None):
+        """Periodic block_until_ready fence: honest device-time step
+        latency + MFU from fence-to-fence elapsed, plus the scalars whose
+        read would otherwise break async dispatch. Assumes fence-to-fence
+        wall time is training; engine-visible non-training work
+        (checkpoint save/load) resets the window via
+        _reset_telemetry_window — caller-side stalls between steps are
+        still charged (they are invisible from here)."""
+        reg = self.telemetry
+        jax.block_until_ready(self.state.params)
+        now = time.perf_counter()
+        steps = self.global_steps - self._fence_step
+        if self._fence_t is not None and steps > 0:
+            dev_step_s = (now - self._fence_t) / steps
+            reg.gauge("train/device_step_time_ms").set(dev_step_s * 1e3)
+            if self._fence_tokens:
+                reg.gauge("train/tokens_per_sec").set(
+                    self._fence_tokens / (now - self._fence_t))
+            flops = self._telemetry_flops  # probed at the previous fence
+            if flops:
+                reg.gauge("train/model_tflops").set(flops / dev_step_s / 1e12)
+                from deepspeed_tpu.telemetry.mfu import mfu as _mfu
+
+                u = _mfu(flops, dev_step_s)
+                if u is not None:
+                    reg.gauge("train/mfu").set(u)
+        # probe flops AFTER reading the window so the probe's one-time
+        # lower+compile never pollutes a device-rate sample; the first
+        # fence is step 1, so the compile lands in warmup
+        self._train_step_flops(batch, ltd_keep)
+        self._fence_step = self.global_steps
+        self._fence_tokens = 0
+        # device-truth scalars: the fence already drained the pipeline, so
+        # these fetches are free of extra sync
+        try:
+            reg.gauge("train/grad_norm").set(
+                float(jax.device_get(metrics["grad_norm"])))
+            reg.gauge("train/loss").set(
+                float(jax.device_get(metrics["loss"])))
+            if self.fp16_enabled:
+                reg.gauge("train/loss_scale").set(
+                    float(jax.device_get(metrics["loss_scale"])))
+                # device global_step counts only successful steps; the host
+                # counter counts all — the difference IS the skip count
+                device_gs = int(jax.device_get(self.state.global_step))
+                reg.gauge("train/fp16_skipped_steps").set(
+                    max(self.global_steps - device_gs, 0))
+        except Exception:
+            pass
+        stats = self.accelerator.memory_stats()
+        if stats:
+            reg.gauge("device/mem_in_use_bytes").set(
+                stats.get("bytes_in_use", 0))
+            reg.gauge("device/mem_peak_bytes").set(
+                stats.get("peak_bytes_in_use", 0))
+        reg.flush(step=self.global_steps)
+        # window baseline AFTER the probe + fetches, so only training
+        # steps are charged to the next fence-to-fence device rate
+        self._fence_t = time.perf_counter()
+
+    def _train_step_flops(self, batch, ltd_keep=None) -> Optional[float]:
+        """Model flops of ONE fused train step, cached after first probe.
+        Primary: XLA's own cost_analysis of the compiled step (post-fusion,
+        includes remat recompute — the PaLM MFU numerator). Costs one extra
+        lower+compile at the first fence (disable via
+        telemetry.cost_analysis). Fallback: analytic 6*N*tokens."""
+        if self._telemetry_flops is not None:
+            return self._telemetry_flops or None
+        flops = 0.0
+        # the probe costs one extra lower+compile of the train step, so it
+        # runs only where the result is actually consumed: a JSONL sink is
+        # attached, or the accelerator has a peak entry (MFU computable —
+        # real TPU, or DSTPU_PEAK_TFLOPS set). CPU unit tests take the
+        # free analytic fallback.
+        worth_probing = (self.telemetry.sink is not None
+                         or self.accelerator.peak_tflops() is not None)
+        if (self.config.telemetry_config.cost_analysis and worth_probing
+                and self._compiled_train_step is not None
+                and getattr(self, "_step_takes_extra_args", False)
+                and not self._use_pld):
+            try:
+                lowered = self._compiled_train_step.lower(
+                    self.state, batch,
+                    jnp.zeros((), jnp.float32),
+                    jax.random.PRNGKey(0), None, ltd_keep)
+                ca = lowered.compile().cost_analysis()
+                if isinstance(ca, list):
+                    ca = ca[0] if ca else {}
+                flops = float((ca or {}).get("flops", 0.0) or 0.0)
+                # cost_analysis sees the PER-DEVICE partitioned module;
+                # scale to global so both flops sources and the aggregate
+                # peak denominator (mfu.peak_flops_per_sec over all chips)
+                # agree. Replicated compute makes this a slight
+                # overcount — acceptable for an MFU estimate.
+                flops *= jax.device_count()
+            except Exception as e:
+                logger.warning("telemetry: cost_analysis of the train step "
+                               "failed (%s: %s); using analytic flops",
+                               type(e).__name__, e)
+        if not flops:
+            tokens = self._batch_token_count(batch)
+            if tokens:
+                n_params = sum(int(np.prod(l.shape)) for l in
+                               jax.tree_util.tree_leaves(self._params_shape))
+                flops = 6.0 * n_params * tokens
+        self._telemetry_flops = flops
+        return flops or None
+
+    def destroy(self):
+        """Engine shutdown (reference engine.destroy): emit the comms
+        summary when comms logging is enabled, flush telemetry, close the
+        engine-owned JSONL sink."""
+        import deepspeed_tpu.comm as dist
+
+        if self.config.comms_logger_config.enabled:
+            dist.log_summary()
+        if self.telemetry is not None:
+            self.telemetry.flush(step=self.global_steps)
+        if self._owned_sink is not None:
+            self._owned_sink.close()
+            if self.telemetry is not None and \
+                    self.telemetry.sink is self._owned_sink:
+                self.telemetry.attach_sink(None)
+            self._owned_sink = None
+
     # ------------------------------------------ forward/backward/step parity
     def forward(self, batch):
         """Compute loss for one microbatch; grads are computed in the same
@@ -881,8 +1086,9 @@ class DeepSpeedEngine:
         self.timers(FORWARD_GLOBAL_TIMER).start()
         rng = jax.random.fold_in(self._dropout_rng, self.micro_steps)
         batch = jax.device_put(batch, self._batch_shardings(batch))
-        scaled_loss, grads, metrics = self._compiled_micro_grad(
-            self.state.params, self.state.scaler, batch, rng)
+        with jax.profiler.TraceAnnotation("dstpu/forward"):
+            scaled_loss, grads, metrics = self._compiled_micro_grad(
+                self.state.params, self.state.scaler, batch, rng)
         self._pending = (scaled_loss, grads)
         self.timers(FORWARD_GLOBAL_TIMER).stop()
         return metrics["loss"]
@@ -896,11 +1102,12 @@ class DeepSpeedEngine:
         self.timers(BACKWARD_GLOBAL_TIMER).start()
         _, grads = self._pending
         self._pending = None
-        if self._grad_acc is None:
-            self._grad_acc = grads
-        else:
-            add = jax.jit(lambda a, b: jax.tree_util.tree_map(jnp.add, a, b))
-            self._grad_acc = add(self._grad_acc, grads)
+        with jax.profiler.TraceAnnotation("dstpu/backward"):
+            if self._grad_acc is None:
+                self._grad_acc = grads
+            else:
+                add = jax.jit(lambda a, b: jax.tree_util.tree_map(jnp.add, a, b))
+                self._grad_acc = add(self._grad_acc, grads)
         self._acc_count += 1
         self.timers(BACKWARD_GLOBAL_TIMER).stop()
         return loss
@@ -945,8 +1152,9 @@ class DeepSpeedEngine:
                     return new_state, overflow, norm
                 self._compiled_apply_grads = jax.jit(apply_fn, donate_argnums=(0, 1))
             lr = jnp.asarray(self.get_lr()[0], jnp.float32)
-            self.state, overflow, norm = self._compiled_apply_grads(
-                self.state, self._grad_acc, lr)
+            with jax.profiler.TraceAnnotation("dstpu/optimizer_step"):
+                self.state, overflow, norm = self._compiled_apply_grads(
+                    self.state, self._grad_acc, lr)
             self._grad_acc = None
             self._acc_count = 0
             self._global_grad_norm = norm
@@ -1026,19 +1234,28 @@ class DeepSpeedEngine:
     def save_checkpoint(self, save_dir, tag=None, client_state=None, save_latest=True):
         from deepspeed_tpu.runtime.checkpoint_engine.engine import save_engine_checkpoint
 
-        return save_engine_checkpoint(self, save_dir, tag=tag, client_state=client_state,
-                                      save_latest=save_latest,
-                                      checkpoint_engine=self._checkpoint_engine())
+        try:
+            return save_engine_checkpoint(self, save_dir, tag=tag,
+                                          client_state=client_state,
+                                          save_latest=save_latest,
+                                          checkpoint_engine=self._checkpoint_engine())
+        finally:
+            if self.telemetry is not None:
+                self._reset_telemetry_window()
 
     def load_checkpoint(self, load_dir, tag=None, load_optimizer_states=True,
                         load_lr_scheduler_states=True, load_module_only=False):
         from deepspeed_tpu.runtime.checkpoint_engine.engine import load_engine_checkpoint
 
-        return load_engine_checkpoint(self, load_dir, tag=tag,
-                                      load_optimizer_states=load_optimizer_states,
-                                      load_lr_scheduler_states=load_lr_scheduler_states,
-                                      load_module_only=load_module_only,
-                                      checkpoint_engine=self._checkpoint_engine())
+        try:
+            return load_engine_checkpoint(self, load_dir, tag=tag,
+                                          load_optimizer_states=load_optimizer_states,
+                                          load_lr_scheduler_states=load_lr_scheduler_states,
+                                          load_module_only=load_module_only,
+                                          checkpoint_engine=self._checkpoint_engine())
+        finally:
+            if self.telemetry is not None:
+                self._reset_telemetry_window()
 
     def save_16bit_model(self, save_dir, save_filename="model_weights.npz"):
         from deepspeed_tpu.runtime.checkpoint_engine.engine import save_16bit_model

@@ -369,8 +369,11 @@ class PipelineEngine(DeepSpeedEngine):
         import jax.numpy as jnp  # noqa: F811
         from deepspeed_tpu.runtime.engine import TRAIN_BATCH_TIMER
 
+        import time
+
         self.tput_timer.start()
         self.timers(TRAIN_BATCH_TIMER).start()
+        t_start = time.perf_counter()
         batch = self._apply_curriculum(batch)
         batch = jax.device_put(batch, self._gas_batch_shardings(batch))
         lr = jnp.asarray(self.get_lr()[0], jnp.float32)
@@ -413,6 +416,9 @@ class PipelineEngine(DeepSpeedEngine):
         self._after_step(metrics)
         self.timers(TRAIN_BATCH_TIMER).stop(record=True)
         self.tput_timer.stop(global_step=True)
+        if self.telemetry is not None:
+            self._record_step_telemetry(
+                metrics, batch, time.perf_counter() - t_start)
         if self._sync_each_step:
             jax.block_until_ready(self.state.params)
         return metrics["loss"]

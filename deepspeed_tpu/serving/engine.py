@@ -71,6 +71,12 @@ class ServingEngine:
     time_fn: clock used for arrival admission + latency metrics; defaults
         to time.monotonic. Tests inject a virtual clock so mixed arrival
         traces replay deterministically.
+    telemetry: True (default) instruments the serving loop into the
+        global metrics registry (queue-wait/TTFT/TPOT latency histograms,
+        slot-occupancy and batch-fill gauges, recompile counter,
+        finished-requests/sec — ISSUE 3); pass a MetricsRegistry to use a
+        private one, or False/None to run bare (the bench.py
+        ``observability_overhead`` baseline).
     """
 
     def __init__(self, engine, *, num_slots: int = 8, max_len: int = 1024,
@@ -78,7 +84,8 @@ class ServingEngine:
                  eos_token_id: Optional[int] = None, pad_token_id: int = 0,
                  do_sample: bool = False, temperature: float = 1.0,
                  top_k: int = 0, top_p: float = 1.0,
-                 time_fn: Optional[Callable[[], float]] = None):
+                 time_fn: Optional[Callable[[], float]] = None,
+                 telemetry=True):
         self.engine = engine
         model = engine.module
         mcfg = getattr(model, "config", None)
@@ -143,6 +150,13 @@ class ServingEngine:
         self.decode_steps = 0
         self.prefill_calls = 0
         self.tokens_generated = 0
+        self._active_slot_iterations = 0
+        if telemetry is True:
+            from deepspeed_tpu.telemetry import get_registry
+
+            self.telemetry = get_registry()
+        else:
+            self.telemetry = telemetry or None
         log_dist(f"ServingEngine: slots={num_slots} max_len={max_len} "
                  f"buckets={self.buckets} cache={self.cache!r}", ranks=[0])
 
@@ -241,6 +255,17 @@ class ServingEngine:
         st.result.finish_reason = reason
         self._slots[slot] = None
         self.scheduler.release(slot)
+        if self.telemetry is not None:
+            res = st.result
+            reg = self.telemetry
+            reg.counter("serving/finished_requests").inc()
+            reg.histogram("serving/latency_ms").observe(res.latency * 1e3)
+            n_dec = len(res.tokens) - 1  # tokens after the prefill token
+            if n_dec > 0:
+                # Orca-style iteration accounting: time-per-output-token
+                # over the decode phase only (TTFT covers the prefill)
+                reg.histogram("serving/tpot_ms").observe(
+                    (res.finish_time - res.first_token_time) / n_dec * 1e3)
         return st.result
 
     def _maybe_finish(self, slot: int, now: float) -> Optional[RequestResult]:
@@ -263,18 +288,26 @@ class ServingEngine:
             bucket = pick_bucket(plen, self.buckets)
             ids = np.full((1, bucket), self.pad_token_id, np.int32)
             ids[0, :plen] = np.asarray(req.prompt, np.int32)
-            out = self._prefill_fn(bucket)(
-                eng.params, *self.cache.carry(), jnp.asarray(ids),
-                np.int32(slot), np.int32(plen), self._temp,
-                self._next_rng())
-            self.cache.update(*out[:3])
-            tok = int(jax.device_get(out[3]))
+            with jax.profiler.TraceAnnotation("dstpu/serving_prefill"):
+                out = self._prefill_fn(bucket)(
+                    eng.params, *self.cache.carry(), jnp.asarray(ids),
+                    np.int32(slot), np.int32(plen), self._temp,
+                    self._next_rng())
+                self.cache.update(*out[:3])
+                tok = int(jax.device_get(out[3]))
             self.prefill_calls += 1
             self.tokens_generated += 1
             res = RequestResult(rid=req.rid, prompt_len=plen,
                                 tokens=[tok], arrival_time=req.arrival_time,
                                 admitted_time=now,
                                 first_token_time=self._now(now))
+            if self.telemetry is not None:
+                reg = self.telemetry
+                reg.counter("serving/prefills").inc()
+                reg.histogram("serving/queue_wait_ms").observe(
+                    max(now - req.arrival_time, 0.0) * 1e3)
+                reg.histogram("serving/ttft_ms").observe(
+                    max(res.first_token_time - req.arrival_time, 0.0) * 1e3)
             self._slots[slot] = _SlotState(req, res, tok)
             done = self._maybe_finish(slot, now)
             if done is not None:
@@ -289,8 +322,18 @@ class ServingEngine:
             self.warmup()
         if now is None:
             now = self._time()
-        finished = self._admit(now)
+        with jax.profiler.TraceAnnotation("dstpu/serving_admit"):
+            finished = self._admit(now)
         active_slots = [i for i, s in enumerate(self._slots) if s is not None]
+        if self.telemetry is not None:
+            # iteration-level gauges: slot occupancy after admission and
+            # the decode batch's fill ratio (identical here since every
+            # occupied slot decodes — they diverge for engines that cap
+            # the decode batch below the slot count)
+            occ = len(active_slots) / self.num_slots
+            self.telemetry.gauge("serving/slot_occupancy").set(occ)
+            if active_slots:
+                self.telemetry.gauge("serving/batch_fill_ratio").set(occ)
         if not active_slots:
             return finished
         toks = np.full((self.num_slots,), self.pad_token_id, np.int32)
@@ -298,12 +341,18 @@ class ServingEngine:
             toks[i] = self._slots[i].last_token
         active = np.zeros((self.num_slots,), bool)
         active[active_slots] = True
-        out = self._decode(self.engine.params, *self.cache.carry(),
-                           jnp.asarray(toks), jnp.asarray(active),
-                           self._temp, self._next_rng())
-        self.cache.update(*out[:3])
-        nxt = np.asarray(jax.device_get(out[3]))
+        with jax.profiler.TraceAnnotation("dstpu/serving_decode"):
+            out = self._decode(self.engine.params, *self.cache.carry(),
+                               jnp.asarray(toks), jnp.asarray(active),
+                               self._temp, self._next_rng())
+            self.cache.update(*out[:3])
+            nxt = np.asarray(jax.device_get(out[3]))
         self.decode_steps += 1
+        self._active_slot_iterations += len(active_slots)
+        if self.telemetry is not None:
+            self.telemetry.counter("serving/decode_steps").inc()
+            self.telemetry.counter("serving/slot_iterations_active").inc(
+                len(active_slots))
         for i in active_slots:
             st = self._slots[i]
             tok = int(nxt[i])
@@ -327,6 +376,7 @@ class ServingEngine:
             self.warmup()
         t0 = self._time()
         self._run_t0 = t0
+        tokens_before = self.tokens_generated
         results: List[RequestResult] = []
         stall = 0
         while self.pending:
@@ -345,4 +395,38 @@ class ServingEngine:
                     continue
             stall = 0
             results.extend(self.step(now))
+        if self.telemetry is not None:
+            self._record_run_telemetry(
+                len(results), self._time() - t0,
+                self.tokens_generated - tokens_before)
         return results
+
+    # ------------------------------------------------------------- telemetry
+    def recompile_count(self) -> int:
+        """Excess jit-cache entries across the serving programs — any
+        value > 0 means some program recompiled after warmup (an
+        argument's shape/dtype/sharding varied)."""
+        return sum(max(0, v - 1) for v in self.program_cache_sizes().values())
+
+    def _record_run_telemetry(self, n_finished: int, elapsed: float,
+                              run_tokens: int) -> None:
+        reg = self.telemetry
+        reg.gauge("serving/run_elapsed_s").set(elapsed)
+        if elapsed > 0:
+            reg.gauge("serving/finished_requests_per_sec").set(
+                n_finished / elapsed)
+            # THIS run's tokens only — self.tokens_generated is cumulative
+            # across runs while elapsed resets, so using it would inflate
+            # the rate on every run() after the first
+            reg.gauge("serving/tokens_per_sec").set(run_tokens / elapsed)
+        reg.gauge("serving/peak_queue_depth").set(
+            self.scheduler.peak_queue_depth)
+        reg.gauge("serving/compiled_programs").set(self.program_count)
+        reg.gauge("serving/jit_cache_entries").set(
+            sum(self.program_cache_sizes().values()))
+        reg.gauge("serving/recompiles").set(self.recompile_count())
+        if self.decode_steps:
+            reg.gauge("serving/mean_batch_fill_ratio").set(
+                self._active_slot_iterations /
+                (self.decode_steps * self.num_slots))
+        reg.flush()

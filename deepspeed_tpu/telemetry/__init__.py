@@ -1,0 +1,58 @@
+"""Unified telemetry subsystem (ISSUE 3).
+
+The cross-cutting observability layer the reference spreads over
+``deepspeed/monitor``, ``utils/timer.py``, the flops profiler and the
+comms logger, redesigned for JAX's async-dispatch execution model:
+
+  * :mod:`registry`  — counters / gauges / fixed-bucket latency histograms
+    with p50/p95/p99 snapshots; process-global default registry plus
+    :func:`record_event` for discrete occurrences (checkpoint saves,
+    corruption fallbacks, elastic restarts).
+  * :mod:`sink`      — structured JSONL sink (one record per line) that
+    also plugs into :class:`~deepspeed_tpu.monitor.monitor.MonitorMaster`
+    as its fourth writer; render with ``scripts/telemetry_report.py``.
+  * :mod:`trace`     — ``telemetry.trace(path)`` Perfetto/XPlane capture
+    around any block, with the hot loops' named scopes inside.
+  * :mod:`mfu`       — PaLM-sense model-flops-utilization against the
+    accelerator layer's per-chip peak table.
+
+Instrumentation points: ``runtime/engine.py`` (per-step wall/device time,
+tokens/sec, MFU, grad-norm, fp16 skip counters, device memory) and
+``serving/engine.py`` (queue-wait/TTFT/TPOT histograms, slot occupancy,
+recompile counter, finished-requests/sec). Overhead is budgeted at 2% and
+measured by ``bench.py``'s ``observability_overhead`` section.
+"""
+
+from deepspeed_tpu.telemetry.config import TelemetryConfig, get_telemetry_config
+from deepspeed_tpu.telemetry.mfu import mfu, peak_flops_per_sec
+from deepspeed_tpu.telemetry.registry import (
+    DEFAULT_LATENCY_BUCKETS_MS,
+    Counter,
+    Gauge,
+    Histogram,
+    MetricsRegistry,
+    get_registry,
+    record_event,
+    reset_registry,
+)
+from deepspeed_tpu.telemetry.sink import JsonlSink, read_jsonl
+from deepspeed_tpu.telemetry.trace import annotate, trace
+
+__all__ = [
+    "DEFAULT_LATENCY_BUCKETS_MS",
+    "Counter",
+    "Gauge",
+    "Histogram",
+    "JsonlSink",
+    "MetricsRegistry",
+    "TelemetryConfig",
+    "annotate",
+    "get_registry",
+    "get_telemetry_config",
+    "mfu",
+    "peak_flops_per_sec",
+    "read_jsonl",
+    "record_event",
+    "reset_registry",
+    "trace",
+]

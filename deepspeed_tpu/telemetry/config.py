@@ -1,0 +1,33 @@
+"""Telemetry config section (``"telemetry": {...}`` in the DeepSpeed JSON).
+
+Keys:
+  enabled        — master switch for engine/serving instrumentation
+                   (default true; the registry ops it gates cost ~1us/step,
+                   see bench.py observability_overhead).
+  jsonl_path     — when non-empty, a JsonlSink is attached to the global
+                   registry and periodic snapshots + events stream there
+                   (render with scripts/telemetry_report.py).
+  sync_interval  — every N global steps the engine fences device work
+                   (block_until_ready) to read honest device-time step
+                   latency, memory gauges, grad-norm/overflow/MFU. 0
+                   disables fencing (async dispatch never perturbed;
+                   device-time metrics then unavailable).
+  cost_analysis  — allow a one-time XLA cost_analysis of the compiled
+                   train step for MFU flops (an extra lower+compile at the
+                   first fence; analytic model flops are the fallback).
+"""
+
+from __future__ import annotations
+
+from deepspeed_tpu.runtime.config_utils import DeepSpeedConfigModel
+
+
+class TelemetryConfig(DeepSpeedConfigModel):
+    enabled: bool = True
+    jsonl_path: str = ""
+    sync_interval: int = 50
+    cost_analysis: bool = True
+
+
+def get_telemetry_config(param_dict: dict) -> TelemetryConfig:
+    return TelemetryConfig(**param_dict.get("telemetry", {}))

@@ -1,0 +1,48 @@
+"""Model-flops-utilization accounting (PaLM appendix B sense).
+
+MFU = achieved model flops/sec ÷ peak chip flops/sec: the fraction of the
+hardware's matmul ceiling the training loop actually sustains, with model
+flops counted analytically or from XLA's own ``cost_analysis`` of the
+compiled step (post-fusion, what actually hits the MXU) — NOT
+hardware-counter flops, so recomputation (remat) is charged against MFU
+exactly as PaLM defines it when using cost_analysis of the remat program.
+
+The peak table itself lives in the accelerator layer
+(``accelerator.peak_tflops()``: per-chip dense bf16 peak by device kind,
+``DSTPU_PEAK_TFLOPS`` env override for new silicon); this module only does
+the division.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+
+def peak_flops_per_sec(n_chips: Optional[int] = None) -> Optional[float]:
+    """Aggregate peak (flops/sec) across ``n_chips`` (default: every device
+    in the process's world). None when the accelerator has no peak entry
+    (e.g. the CPU test backend without DSTPU_PEAK_TFLOPS set)."""
+    from deepspeed_tpu.accelerator import get_accelerator
+
+    acc = get_accelerator()
+    per_chip = acc.peak_tflops()
+    if per_chip is None or per_chip <= 0:
+        return None
+    if n_chips is None:
+        try:
+            n_chips = acc.device_count()
+        except Exception:
+            n_chips = 1
+    return per_chip * 1e12 * max(n_chips, 1)
+
+
+def mfu(flops_per_step: float, step_time_s: float,
+        n_chips: Optional[int] = None) -> Optional[float]:
+    """Achieved-vs-peak utilization in [0, ~1]; None when peak is unknown
+    or inputs are degenerate."""
+    if not flops_per_step or not step_time_s or step_time_s <= 0:
+        return None
+    peak = peak_flops_per_sec(n_chips)
+    if peak is None:
+        return None
+    return (flops_per_step / step_time_s) / peak

@@ -1,0 +1,111 @@
+"""Structured JSONL sink — the durable half of the telemetry subsystem.
+
+One record per line, append-only, buffered host-side: a run produces a
+single ``telemetry.jsonl`` that ``scripts/telemetry_report.py`` renders
+into a human summary and downstream tooling can grep/stream. Record kinds:
+
+  {"ts": ..., "kind": "scalar",   "tag": ..., "value": ..., "step": ...}
+  {"ts": ..., "kind": "event",    "name": ..., **fields}
+  {"ts": ..., "kind": "snapshot", "step": ..., "metrics": {...}}
+
+``ts`` is wall-clock epoch seconds, stamped at write. Writes are buffered
+(``flush_every`` records) so the hot loop pays a dict+list append, not a
+syscall; ``flush()``/``close()`` drain. All I/O errors are swallowed after
+a one-time warning — telemetry must never take down the job it observes.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import List, Optional
+
+
+class JsonlSink:
+    def __init__(self, path: str, flush_every: int = 64):
+        self.path = path
+        self.flush_every = max(int(flush_every), 1)
+        self._buf: List[str] = []
+        self._lock = threading.Lock()
+        self._fh = None
+        self._warned = False
+        self.records_written = 0
+        parent = os.path.dirname(os.path.abspath(path))
+        os.makedirs(parent, exist_ok=True)
+
+    def _warn_once(self, e: Exception) -> None:
+        if not self._warned:
+            self._warned = True
+            from deepspeed_tpu.utils.logging import logger
+
+            logger.warning(f"telemetry sink {self.path}: {type(e).__name__}: "
+                           f"{e}; further records dropped silently")
+
+    def write(self, record: dict) -> None:
+        rec = dict(record)
+        rec.setdefault("ts", time.time())
+        try:
+            line = json.dumps(rec, default=str)
+        except Exception as e:
+            self._warn_once(e)
+            return
+        with self._lock:
+            self._buf.append(line)
+            if len(self._buf) >= self.flush_every:
+                self._drain_locked()
+
+    def scalar(self, tag: str, value: float, step: int) -> None:
+        """Monitor-event shape (the JSONL fourth writer goes through this)."""
+        self.write({"kind": "scalar", "tag": tag, "value": value, "step": step})
+
+    def _drain_locked(self) -> None:
+        if not self._buf:
+            return
+        try:
+            if self._fh is None:
+                self._fh = open(self.path, "a")
+            self._fh.write("\n".join(self._buf) + "\n")
+            self._fh.flush()
+            self.records_written += len(self._buf)
+        except Exception as e:
+            self._warn_once(e)
+        finally:
+            self._buf.clear()
+
+    def flush(self) -> None:
+        with self._lock:
+            self._drain_locked()
+
+    def close(self) -> None:
+        with self._lock:
+            self._drain_locked()
+            if self._fh is not None:
+                try:
+                    self._fh.close()
+                except Exception:
+                    pass
+                self._fh = None
+
+    def __enter__(self) -> "JsonlSink":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+def read_jsonl(path: str) -> List[dict]:
+    """Parse a telemetry JSONL file, skipping lines torn by a crash
+    mid-write (same tolerance as the test-harness report reader)."""
+    out: List[dict] = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                out.append(json.loads(line))
+            except ValueError:
+                continue
+    return out

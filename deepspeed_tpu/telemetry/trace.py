@@ -1,0 +1,73 @@
+"""Profiler trace capture — ``telemetry.trace(path)``.
+
+Context-manager wrapper over ``jax.profiler.start_trace``/``stop_trace``
+producing a Perfetto/XPlane trace directory viewable at ui.perfetto.dev
+(or TensorBoard's profile plugin). The named scopes the hot loops already
+emit (``utils/nvtx.py`` TraceAnnotations around prefill/decode/admit and
+fwd/bwd/step) appear as ranges inside it, the way NVTX ranges appear in
+Nsight for the reference.
+
+Degrades to a no-op with a warning when the installed jax/backend cannot
+start a trace (some stripped jaxlib builds lack the profiler server) —
+capturing a trace is never worth crashing the run being traced.
+"""
+
+from __future__ import annotations
+
+import contextlib
+from typing import Iterator, Optional
+
+
+def annotate(name: str):
+    """Named profiler scope (``with telemetry.annotate("decode"): ...``).
+    Same TraceAnnotation the nvtx shim uses; reusable as a decorator via
+    :func:`deepspeed_tpu.utils.nvtx.instrument_w_nvtx`."""
+    import jax
+
+    return jax.profiler.TraceAnnotation(name)
+
+
+@contextlib.contextmanager
+def trace(path: str, *, host_tracer_level: Optional[int] = None
+          ) -> Iterator[str]:
+    """Capture a device+host profiler trace of the enclosed block into
+    ``path`` (a directory; created if needed).
+
+    Example — trace one serving burst::
+
+        with telemetry.trace("/tmp/serve_trace"):
+            serving_engine.run(requests)
+
+    then load ``path``'s ``plugins/profile/.../*.trace.json.gz`` in
+    Perfetto. ``host_tracer_level`` forwards to jax when supported
+    (higher = more host annotations)."""
+    import jax
+
+    from deepspeed_tpu.utils.logging import logger
+
+    started = False
+    try:
+        kwargs = {}
+        if host_tracer_level is not None:
+            try:
+                from jax.profiler import ProfileOptions  # jax >= 0.4.31
+
+                opts = ProfileOptions()
+                opts.host_tracer_level = host_tracer_level
+                kwargs["profiler_options"] = opts
+            except Exception:
+                pass  # older jax: no per-trace options; default level
+        jax.profiler.start_trace(str(path), **kwargs)
+        started = True
+    except Exception as e:
+        logger.warning(f"telemetry.trace: cannot start profiler trace "
+                       f"({type(e).__name__}: {e}); running untraced")
+    try:
+        yield str(path)
+    finally:
+        if started:
+            try:
+                jax.profiler.stop_trace()
+            except Exception as e:
+                logger.warning(f"telemetry.trace: stop_trace failed "
+                               f"({type(e).__name__}: {e})")

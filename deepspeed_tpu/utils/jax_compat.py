@@ -1,0 +1,68 @@
+"""Compatibility shims over jax API drift.
+
+``shard_map`` moved from ``jax.experimental.shard_map`` to the top-level
+``jax`` namespace, and two kwargs were renamed on the way:
+
+  * ``check_rep``  → ``check_vma``
+  * partial-manual axes: old API takes ``auto`` (the complement set —
+    mesh axes left OUT of manual mode), new API takes ``axis_names``
+    (the manual set itself).
+
+The codebase is written against the new surface (``axis_names``,
+``check_vma``); this adapter translates per-installed-jax so the 1-bit
+engine path, ring attention, and the pipeline executor run on both. On
+jaxlibs where ``from jax import shard_map`` works, this is a pass-through.
+"""
+
+from __future__ import annotations
+
+import inspect
+
+import jax
+
+try:  # jax >= 0.6 (top-level, check_vma/axis_names spelling)
+    from jax import shard_map as _shard_map
+except ImportError:  # older jax: experimental module, check_rep/auto
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+_PARAMS = frozenset(inspect.signature(_shard_map).parameters)
+
+
+def has_vma_typing() -> bool:
+    """True when this jax tracks shard_map varying-manual-axes types
+    (aval ``.vma``); same probe as ops.flash_attention.
+    vma_typing_supported, duplicated here so L0 utils need not import the
+    kernel layer."""
+    try:
+        import jax.numpy as jnp
+
+        jax.ShapeDtypeStruct((1,), jnp.float32, vma=frozenset())
+        return hasattr(jax.typeof(jnp.zeros(())), "vma")
+    except Exception:
+        return False
+
+
+def pcast_varying(x, axis_names):
+    """``lax.pcast(x, axes, to="varying")`` where vma typing exists;
+    identity on older jax, whose shard_map rep machinery either inserts
+    the casts itself (check_rep=True) or doesn't track reps at all
+    (check_rep=False) — there is nothing to cast."""
+    pcast = getattr(jax.lax, "pcast", None)
+    if pcast is None:
+        return x
+    return pcast(x, axis_names, to="varying")
+
+
+def shard_map(f, *, mesh, in_specs, out_specs, **kw):
+    if "check_vma" in kw and "check_vma" not in _PARAMS:
+        kw["check_rep"] = kw.pop("check_vma")
+    elif "check_rep" in kw and "check_rep" not in _PARAMS:
+        kw["check_vma"] = kw.pop("check_rep")
+    if "axis_names" in kw and "axis_names" not in _PARAMS:
+        manual = kw.pop("axis_names")
+        if manual is not None and "auto" in _PARAMS:
+            auto = frozenset(getattr(mesh, "axis_names", ())) - set(manual)
+            if auto:
+                kw["auto"] = auto
+    return _shard_map(f, mesh=mesh, in_specs=in_specs,
+                      out_specs=out_specs, **kw)

@@ -125,6 +125,7 @@ class ThroughputTimer:
         self.global_step_count = 0
         self.total_elapsed_time = 0.0
         self.step_elapsed_time = 0.0
+        self.window_steps = 0  # timed global steps in the current window
         self.steps_per_output = steps_per_output
         self.monitor_memory = monitor_memory
         self.logging = logging_fn or (lambda msg: log_dist(msg, ranks=[0]))
@@ -156,18 +157,26 @@ class ThroughputTimer:
             duration = self.end_time - self.start_time
             self.total_elapsed_time += duration
             self.step_elapsed_time += duration
+            if global_step:
+                self.window_steps += 1
             self.start_time = 0.0
             if global_step and report_speed and \
                     self.global_step_count % self.steps_per_output == 0:
+                # step_elapsed_time spans EVERY timed step since the last
+                # report, so the current-rate numerator is the window's
+                # sample count, not one batch (a single batch_size here
+                # under-reported CurrSamplesPerSec by ~steps_per_output x)
+                window_samples = self.batch_size * max(self.window_steps, 1)
                 msg = (f"epoch={self.epoch_count}/micro_step={self.micro_step_count}/"
                        f"global_step={self.global_step_count}, "
                        f"RunningAvgSamplesPerSec={self.avg_samples_per_sec():.3f}, "
-                       f"CurrSamplesPerSec={self.batch_size / self.step_elapsed_time:.3f}")
+                       f"CurrSamplesPerSec={window_samples / self.step_elapsed_time:.3f}")
                 if self.flops_per_sample:
-                    tflops = self.flops_per_sample * self.batch_size / self.step_elapsed_time / 1e12
+                    tflops = self.flops_per_sample * window_samples / self.step_elapsed_time / 1e12
                     msg += f", TFLOPs={tflops:.2f}"
                 self.logging(msg)
                 self.step_elapsed_time = 0.0
+                self.window_steps = 0
 
     def avg_samples_per_sec(self) -> float:
         if self.global_step_count > self.start_step and self.total_elapsed_time > 0:

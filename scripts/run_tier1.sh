@@ -9,4 +9,7 @@ set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu pyt
 # `-m 'not slow'` selection above; a second pytest invocation here was
 # flaky under post-suite memory pressure, so guard on the files)
 grep -rqs "def test_" tests/unit/serving || { echo "tier-1: serving tests missing"; exit 1; }
+# likewise the observability suite (marker `observability`): the telemetry
+# registry/sink + engine/serving instrumentation tests ride `-m 'not slow'`
+grep -rqs "def test_" tests/unit/telemetry || { echo "tier-1: observability tests missing"; exit 1; }
 exit $rc

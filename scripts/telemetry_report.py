@@ -1,0 +1,166 @@
+#!/usr/bin/env python
+"""Render a telemetry JSONL run into a human summary.
+
+Usage:
+    python scripts/telemetry_report.py RUN.jsonl [--json]
+
+Input is the file produced by the telemetry subsystem (ISSUE 3): the
+engine's periodic registry snapshots (``telemetry.jsonl_path`` config key),
+the JSONL monitor backend's scalar stream (``jsonl_monitor`` section), and
+discrete events (checkpoint saves, corruption fallbacks, elastic
+restarts) — any mix of the three record kinds in one file.
+
+Sections:
+  counters    — final values from the newest snapshot
+  gauges      — final values (device step time, MFU, memory, occupancy...)
+  histograms  — count/mean/p50/p95/p99/max per latency histogram
+  scalars     — per-tag last/min/max/mean over the monitor scalar stream
+  events      — occurrence counts per event name
+
+``--json`` emits the aggregate as one JSON object instead of tables
+(machine-readable; the smoke test uses it). Stdlib only — runs anywhere.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from collections import OrderedDict
+
+
+def load_records(path):
+    """Tolerant JSONL reader (torn trailing lines from a crash are
+    skipped, matching telemetry.sink.read_jsonl)."""
+    out = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                continue
+            if isinstance(rec, dict):
+                out.append(rec)
+    return out
+
+
+def aggregate(records):
+    last_snapshot = None
+    scalars = OrderedDict()   # tag -> stats dict
+    events = OrderedDict()    # name -> {count, last_fields}
+    for rec in records:
+        kind = rec.get("kind")
+        if kind == "snapshot":
+            last_snapshot = rec
+        elif kind == "scalar":
+            tag = rec.get("tag", "?")
+            try:
+                v = float(rec.get("value"))
+            except (TypeError, ValueError):
+                continue
+            s = scalars.setdefault(tag, {
+                "count": 0, "sum": 0.0, "min": v, "max": v,
+                "last": v, "last_step": rec.get("step")})
+            s["count"] += 1
+            s["sum"] += v
+            s["min"] = min(s["min"], v)
+            s["max"] = max(s["max"], v)
+            s["last"] = v
+            s["last_step"] = rec.get("step")
+        elif kind == "event":
+            name = rec.get("name", "?")
+            e = events.setdefault(name, {"count": 0, "last": {}})
+            e["count"] += 1
+            e["last"] = {k: v for k, v in rec.items()
+                         if k not in ("kind", "name", "ts")}
+    for s in scalars.values():
+        s["mean"] = s["sum"] / s["count"] if s["count"] else 0.0
+    metrics = (last_snapshot or {}).get("metrics", {})
+    return {
+        "snapshot_step": (last_snapshot or {}).get("step"),
+        "counters": metrics.get("counters", {}),
+        "gauges": metrics.get("gauges", {}),
+        "histograms": metrics.get("histograms", {}),
+        "scalars": scalars,
+        "events": events,
+        "n_records": len(records),
+    }
+
+
+def _fmt(v):
+    if v is None:
+        return "-"
+    if isinstance(v, float):
+        if v == 0:
+            return "0"
+        if abs(v) >= 1e6 or abs(v) < 1e-3:
+            return f"{v:.3e}"
+        return f"{v:,.3f}".rstrip("0").rstrip(".")
+    return str(v)
+
+
+def _table(title, header, rows, out):
+    if not rows:
+        return
+    widths = [max(len(str(h)), *(len(str(r[i])) for r in rows))
+              for i, h in enumerate(header)]
+    out.append(f"\n== {title} ==")
+    out.append("  ".join(str(h).ljust(w) for h, w in zip(header, widths)))
+    out.append("  ".join("-" * w for w in widths))
+    for r in rows:
+        out.append("  ".join(str(c).ljust(w) for c, w in zip(r, widths)))
+
+
+def render(agg):
+    out = [f"telemetry report — {agg['n_records']} records"
+           + (f", last snapshot at step {agg['snapshot_step']}"
+              if agg["snapshot_step"] is not None else "")]
+    _table("counters", ("counter", "value"),
+           [(k, _fmt(v)) for k, v in sorted(agg["counters"].items())], out)
+    _table("gauges", ("gauge", "value"),
+           [(k, _fmt(v)) for k, v in sorted(agg["gauges"].items())], out)
+    hrows = []
+    for k, h in sorted(agg["histograms"].items()):
+        if not h.get("count"):
+            continue
+        hrows.append((k, h["count"], _fmt(h.get("mean")), _fmt(h.get("p50")),
+                      _fmt(h.get("p95")), _fmt(h.get("p99")),
+                      _fmt(h.get("max"))))
+    _table("histograms", ("histogram", "count", "mean", "p50", "p95", "p99",
+                          "max"), hrows, out)
+    srows = [(k, s["count"], _fmt(s["last"]), _fmt(s["min"]), _fmt(s["mean"]),
+              _fmt(s["max"]))
+             for k, s in agg["scalars"].items()]
+    _table("scalars", ("tag", "n", "last", "min", "mean", "max"), srows, out)
+    erows = [(k, e["count"],
+              json.dumps(e["last"], default=str)[:60])
+             for k, e in agg["events"].items()]
+    _table("events", ("event", "count", "last"), erows, out)
+    return "\n".join(out)
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("path", help="telemetry JSONL file")
+    p.add_argument("--json", action="store_true",
+                   help="emit the aggregate as JSON instead of tables")
+    args = p.parse_args(argv)
+    try:
+        records = load_records(args.path)
+    except OSError as e:
+        print(f"telemetry_report: cannot read {args.path}: {e}",
+              file=sys.stderr)
+        return 2
+    agg = aggregate(records)
+    if args.json:
+        print(json.dumps(agg, indent=2, default=str))
+    else:
+        print(render(agg))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

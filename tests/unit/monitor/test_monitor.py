@@ -1,0 +1,98 @@
+"""Monitor stack unit tests (ISSUE-3 satellite: the csv writer,
+MonitorMaster fan-out, rank-0 gating and the new JSONL fourth writer had
+no coverage)."""
+
+import os
+
+import pytest
+
+from deepspeed_tpu.monitor import monitor as monitor_mod
+from deepspeed_tpu.monitor.config import get_monitor_config
+from deepspeed_tpu.monitor.monitor import (JsonlMonitor, Monitor,
+                                           MonitorMaster, csvMonitor)
+from deepspeed_tpu.telemetry import read_jsonl
+
+pytestmark = [pytest.mark.observability, pytest.mark.quick]
+
+
+def _cfg(tmp_path, **sections):
+    base = {"csv_monitor": {"enabled": False},
+            "tensorboard": {"enabled": False},
+            "wandb": {"enabled": False},
+            "jsonl_monitor": {"enabled": False}}
+    for k, v in sections.items():
+        base[k] = dict(v, output_path=str(tmp_path), job_name="job")
+    return get_monitor_config(base)
+
+
+def test_csv_monitor_writes_per_tag_files(tmp_path):
+    cfg = _cfg(tmp_path, csv_monitor={"enabled": True})
+    mon = csvMonitor(cfg.csv_monitor)
+    assert mon.enabled
+    mon.write_events([("Train/Samples/loss", 2.0, 1),
+                      ("Train/Samples/lr", 0.1, 1)])
+    mon.write_events([("Train/Samples/loss", 1.0, 2)])
+    loss_csv = os.path.join(str(tmp_path), "job", "Train_Samples_loss.csv")
+    with open(loss_csv) as f:
+        assert f.read().splitlines() == ["step,value", "1,2.0", "2,1.0"]
+    assert os.path.exists(os.path.join(str(tmp_path), "job",
+                                       "Train_Samples_lr.csv"))
+
+
+def test_jsonl_monitor_records(tmp_path):
+    cfg = _cfg(tmp_path, jsonl_monitor={"enabled": True})
+    mon = JsonlMonitor(cfg.jsonl_monitor)
+    assert mon.enabled
+    mon.write_events([("Train/loss", 2.0, 1), ("Train/lr", 0.1, 1)])
+    recs = read_jsonl(os.path.join(str(tmp_path), "job.jsonl"))
+    assert [(r["tag"], r["value"], r["step"]) for r in recs] == \
+        [("Train/loss", 2.0, 1), ("Train/lr", 0.1, 1)]
+    assert all(r["kind"] == "scalar" and "ts" in r for r in recs)
+
+
+def test_master_fans_out_to_enabled_writers(tmp_path):
+    cfg = _cfg(tmp_path, csv_monitor={"enabled": True},
+               jsonl_monitor={"enabled": True})
+    master = MonitorMaster(cfg)
+    assert master.enabled
+    assert master.csv_monitor.enabled and master.jsonl_monitor.enabled
+    assert not master.tb_monitor.enabled or True  # tb optional dep
+
+    class Spy(Monitor):
+        def __init__(self):
+            self.enabled = True
+            self.seen = []
+
+        def write_events(self, events):
+            self.seen.extend(events)
+
+    spy = Spy()
+    master.csv_monitor = spy
+    master.write_events([("a", 1.0, 1)])
+    assert spy.seen == [("a", 1.0, 1)]
+    # the jsonl writer got the same event
+    assert read_jsonl(os.path.join(str(tmp_path), "job.jsonl"))[0]["tag"] \
+        == "a"
+
+
+def test_master_disabled_when_no_writer(tmp_path):
+    master = MonitorMaster(_cfg(tmp_path))
+    assert not master.enabled
+    master.write_events([("a", 1.0, 1)])      # no-op, no crash
+
+
+def test_rank0_gating(tmp_path, monkeypatch):
+    """Writers activate only on process rank 0, and the master drops
+    events on other ranks (reference rank-0-only behaviour)."""
+    monkeypatch.setattr(monitor_mod, "_rank", lambda: 1)
+    cfg = _cfg(tmp_path, csv_monitor={"enabled": True},
+               jsonl_monitor={"enabled": True})
+    master = MonitorMaster(cfg)
+    assert not master.enabled                  # nothing activated on rank 1
+    # even a force-enabled writer is gated at the master fan-out
+    master.csv_monitor.enabled = True
+    called = []
+    master.csv_monitor.write_events = lambda ev: called.append(ev)
+    master.write_events([("a", 1.0, 1)])
+    assert called == []
+    assert not os.path.exists(os.path.join(str(tmp_path), "job.jsonl"))

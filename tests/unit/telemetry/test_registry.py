@@ -1,0 +1,138 @@
+"""Metrics registry invariants: counter/gauge semantics, fixed-bucket
+histogram percentile accuracy, snapshot shape, JSONL sink round-trip,
+event plumbing. Pure host-side — no jax."""
+
+import json
+import random
+
+import numpy as np
+import pytest
+
+from deepspeed_tpu.telemetry import (
+    DEFAULT_LATENCY_BUCKETS_MS,
+    Histogram,
+    JsonlSink,
+    MetricsRegistry,
+    get_registry,
+    read_jsonl,
+    record_event,
+    reset_registry,
+)
+
+pytestmark = [pytest.mark.observability, pytest.mark.quick]
+
+
+def test_counter_and_gauge_basics():
+    reg = MetricsRegistry()
+    c = reg.counter("a")
+    c.inc()
+    c.inc(4)
+    assert reg.counter("a").value == 5          # get-or-create returns same
+    assert reg.counter("a") is c
+    g = reg.gauge("b")
+    assert g.value is None
+    g.set(2.5)
+    g.set(1.5)                                   # last-write-wins
+    assert reg.gauge("b").value == 1.5
+
+
+def test_histogram_exact_stats_and_bucket_bounds():
+    h = Histogram("h", buckets=(1.0, 10.0, 100.0))
+    for v in (0.5, 5.0, 50.0, 500.0):
+        h.observe(v)
+    assert h.count == 4
+    assert h.sum == pytest.approx(555.5)
+    assert h.min == 0.5 and h.max == 500.0
+    assert h.counts == [1, 1, 1, 1]              # one per bucket + overflow
+    # overflow bucket percentile reports the exact max
+    assert h.percentile(1.0) == 500.0
+    snap = h.snapshot()
+    assert snap["count"] == 4 and snap["mean"] == pytest.approx(555.5 / 4)
+
+
+def test_histogram_percentiles_match_direct_measurement():
+    """Default log-spaced buckets: p50/p95/p99 estimates agree with a
+    direct sort of the same samples to a few percent (the ISSUE-3
+    acceptance property bench.py re-checks on real serving latencies)."""
+    rng = random.Random(7)
+    h = Histogram("lat")
+    vals = [rng.lognormvariate(2.0, 1.0) for _ in range(8000)]
+    for v in vals:
+        h.observe(v)
+    for p in (0.50, 0.95, 0.99):
+        direct = float(np.percentile(vals, p * 100))
+        est = h.percentile(p)
+        assert est == pytest.approx(direct, rel=0.15), f"p{int(p*100)}"
+
+
+def test_histogram_empty_and_single():
+    h = Histogram("h")
+    assert h.percentile(0.5) is None
+    assert h.snapshot() == {"count": 0}
+    h.observe(3.0)
+    assert h.percentile(0.5) == pytest.approx(3.0, rel=0.3)
+    assert h.snapshot()["min"] == 3.0 == h.snapshot()["max"]
+
+
+def test_default_buckets_ascending_and_span():
+    b = DEFAULT_LATENCY_BUCKETS_MS
+    assert list(b) == sorted(b)
+    assert b[0] <= 0.05 and b[-1] >= 60_000     # 50us .. 1min span
+
+
+def test_registry_snapshot_shape():
+    reg = MetricsRegistry()
+    reg.counter("c").inc(2)
+    reg.gauge("g").set(7.0)
+    reg.histogram("h").observe(1.0)
+    reg.gauge("unset")                           # never set -> omitted
+    snap = reg.snapshot()
+    assert snap["counters"] == {"c": 2}
+    assert snap["gauges"] == {"g": 7.0}
+    assert snap["histograms"]["h"]["count"] == 1
+    assert "unset" not in snap["gauges"]
+
+
+def test_jsonl_sink_round_trip(tmp_path):
+    path = str(tmp_path / "t.jsonl")
+    sink = JsonlSink(path, flush_every=2)
+    reg = MetricsRegistry(sink=sink)
+    reg.event("x/saved", tag="t1")
+    reg.counter("x/saved").inc()                  # counted twice total? no:
+    # event() already counted once; the explicit inc makes 2
+    reg.histogram("lat").observe(4.2)
+    reg.flush(step=3)
+    sink.close()
+    recs = read_jsonl(path)
+    kinds = [r["kind"] for r in recs]
+    assert kinds == ["event", "snapshot"]
+    assert recs[0]["name"] == "x/saved" and recs[0]["tag"] == "t1"
+    assert "ts" in recs[0]
+    assert recs[1]["step"] == 3
+    assert recs[1]["metrics"]["counters"]["x/saved"] == 2
+    assert recs[1]["metrics"]["histograms"]["lat"]["count"] == 1
+
+
+def test_sink_scalar_shape(tmp_path):
+    path = str(tmp_path / "s.jsonl")
+    with JsonlSink(path) as sink:
+        sink.scalar("Train/loss", 0.5, 10)
+    [rec] = read_jsonl(path)
+    assert rec == {"kind": "scalar", "tag": "Train/loss", "value": 0.5,
+                   "step": 10, "ts": rec["ts"]}
+
+
+def test_read_jsonl_skips_torn_lines(tmp_path):
+    path = tmp_path / "torn.jsonl"
+    path.write_text(json.dumps({"kind": "event", "name": "a"}) +
+                    '\n{"kind": "ev')           # crash mid-write
+    assert [r["name"] for r in read_jsonl(str(path))] == ["a"]
+
+
+def test_global_registry_and_record_event():
+    reset_registry()
+    record_event("checkpoint/saves", tag="global_step5")
+    record_event("checkpoint/saves", tag="global_step6")
+    assert get_registry().counter("checkpoint/saves").value == 2
+    reset_registry()
+    assert get_registry().counter("checkpoint/saves").value == 0

@@ -1,0 +1,74 @@
+"""Smoke test: scripts/telemetry_report.py renders a generated JSONL
+fixture (ISSUE-3 CI satellite). The script is stdlib-only, so the
+subprocess run is fast (no jax import)."""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+pytestmark = [pytest.mark.observability, pytest.mark.quick]
+
+SCRIPT = os.path.join(os.path.dirname(__file__), "..", "..", "..",
+                      "scripts", "telemetry_report.py")
+
+
+@pytest.fixture
+def fixture_jsonl(tmp_path):
+    """A representative run: monitor scalars + events + two snapshots
+    (the report must use the NEWEST snapshot)."""
+    recs = [
+        {"kind": "scalar", "tag": "Train/Samples/train_loss",
+         "value": 2.5, "step": 1, "ts": 1.0},
+        {"kind": "scalar", "tag": "Train/Samples/train_loss",
+         "value": 1.5, "step": 2, "ts": 2.0},
+        {"kind": "event", "name": "checkpoint/saves",
+         "tag": "global_step2", "ts": 2.5},
+        {"kind": "snapshot", "step": 1, "ts": 1.1, "metrics": {
+            "counters": {"train/steps": 1}, "gauges": {},
+            "histograms": {}}},
+        {"kind": "snapshot", "step": 2, "ts": 2.6, "metrics": {
+            "counters": {"train/steps": 2, "checkpoint/saves": 1},
+            "gauges": {"train/mfu": 0.41,
+                       "device/mem_in_use_bytes": 123456.0},
+            "histograms": {"train/step_wall_ms": {
+                "count": 2, "sum": 20.0, "mean": 10.0, "min": 9.0,
+                "max": 11.0, "p50": 10.0, "p95": 11.0, "p99": 11.0}}}},
+    ]
+    path = tmp_path / "run.jsonl"
+    path.write_text("\n".join(json.dumps(r) for r in recs) + "\n")
+    return str(path)
+
+
+def test_report_renders_tables(fixture_jsonl):
+    p = subprocess.run([sys.executable, SCRIPT, fixture_jsonl],
+                       capture_output=True, text=True, timeout=60)
+    assert p.returncode == 0, p.stderr
+    out = p.stdout
+    assert "last snapshot at step 2" in out
+    for needle in ("train/steps", "train/mfu", "train/step_wall_ms",
+                   "Train/Samples/train_loss", "checkpoint/saves",
+                   "p95"):
+        assert needle in out, f"missing {needle!r} in report:\n{out}"
+
+
+def test_report_json_mode(fixture_jsonl):
+    p = subprocess.run([sys.executable, SCRIPT, fixture_jsonl, "--json"],
+                       capture_output=True, text=True, timeout=60)
+    assert p.returncode == 0, p.stderr
+    agg = json.loads(p.stdout)
+    assert agg["snapshot_step"] == 2
+    assert agg["counters"]["train/steps"] == 2        # newest snapshot wins
+    assert agg["gauges"]["train/mfu"] == 0.41
+    s = agg["scalars"]["Train/Samples/train_loss"]
+    assert s["count"] == 2 and s["last"] == 1.5 and s["min"] == 1.5
+    assert agg["events"]["checkpoint/saves"]["count"] == 1
+    assert agg["histograms"]["train/step_wall_ms"]["p95"] == 11.0
+
+
+def test_report_missing_file():
+    p = subprocess.run([sys.executable, SCRIPT, "/nonexistent/x.jsonl"],
+                       capture_output=True, text=True, timeout=60)
+    assert p.returncode == 2

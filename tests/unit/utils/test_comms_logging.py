@@ -1,0 +1,99 @@
+"""CommsLogger unit tests (ISSUE-3 satellite: append/bandwidth math and
+the summary renderer had no coverage), plus the module-level
+comm.log_summary() surface."""
+
+import pytest
+
+from deepspeed_tpu.utils.comms_logging import (CommsLogger, calc_bw_log,
+                                               convert_size)
+
+pytestmark = [pytest.mark.observability, pytest.mark.quick]
+
+
+# ------------------------------------------------------------ bandwidth math
+def test_calc_bw_log_all_reduce():
+    """all_reduce moves 2x the payload (reduce+broadcast halves):
+    algbw = 2*size/t, busbw = algbw * (n-1)/n."""
+    size, t, n = 1 << 20, 0.001, 4
+    algbw, busbw, reported = calc_bw_log("all_reduce", size, t, n)
+    assert algbw == pytest.approx(2 * size / t / 1e9)
+    assert busbw == pytest.approx((size / t) * (2 * (n - 1) / n) / 1e9)
+    assert reported == size
+
+
+def test_calc_bw_log_all_gather_scales_size_by_world():
+    size, t, n = 1 << 20, 0.002, 8
+    algbw, busbw, reported = calc_bw_log("all_gather", size, t, n)
+    assert reported == size * n
+    assert algbw == pytest.approx(size * n / t / 1e9)
+    assert busbw == pytest.approx(algbw * (n - 1) / n)
+
+
+def test_calc_bw_log_pt2pt_and_zero_duration():
+    algbw, busbw, _ = calc_bw_log("broadcast", 1000, 0.001, 2)
+    assert algbw == busbw == pytest.approx(1000 / 0.001 / 1e9)
+    # duration clamped: never a div-by-zero
+    algbw, _, _ = calc_bw_log("all_reduce", 1000, 0.0, 2)
+    assert algbw > 0
+
+
+def test_convert_size():
+    assert convert_size(0) == "0B"
+    assert convert_size(1023) == "1023.0 B"
+    assert convert_size(1024) == "1.0 KB"
+    assert convert_size(5 * 1024 ** 3) == "5.0 GB"
+
+
+# ------------------------------------------------------------------ logger
+def test_should_profile_gating():
+    lg = CommsLogger(enabled=False)
+    assert not lg.should_profile("all_reduce")
+    lg = CommsLogger(enabled=True, prof_all=True)
+    assert lg.should_profile("anything")
+    lg = CommsLogger(enabled=True, prof_all=False, prof_ops=["all_gather"])
+    assert lg.should_profile("all_gather")
+    assert not lg.should_profile("all_reduce")
+
+
+def test_append_accumulates_per_op_and_size():
+    lg = CommsLogger(enabled=True)
+    for _ in range(3):
+        lg.append("all_reduce", "all_reduce", 0.001, 1 << 20, world_size=4)
+    lg.append("all_reduce", "all_reduce", 0.002, 1 << 10, world_size=4)
+    sizes = lg.comms_dict["all_reduce"]
+    assert set(sizes) == {1 << 20, 1 << 10}
+    count, total, tputs, busbws = sizes[1 << 20]
+    assert count == 3
+    assert total == pytest.approx(0.003)
+    assert len(tputs) == len(busbws) == 3
+
+
+def test_record_traced_counts_without_latency():
+    lg = CommsLogger(enabled=True)
+    lg.record_traced("all_gather", "all_gather", 4096)
+    lg.record_traced("all_gather", "all_gather", 4096)
+    count, total, tputs, busbws = lg.comms_dict["all_gather"][4096]
+    assert count == 2 and total == 0.0 and tputs == [] and busbws == []
+
+
+def test_log_all_renders_summary():
+    lg = CommsLogger(enabled=True)
+    lg.append("all_reduce", "all_reduce", 0.001, 1 << 20, world_size=4)
+    lg.record_traced("all_gather", "all_gather", 2048)
+    out = lg.log_all(print_log=False)
+    assert "Comm. Op" in out and "Message Size" in out
+    assert "all_reduce" in out and "all_gather" in out
+    assert "1.0 MB" in out and "2.0 KB" in out
+
+
+def test_module_level_log_summary_calls_logger():
+    import deepspeed_tpu.comm as dist
+
+    dist.comms_logger.comms_dict.clear()
+    dist.comms_logger.append("all_reduce", "all_reduce", 0.001, 4096,
+                             world_size=2)
+    try:
+        out = dist.log_summary()
+        assert "all_reduce" in out
+    finally:
+        dist.comms_logger.comms_dict.clear()

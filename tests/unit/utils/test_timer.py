@@ -1,0 +1,139 @@
+"""SynchronizedWallClockTimer / ThroughputTimer unit tests (ISSUE-3
+satellite: no coverage existed), including the regression for
+CurrSamplesPerSec under-reporting — step_elapsed_time accumulates over
+steps_per_output steps but was divided by a single batch_size."""
+
+import pytest
+
+from deepspeed_tpu.utils import timer as timer_mod
+from deepspeed_tpu.utils.timer import SynchronizedWallClockTimer, ThroughputTimer
+
+pytestmark = [pytest.mark.observability, pytest.mark.quick]
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 0.0
+
+    def advance(self, dt):
+        self.t += dt
+
+    def __call__(self):
+        return self.t
+
+
+@pytest.fixture
+def clock(monkeypatch):
+    c = FakeClock()
+    monkeypatch.setattr(timer_mod.time, "perf_counter", c)
+    return c
+
+
+# --------------------------------------------------- SynchronizedWallClock
+def test_timer_start_stop_elapsed(clock):
+    timers = SynchronizedWallClockTimer()
+    t = timers("fwd")
+    t.start()
+    clock.advance(0.5)
+    t.stop()
+    assert t.elapsed(reset=False) == pytest.approx(0.5)
+    t.start()
+    clock.advance(0.25)
+    t.stop()
+    assert t.elapsed(reset=True) == pytest.approx(0.75)   # accumulates
+    assert t.elapsed() == 0.0                              # reset cleared it
+
+
+def test_timer_elapsed_while_running_keeps_timer_alive(clock):
+    t = SynchronizedWallClockTimer()("x")
+    t.start()
+    clock.advance(1.0)
+    assert t.elapsed(reset=False) == pytest.approx(1.0)
+    assert t.started                                       # restarted
+    clock.advance(1.0)
+    t.stop()
+    assert t.elapsed() == pytest.approx(2.0)
+
+
+def test_timer_double_start_asserts(clock):
+    t = SynchronizedWallClockTimer()("x")
+    t.start()
+    with pytest.raises(AssertionError):
+        t.start()
+    t.stop()
+    with pytest.raises(AssertionError):
+        t.stop()
+
+
+def test_timer_registry_and_sync_fn(clock):
+    synced = []
+    timers = SynchronizedWallClockTimer(sync_fn=lambda: synced.append(1))
+    timers("a").start()
+    clock.advance(0.1)
+    timers("a").stop(record=True)
+    assert timers.has("a") and not timers.has("b")
+    timers.log(["a", "b"])                                 # missing ok
+    assert synced == [1]                                   # fence ran
+    assert timers("a").mean() == pytest.approx(0.1)
+
+
+def test_timer_mean_of_records(clock):
+    t = SynchronizedWallClockTimer()("x")
+    for dt in (0.1, 0.3):
+        t.start()
+        clock.advance(dt)
+        t.stop(record=True)
+    assert t.mean() == pytest.approx(0.2)
+
+
+# --------------------------------------------------------- ThroughputTimer
+def _run_steps(tt, clock, n, step_s):
+    for _ in range(n):
+        tt.start()
+        clock.advance(step_s)
+        tt.stop(global_step=True)
+
+
+def test_curr_samples_per_sec_scales_by_window(clock):
+    """Regression (deepspeed_tpu/utils/timer.py CurrSamplesPerSec): 5
+    steps of 1s at batch 4 is 4 samples/sec — the old code reported
+    batch/window_elapsed = 0.8 (a steps_per_output-fold under-report)."""
+    msgs = []
+    tt = ThroughputTimer(batch_size=4, start_step=0, steps_per_output=5,
+                         logging_fn=msgs.append)
+    _run_steps(tt, clock, 5, 1.0)
+    assert len(msgs) == 1
+    curr = float(msgs[0].split("CurrSamplesPerSec=")[1].split(",")[0])
+    assert curr == pytest.approx(4.0)
+    # second window: rate doubles when steps get 2x faster
+    _run_steps(tt, clock, 5, 0.5)
+    curr2 = float(msgs[1].split("CurrSamplesPerSec=")[1].split(",")[0])
+    assert curr2 == pytest.approx(8.0)
+
+
+def test_curr_tflops_uses_window_samples(clock):
+    msgs = []
+    tt = ThroughputTimer(batch_size=2, start_step=0, steps_per_output=4,
+                         logging_fn=msgs.append)
+    tt.flops_per_sample = 1e12                 # 1 TFLOP per sample
+    _run_steps(tt, clock, 4, 1.0)
+    tflops = float(msgs[0].split("TFLOPs=")[1])
+    # 2 samples/step x 4 steps x 1 TFLOP / 4 s = 2 TFLOPs
+    assert tflops == pytest.approx(2.0)
+
+
+def test_avg_samples_per_sec_excludes_warmup(clock):
+    tt = ThroughputTimer(batch_size=4, start_step=2, steps_per_output=100)
+    _run_steps(tt, clock, 6, 1.0)              # steps 0,1 untimed
+    assert tt.avg_samples_per_sec() == pytest.approx(4.0)
+    assert tt.total_elapsed_time == pytest.approx(4.0)
+
+
+def test_window_resets_after_report(clock):
+    msgs = []
+    tt = ThroughputTimer(batch_size=1, start_step=0, steps_per_output=2,
+                         logging_fn=msgs.append)
+    _run_steps(tt, clock, 4, 1.0)
+    assert len(msgs) == 2
+    assert tt.window_steps == 0
+    assert tt.step_elapsed_time == 0.0
