@@ -617,6 +617,108 @@ class InferenceEngine:
             self._compiled[key] = jax.jit(decode, donate_argnums=donate)
         return self._compiled[key]
 
+    def slot_verify_program(self, num_slots: int, max_len: int, k: int, *,
+                            do_sample: bool = False, top_k: int = 0,
+                            top_p: float = 1.0, pad_token_id: int = 0):
+        """Jitted speculative-decoding verify step (ISSUE 4,
+        serving/speculative.py): score ``k`` drafted tokens per slot in
+        ONE target-model forward over the slot-paged cache and emit each
+        slot's accepted prefix plus one bonus/correction token.
+
+        The [B, k+1] block (last committed token + k drafts) runs through
+        the SAME ``forward_with_cache`` the decode step uses: per-slot
+        positions from the length vector (models/base.cache_positions),
+        per-slot-prefix + intra-block-causal masks in
+        ops/attention.decode_attention, and a vector-idx block scatter
+        writing all k+1 candidate K/V entries
+        (ops/attention.write_kv_cache). Rollback of rejected drafts is
+        free: the returned length vector advances only over the accepted
+        prefix, so rejected cache entries stay dead behind the mask and
+        the NEXT verify block overwrites them in place. One compiled
+        program per k-bucket — k comes from the engine's fixed bucket
+        set, so the jit cache stays pinned after warmup.
+
+        Signature: ``(params, k_slots, v_slots, lengths[B], tokens[B,k+1],
+        draft_len[B], active[B] bool, temp, rng) -> (k_slots, v_slots,
+        lengths, out_tokens[B,k+1], n_emit[B])``; row b emits
+        ``out_tokens[b, :n_emit[b]]`` (cache operands donated on TPU)."""
+        from deepspeed_tpu.serving.speculative import speculative_acceptance
+
+        key = ("slot_ver", num_slots, max_len, k, do_sample, top_k,
+               float(top_p), pad_token_id)
+        if key not in self._compiled:
+            model = self.module
+
+            def verify(params, k_slots, v_slots, lengths, tokens,
+                       draft_len, active, temp, rng):
+                cache = {"k": k_slots, "v": v_slots, "index": lengths}
+                logits, cache = model.forward_with_cache(
+                    params, tokens, cache)
+                out_tokens, n_emit = speculative_acceptance(
+                    logits, tokens, draft_len, temp, rng,
+                    do_sample=do_sample, top_k=top_k, top_p=float(top_p),
+                    pad_token_id=pad_token_id)
+                n_emit = jnp.where(active, n_emit, 0)
+                out_tokens = jnp.where(active[:, None], out_tokens,
+                                       pad_token_id)
+                lengths = lengths + n_emit      # n_emit is 0 when inactive
+                return (cache["k"], cache["v"], lengths, out_tokens,
+                        n_emit)
+
+            donate = (1, 2, 3) if jax.default_backend() == "tpu" else ()
+            self._compiled[key] = jax.jit(verify, donate_argnums=donate)
+        return self._compiled[key]
+
+    def slot_draft_program(self, window_len: int, num_slots: int, k: int):
+        """Jitted greedy drafting for the DRAFT model of a speculative-
+        decoding pair (serving/speculative.DraftModelDrafter): re-prefill
+        each slot's trailing ``window_len`` history tokens into a fresh
+        in-program cache, then roll ``k`` greedy tokens forward —
+        returning [B, k] draft proposals in one compiled program.
+
+        Stateless by design: the draft cache lives and dies inside the
+        program, so there is no persistent draft KV to roll back when the
+        target rejects, and the program's shapes never vary (one program
+        per (window, k) pair, both from fixed bucket sets). Right-padded
+        windows with a per-slot true length reuse the slot machinery:
+        positions/masks come from the per-slot index vector, and each
+        decode write lands at ``wlen + j``, overwriting window padding
+        before the mask ever exposes it.
+
+        Signature: ``(params, window[B, window_len] int32, wlen[B] int32
+        >= 1) -> drafts[B, k] int32`` (greedy argmax; point-mass
+        proposals stay lossless under both acceptance modes)."""
+        key = ("slot_draft", window_len, num_slots, k)
+        if key not in self._compiled:
+            model = self.module
+            cache_len = window_len + k
+
+            def draft(params, window, wlen):
+                cache = model.init_cache(num_slots, cache_len,
+                                         dtype=self.dtype)
+                zeros = jnp.zeros((num_slots,), jnp.int32)
+                logits, cache = model.forward_with_cache(
+                    params, window, {"k": cache["k"], "v": cache["v"],
+                                     "index": zeros})
+                # first draft from each row's TRUE last window position
+                tok = jnp.argmax(jnp.take_along_axis(
+                    logits, (wlen - 1)[:, None, None], axis=1
+                )[:, 0].astype(jnp.float32), axis=-1).astype(jnp.int32)
+                out = [tok]
+                idx = wlen
+                for _ in range(k - 1):
+                    logits, cache = model.forward_with_cache(
+                        params, tok[:, None],
+                        {"k": cache["k"], "v": cache["v"], "index": idx})
+                    tok = jnp.argmax(logits[:, -1].astype(jnp.float32),
+                                     axis=-1).astype(jnp.int32)
+                    out.append(tok)
+                    idx = idx + 1
+                return jnp.stack(out, axis=1)
+
+            self._compiled[key] = jax.jit(draft)
+        return self._compiled[key]
+
     # ------------------------------------------------------------- utilities
     def compiled_programs(self, batch: int, prompt_len: int, max_new: int,
                           *, do_sample: bool = False, top_k: int = 0,

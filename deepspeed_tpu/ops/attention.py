@@ -166,18 +166,24 @@ def write_kv_cache(k_full, v_full, k_new, v_new, layer, idx):
     (k_full, v_full, k_layer, v_layer) with the per-layer [B, Hkv, S, Dh]
     views ready for :func:`decode_attention`.
 
-    A per-slot ``[B]`` idx vector (continuous batching, T must be 1)
-    scatters each row's token at its own position instead of one shared
-    slice start."""
+    A per-slot ``[B]`` idx vector (continuous batching) scatters each
+    row's block at its own position instead of one shared slice start:
+    row b's token j lands at cache position ``idx[b] + j``. T > 1 is the
+    speculative-decoding verify path (serving/speculative.py) — all
+    ``k + 1`` candidate tokens' K/V are written in one pass, and entries
+    past the accepted prefix stay dead behind the per-slot length vector
+    (rollback-by-masking, no copies). ``mode="drop"`` makes any position
+    past the allocation a silent no-op instead of undefined behavior
+    (inactive slots carry stale lengths; their masked garbage writes must
+    never land out of bounds)."""
     if jnp.ndim(idx) == 1:
-        assert k_new.shape[1] == 1, \
-            "per-slot cache writes are single-token (decode) only"
-        b = k_new.shape[0]
-        rows = jnp.arange(b)
-        k_full = k_full.at[layer, rows, :, idx, :].set(
-            k_new[:, 0].astype(k_full.dtype))
-        v_full = v_full.at[layer, rows, :, idx, :].set(
-            v_new[:, 0].astype(v_full.dtype))
+        b, t = k_new.shape[0], k_new.shape[1]
+        rows = jnp.broadcast_to(jnp.arange(b)[:, None], (b, t))
+        pos = idx[:, None] + jnp.arange(t)[None, :]              # [B, T]
+        k_full = k_full.at[layer, rows, :, pos, :].set(
+            k_new.astype(k_full.dtype), mode="drop")
+        v_full = v_full.at[layer, rows, :, pos, :].set(
+            v_new.astype(v_full.dtype), mode="drop")
     else:
         k_full = jax.lax.dynamic_update_slice(
             k_full, k_new.transpose(0, 2, 1, 3)[None].astype(k_full.dtype),

@@ -10,7 +10,11 @@ from deepspeed_tpu.serving.engine import ServingEngine
 from deepspeed_tpu.serving.kv_slots import SlotKVCache
 from deepspeed_tpu.serving.scheduler import (Request, RequestResult,
                                              SlotScheduler, pick_bucket,
-                                             poisson_trace)
+                                             poisson_trace,
+                                             templated_trace)
+from deepspeed_tpu.serving.speculative import (SpeculativeConfig,
+                                               ngram_propose)
 
 __all__ = ["ServingEngine", "SlotKVCache", "SlotScheduler", "Request",
-           "RequestResult", "pick_bucket", "poisson_trace"]
+           "RequestResult", "SpeculativeConfig", "ngram_propose",
+           "pick_bucket", "poisson_trace", "templated_trace"]

@@ -38,11 +38,23 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 from deepspeed_tpu.serving.kv_slots import SlotKVCache
 from deepspeed_tpu.serving.scheduler import (Request, RequestResult,
                                              SlotScheduler, pick_bucket)
+from deepspeed_tpu.serving.speculative import (AdaptiveK, DraftModelDrafter,
+                                               NgramDrafter,
+                                               normalize_speculative,
+                                               pick_k_bucket)
 from deepspeed_tpu.utils.logging import log_dist
+
+# accepted-tokens-per-step / tokens-per-decode-call histograms count small
+# integers (1 .. k+1), not latencies — unit-wide buckets keep the
+# interpolated percentiles exact for the range any sane k reaches
+_TOKENS_PER_STEP_BUCKETS = tuple(float(x) for x in range(1, 34))
 
 
 class _SlotState:
-    """Host-side state of one occupied slot."""
+    """Host-side state of one occupied slot. The speculative drafters'
+    token-history view is DERIVED (request.prompt + result.tokens), not
+    stored — a second copy could silently desynchronize from the
+    emitted stream."""
 
     __slots__ = ("request", "result", "last_token")
 
@@ -77,6 +89,18 @@ class ServingEngine:
         finished-requests/sec — ISSUE 3); pass a MetricsRegistry to use a
         private one, or False/None to run bare (the bench.py
         ``observability_overhead`` baseline).
+    speculative: speculative decoding (ISSUE 4): None/"off" (default),
+        a mode string ("ngram" | "draft"), a dict of
+        :class:`~deepspeed_tpu.serving.speculative.SpeculativeConfig`
+        fields, or a config instance. When on, every decode iteration
+        drafts up to k tokens per slot (prompt-lookup or draft model),
+        verifies them ALL in one target forward, and emits each slot's
+        accepted prefix + one bonus token — losslessly (greedy output is
+        bit-identical to the plain decode path; sampling is
+        distribution-exact). Verify programs are bucketed by k exactly
+        like prefill is by length, so the zero-recompile guarantee
+        holds; slot capacity reserves ``k_max`` lookahead rows for the
+        pre-acceptance draft writes.
     """
 
     def __init__(self, engine, *, num_slots: int = 8, max_len: int = 1024,
@@ -85,7 +109,7 @@ class ServingEngine:
                  do_sample: bool = False, temperature: float = 1.0,
                  top_k: int = 0, top_p: float = 1.0,
                  time_fn: Optional[Callable[[], float]] = None,
-                 telemetry=True):
+                 telemetry=True, speculative=None):
         self.engine = engine
         model = engine.module
         mcfg = getattr(model, "config", None)
@@ -146,11 +170,42 @@ class ServingEngine:
         self._decode = engine.slot_decode_program(
             num_slots, max_len, pad_token_id=pad_token_id,
             **self._sample_kw)
+        # ---- speculative decoding (ISSUE 4)
+        self.spec = normalize_speculative(speculative)
+        self._verify: Dict[int, Callable] = {}     # k-bucket -> verify fn
+        self._drafter = None
+        self._adaptive = None
+        self._lookahead = 0
+        if self.spec is not None:
+            # the verify step writes all k draft candidates' K/V BEFORE
+            # acceptance — reserve the lookahead rows at admission
+            self._lookahead = self.spec.k_max
+            if max_len <= self._lookahead:
+                raise ValueError(
+                    f"speculative k_max {self._lookahead} leaves no slot "
+                    f"capacity at max_len {max_len}")
+            if self.spec.mode == "draft":
+                self._drafter = DraftModelDrafter(
+                    self.spec, num_slots, pad_token_id=pad_token_id)
+            else:
+                self._drafter = NgramDrafter(self.spec)
+            if self.spec.adaptive:
+                self._adaptive = AdaptiveK(self.spec, num_slots)
         # metrics
         self.decode_steps = 0
         self.prefill_calls = 0
         self.tokens_generated = 0
         self._active_slot_iterations = 0
+        # speculative accounting (spec mode only; bench + telemetry)
+        self.spec_drafted_tokens = 0
+        self.spec_accepted_tokens = 0
+        self._draft_wall = 0.0
+        self._verify_wall = 0.0
+        # decode-phase wall clock (plain decode + draft + verify calls,
+        # host-observed): the denominator of the bench's decode
+        # tokens/sec — run() wall would dilute the decode hot path with
+        # prefill and idle time
+        self.decode_wall = 0.0
         if telemetry is True:
             from deepspeed_tpu.telemetry import get_registry
 
@@ -167,27 +222,51 @@ class ServingEngine:
                 bucket, self.num_slots, self.max_len, **self._sample_kw)
         return self._prefill[bucket]
 
+    def _verify_fn(self, kb: int):
+        """Speculative verify program for draft-width bucket ``kb`` —
+        one compiled program per bucket in the FIXED k_buckets set, so
+        adaptive-k transitions never compile (the spec analog of the
+        prefill length buckets)."""
+        if kb not in self._verify:
+            self._verify[kb] = self.engine.slot_verify_program(
+                self.num_slots, self.max_len, kb,
+                pad_token_id=self.pad_token_id, **self._sample_kw)
+        return self._verify[kb]
+
     @property
     def program_count(self) -> int:
         """Compiled serving programs built so far (== len(buckets) + 1
-        after warmup; the no-recompile tests pin this)."""
-        return len(self._prefill) + 1
+        after warmup without speculation — the no-recompile tests pin
+        this; speculation adds one verify program per k-bucket plus the
+        draft-model programs)."""
+        n = len(self._prefill) + 1 + len(self._verify)
+        if self._drafter is not None:
+            n += len(self._drafter.program_cache_sizes())
+        return n
 
     def program_cache_sizes(self) -> Dict[str, int]:
         """jit-cache entry count per serving program — every value must
         be 1 after any trace ("zero XLA recompiles after warmup"):
-        a second entry would mean some argument's shape/dtype varied."""
+        a second entry would mean some argument's shape/dtype varied.
+        Covers the speculative verify programs (one per k-bucket) and
+        the draft-model programs when speculation is on."""
         out = {"decode": self._decode._cache_size()}
         for b, fn in self._prefill.items():
             out[f"prefill_{b}"] = fn._cache_size()
+        for kb, fn in self._verify.items():
+            out[f"verify_{kb}"] = fn._cache_size()
+        if self._drafter is not None:
+            out.update(self._drafter.program_cache_sizes())
         return out
 
     def warmup(self) -> None:
         """Compile every serving program (each bucket's prefill + the
-        decode step) on dummy data, then reset the slot lengths. Two
+        decode step + with speculation each k-bucket's verify and draft
+        programs) on dummy data, then reset the slot lengths. Two
         passes, so both carry signatures — canonical (post-reset) and
         program-output — are cached for every program; after this, a
-        trace of ANY shape mix runs zero compiles."""
+        trace of ANY shape mix (including adaptive-k transitions) runs
+        zero compiles."""
         if self._warm:
             return
         eng = self.engine
@@ -204,6 +283,21 @@ class ServingEngine:
                                jnp.asarray(toks), jnp.asarray(active),
                                self._temp, self._zero_key)
             self.cache.update(*out[:3])
+            if self.spec is not None:
+                zeros = jnp.zeros((self.num_slots,), jnp.int32)
+                for kb in self.spec.k_buckets:
+                    blk = jnp.zeros((self.num_slots, kb + 1), jnp.int32)
+                    out = self._verify_fn(kb)(
+                        eng.params, *self.cache.carry(), blk, zeros,
+                        jnp.asarray(active), self._temp, self._zero_key)
+                    self.cache.update(*out[:3])
+                    if isinstance(self._drafter, DraftModelDrafter):
+                        window = jnp.zeros(
+                            (self.num_slots, self._drafter.window),
+                            jnp.int32)
+                        self._drafter._program(kb)(
+                            self._drafter.engine.params, window,
+                            jnp.ones((self.num_slots,), jnp.int32))
             self.cache.lengths = self._canon(
                 jnp.zeros((self.num_slots,), jnp.int32))
         self._warm = True
@@ -220,11 +314,15 @@ class ServingEngine:
             raise ValueError(
                 f"request {request.rid}: prompt length {plen} exceeds the "
                 f"largest prefill bucket {self.buckets[-1]}")
-        if not self.cache.capacity_for(plen, request.max_new_tokens):
+        if not self.cache.capacity_for(plen, request.max_new_tokens,
+                                       self._lookahead):
+            extra = (f" (speculation reserves {self._lookahead} lookahead "
+                     f"rows for pre-acceptance draft writes)"
+                     if self._lookahead else "")
             raise ValueError(
                 f"request {request.rid}: prompt {plen} + max_new "
                 f"{request.max_new_tokens} exceeds slot capacity "
-                f"{self.max_len}")
+                f"{self.max_len}{extra}")
         self.scheduler.submit(request)
 
     @property
@@ -260,12 +358,19 @@ class ServingEngine:
             reg = self.telemetry
             reg.counter("serving/finished_requests").inc()
             reg.histogram("serving/latency_ms").observe(res.latency * 1e3)
-            n_dec = len(res.tokens) - 1  # tokens after the prefill token
+            # Orca-style iteration accounting over the decode phase only
+            # (TTFT covers the prefill). Divide by ACTUAL decode
+            # invocations, not len(tokens) - 1: a speculative verify step
+            # emits up to k+1 tokens per invocation, so the token count
+            # would overstate the step count and understate TPOT.
+            n_dec = res.decode_calls
             if n_dec > 0:
-                # Orca-style iteration accounting: time-per-output-token
-                # over the decode phase only (TTFT covers the prefill)
                 reg.histogram("serving/tpot_ms").observe(
                     (res.finish_time - res.first_token_time) / n_dec * 1e3)
+                reg.histogram(
+                    "serving/tokens_per_decode_call",
+                    buckets=_TOKENS_PER_STEP_BUCKETS).observe(
+                    (len(res.tokens) - 1) / n_dec)
         return st.result
 
     def _maybe_finish(self, slot: int, now: float) -> Optional[RequestResult]:
@@ -309,6 +414,8 @@ class ServingEngine:
                 reg.histogram("serving/ttft_ms").observe(
                     max(res.first_token_time - req.arrival_time, 0.0) * 1e3)
             self._slots[slot] = _SlotState(req, res, tok)
+            if self._adaptive is not None:
+                self._adaptive.reset_slot(slot)
             done = self._maybe_finish(slot, now)
             if done is not None:
                 finished.append(done)
@@ -336,17 +443,28 @@ class ServingEngine:
                 self.telemetry.gauge("serving/batch_fill_ratio").set(occ)
         if not active_slots:
             return finished
+        if self.spec is not None:
+            return self._spec_step(now, active_slots, finished)
+        return self._plain_step(now, active_slots, finished)
+
+    def _plain_step(self, now: float, active_slots: List[int],
+                    finished: List[RequestResult]) -> List[RequestResult]:
+        """One plain decode iteration: one token for every active slot.
+        Also the speculative path's fallback when drafting proposes
+        nothing anywhere (a 1-wide step beats an empty k-wide verify)."""
         toks = np.full((self.num_slots,), self.pad_token_id, np.int32)
         for i in active_slots:
             toks[i] = self._slots[i].last_token
         active = np.zeros((self.num_slots,), bool)
         active[active_slots] = True
+        t0 = time.perf_counter()
         with jax.profiler.TraceAnnotation("dstpu/serving_decode"):
             out = self._decode(self.engine.params, *self.cache.carry(),
                                jnp.asarray(toks), jnp.asarray(active),
                                self._temp, self._next_rng())
             self.cache.update(*out[:3])
             nxt = np.asarray(jax.device_get(out[3]))
+        self.decode_wall += time.perf_counter() - t0
         self.decode_steps += 1
         self._active_slot_iterations += len(active_slots)
         if self.telemetry is not None:
@@ -357,8 +475,115 @@ class ServingEngine:
             st = self._slots[i]
             tok = int(nxt[i])
             st.result.tokens.append(tok)
+            st.result.decode_calls += 1
             st.last_token = tok
             self.tokens_generated += 1
+            done = self._maybe_finish(i, now)
+            if done is not None:
+                finished.append(done)
+        return finished
+
+    def _spec_step(self, now: float, active_slots: List[int],
+                   finished: List[RequestResult]) -> List[RequestResult]:
+        """One speculative decode iteration: draft up to k tokens per
+        slot, verify them ALL in one target forward, emit each slot's
+        accepted prefix + one bonus/correction token.
+
+        Per-step variable emission: a slot commits between 1 and
+        ``draft_len + 1`` tokens per invocation (never 0 — the
+        correction token guarantees baseline-speed progress even at zero
+        acceptance). The verify width is bucketed over the FIXED
+        k_buckets set — the smallest bucket holding the longest draft
+        actually PROPOSED this step — so adaptive-k transitions reuse
+        compiled programs, and a step where drafting found nothing at
+        all falls back to the (also warmed) 1-wide plain decode program
+        instead of paying an empty k-wide verify. Per-slot draft length
+        is additionally capped at ``remaining_budget - 1``: emission can
+        then never overshoot max_new_tokens, so output truncation
+        happens only at EOS (where the slot retires and its dead cache
+        tail is reclaimed by the next prefill anyway)."""
+        spec = self.spec
+        nslots = self.num_slots
+        want = np.zeros((nslots,), np.int32)
+        for i in active_slots:
+            st = self._slots[i]
+            remaining = st.request.max_new_tokens - len(st.result.tokens)
+            k_des = (self._adaptive.desired_k(i)
+                     if self._adaptive is not None else spec.k_max)
+            want[i] = max(0, min(k_des, remaining - 1))
+        kb = pick_k_bucket(max(int(want.max()), 1), spec.k_buckets)
+        # drafters read each slot's full token stream (prompt + emitted,
+        # derived — result.tokens IS the emitted history)
+        histories = [list(s.request.prompt) + s.result.tokens
+                     if s is not None else None for s in self._slots]
+        t0 = time.perf_counter()
+        with jax.profiler.TraceAnnotation("dstpu/serving_draft"):
+            drafts, lens = self._drafter.propose(histories, want, kb)
+        lens = np.minimum(np.asarray(lens, np.int32), want)
+        dt = time.perf_counter() - t0
+        self._draft_wall += dt
+        self.decode_wall += dt
+        longest = int(lens.max())
+        if longest == 0:
+            # nothing proposed anywhere (e.g. prompt-lookup on novel
+            # text): the plain decode step emits the identical token at
+            # 1-token width
+            return self._plain_step(now, active_slots, finished)
+        # shrink the verify width to the drafts we actually have (a
+        # partial match needs a narrower program than the full want)
+        kb = pick_k_bucket(longest, spec.k_buckets)
+        tokens = np.full((nslots, kb + 1), self.pad_token_id, np.int32)
+        active = np.zeros((nslots,), bool)
+        for i in active_slots:
+            tokens[i, 0] = self._slots[i].last_token
+            n = int(lens[i])
+            tokens[i, 1:1 + n] = drafts[i, :n]
+            active[i] = True
+        t0 = time.perf_counter()
+        with jax.profiler.TraceAnnotation("dstpu/serving_verify"):
+            out = self._verify_fn(kb)(
+                self.engine.params, *self.cache.carry(),
+                jnp.asarray(tokens), jnp.asarray(lens),
+                jnp.asarray(active), self._temp, self._next_rng())
+            self.cache.update(*out[:3])
+            out_tokens = np.asarray(jax.device_get(out[3]))
+            n_emit = np.asarray(jax.device_get(out[4]))
+        dt = time.perf_counter() - t0
+        self._verify_wall += dt
+        self.decode_wall += dt
+        self.decode_steps += 1
+        self._active_slot_iterations += len(active_slots)
+        reg = self.telemetry
+        if reg is not None:
+            reg.counter("serving/decode_steps").inc()
+            reg.counter("serving/spec_verify_steps").inc()
+            reg.counter("serving/slot_iterations_active").inc(
+                len(active_slots))
+        for i in active_slots:
+            st = self._slots[i]
+            n = int(n_emit[i])
+            emitted = [int(t) for t in out_tokens[i, :n]]
+            n_drafted, n_accepted = int(lens[i]), n - 1
+            if (self.eos_token_id is not None
+                    and self.eos_token_id in emitted):
+                # EOS inside the accepted block: baseline decode stops
+                # at its first EOS, so every token behind it is dropped
+                # (the slot retires; its dead cache tail is overwritten
+                # by the next prefill into the slot)
+                emitted = emitted[:emitted.index(self.eos_token_id) + 1]
+            st.result.tokens.extend(emitted)
+            st.result.decode_calls += 1
+            st.last_token = emitted[-1]
+            self.tokens_generated += len(emitted)
+            self.spec_drafted_tokens += n_drafted
+            self.spec_accepted_tokens += n_accepted
+            if self._adaptive is not None:
+                self._adaptive.update(i, n_accepted, n_drafted)
+            if reg is not None:
+                reg.counter("serving/spec_drafted_tokens").inc(n_drafted)
+                reg.counter("serving/spec_accepted_tokens").inc(n_accepted)
+                reg.histogram("serving/accepted_tokens_per_step",
+                              buckets=_TOKENS_PER_STEP_BUCKETS).observe(n)
             done = self._maybe_finish(i, now)
             if done is not None:
                 finished.append(done)
@@ -429,4 +654,21 @@ class ServingEngine:
             reg.gauge("serving/mean_batch_fill_ratio").set(
                 self._active_slot_iterations /
                 (self.decode_steps * self.num_slots))
+        if self.spec is not None:
+            if self.spec_drafted_tokens:
+                reg.gauge("serving/spec_acceptance_rate").set(
+                    self.spec_accepted_tokens / self.spec_drafted_tokens)
+            if self._active_slot_iterations:
+                # decode-phase tokens per slot-step: 1.0 = baseline, the
+                # spec speedup headroom is this number (verify cost aside)
+                reg.gauge("serving/spec_tokens_per_slot_step").set(
+                    (self.tokens_generated - self.prefill_calls)
+                    / self._active_slot_iterations)
+            wall = self._draft_wall + self._verify_wall
+            if wall > 0:
+                # drafting's share of the decode hot path (host wall):
+                # n-gram drafting should be noise, a draft MODEL should
+                # stay well under the verify forward
+                reg.gauge("serving/spec_draft_overhead_frac").set(
+                    self._draft_wall / wall)
         reg.flush()

@@ -63,12 +63,21 @@ class SlotKVCache:
         self.k, self.v, self.lengths = k, v, lengths
 
     # ------------------------------------------------------------ sizing
-    def capacity_for(self, prompt_len: int, max_new_tokens: int) -> bool:
+    def capacity_for(self, prompt_len: int, max_new_tokens: int,
+                     lookahead: int = 0) -> bool:
         """Whether one slot can hold the request end to end (prompt plus
         every generated token; the decode step writes token i at row
         prompt_len + i, so the last write lands at row
-        prompt_len + max_new_tokens - 1)."""
-        return prompt_len + max_new_tokens <= self.max_len
+        prompt_len + max_new_tokens - 1).
+
+        ``lookahead`` reserves extra rows for speculative decoding
+        (ISSUE 4): the verify step writes ALL k draft candidates' K/V
+        BEFORE acceptance, so the worst-case final verify (length at
+        prompt_len + max_new_tokens - 1, k-token draft) touches row
+        prompt_len + max_new_tokens - 1 + k. Without the reserve a
+        near-full slot would overflow max_len (pinned by the boundary
+        test in tests/unit/serving/test_kv_slots.py)."""
+        return prompt_len + max_new_tokens + lookahead <= self.max_len
 
     def hbm_bytes(self) -> int:
         return int(self.k.size * self.k.dtype.itemsize

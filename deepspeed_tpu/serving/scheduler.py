@@ -44,6 +44,11 @@ class RequestResult:
     first_token_time: float = 0.0
     finish_time: float = 0.0
     finish_reason: str = ""  # "eos" | "length"
+    # decode-phase model invocations that included this request (0 for a
+    # request finished at prefill). One invocation emits ONE token in
+    # plain decode but up to k+1 under speculative decoding — TPOT and
+    # tokens-per-step accounting divide by THIS, never len(tokens)-1.
+    decode_calls: int = 0
 
     @property
     def latency(self) -> float:
@@ -144,5 +149,32 @@ def poisson_trace(rng, n_requests: int, *, rate: float,
             prompt=rng.randint(0, vocab_size, size=plen).astype("int32")
                       .tolist(),
             max_new_tokens=int(rng.choice(list(max_new_choices))),
+            arrival_time=t))
+    return reqs
+
+
+def templated_trace(rng, n_requests: int, *, rate: float,
+                    pattern_len: int, repeats: int,
+                    max_new_tokens: int, vocab_size: int,
+                    n_templates: int = 4,
+                    start_rid: int = 0) -> List[Request]:
+    """Synthetic HIGH-ACCEPTANCE trace for speculative decoding (the
+    ISSUE-4 bench workload): each prompt is a short random template
+    n-gram repeated ``repeats`` times — the repetitive/templated traffic
+    shape (form letters, code stubs, retrieval-stuffed prompts) where
+    prompt-lookup drafting finds its continuations in the prompt itself
+    and greedy decode tends to keep walking the loop. Poisson arrivals
+    like :func:`poisson_trace`; a handful of shared templates (drawn per
+    request) mimics a templated API's request mix."""
+    patterns = [rng.randint(0, vocab_size, size=pattern_len).tolist()
+                for _ in range(max(n_templates, 1))]
+    reqs: List[Request] = []
+    t = 0.0
+    for i in range(n_requests):
+        t += float(rng.exponential(1.0 / rate)) if rate > 0 else 0.0
+        reqs.append(Request(
+            rid=start_rid + i,
+            prompt=patterns[int(rng.randint(len(patterns)))] * repeats,
+            max_new_tokens=max_new_tokens,
             arrival_time=t))
     return reqs

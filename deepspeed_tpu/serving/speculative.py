@@ -1,0 +1,372 @@
+"""Speculative decoding for the continuous-batching serving runtime
+(ISSUE 4).
+
+Decode steps emit one token per model invocation, so serving throughput
+is bound by sequential decode latency (HBM-bandwidth-limited on TPU,
+dispatch-limited on small models). Speculative decoding (Leviathan et
+al., "Fast Inference from Transformers via Speculative Decoding") drafts
+``k`` cheap candidate tokens, then scores all of them in ONE target-model
+forward and keeps the longest accepted prefix plus one bonus token from
+the target's own distribution — losslessly: the emitted stream is
+token-identical to baseline decode under greedy, and distribution-exact
+under sampling.
+
+Pieces (the ServingEngine in serving/engine.py drives them):
+
+  * **Drafting backends** —
+    :class:`NgramDrafter`: draft-model-free prompt-lookup drafting. The
+    slot's own token history (prompt + generated) is searched for the
+    most recent earlier occurrence of its current suffix n-gram; the
+    tokens that followed that occurrence are proposed as the
+    continuation. Pure numpy, deterministic, zero extra FLOPs — it wins
+    exactly when generation revisits its own context (templated/
+    repetitive traffic, summarization, code).
+    :class:`DraftModelDrafter`: a small draft model served through its
+    own :class:`~deepspeed_tpu.inference.engine.InferenceEngine`. Drafts
+    are generated greedily from a fixed trailing window of the slot's
+    history re-prefilled each round (stateless — no persistent draft KV
+    to roll back, at the cost of a window-length prefill per round; with
+    a draft model orders of magnitude smaller than the target this is
+    the verify FLOPs' rounding error, and the fixed window keeps the
+    draft program's shapes static → zero recompiles).
+
+  * **Acceptance** — :func:`speculative_acceptance`, the in-jit
+    acceptance rule applied to the verify forward's logits. Both
+    backends propose *deterministic* (point-mass) drafts, so the
+    rejection-sampling rule collapses to: accept draft ``x_i`` with
+    probability ``p_target(x_i)`` and on first rejection resample from
+    the renormalized leftover ``p`` with ``x_i`` removed — exactly the
+    Leviathan rule with ``q = delta(x_i)``, hence lossless for ANY draft
+    choice. Greedy mode accepts while the draft matches the target
+    argmax and emits the target's own argmax at the first mismatch, so
+    the output is bit-identical to baseline greedy decode.
+
+  * **KV rollback** — none needed, by construction: the verify forward
+    writes all ``k + 1`` candidate positions' K/V into the slot-paged
+    cache (ops/attention.write_kv_cache vector-idx block scatter), and
+    the per-slot length vector advances only over the accepted prefix.
+    Rejected entries stay DEAD behind the length mask and are
+    overwritten in place by the next verify block, which starts exactly
+    where the accepted prefix ended. Zero copies, zero extra programs.
+
+  * **Adaptive k** — :class:`AdaptiveK`, a per-slot EMA of the
+    acceptance fraction mapped onto the engine's FIXED ``k_buckets``
+    set. Shrinking k when acceptance drops bounds wasted verify width;
+    drawing k from a fixed bucket set (never free-varying) is what keeps
+    the verify-program jit cache pinned after warmup.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deepspeed_tpu.inference.engine import filter_logits
+
+
+# --------------------------------------------------------------- config
+@dataclasses.dataclass
+class SpeculativeConfig:
+    """Speculative-decoding knobs for :class:`~deepspeed_tpu.serving.engine.ServingEngine`.
+
+    mode: "ngram" (prompt-lookup, draft-model-free) or "draft" (small
+        draft model; requires ``draft_engine``).
+    k_buckets: ascending FIXED set of draft lengths the verify program
+        may run at. Each bucket is one compiled verify program (exactly
+        like prefill length buckets); adaptive k moves between buckets,
+        never off them — the zero-recompile invariant.
+    max_ngram/min_ngram: suffix n-gram sizes prompt-lookup tries,
+        longest first (longer matches are more specific → higher
+        acceptance).
+    draft_engine: InferenceEngine serving the draft model ("draft" mode).
+        Must share the target's tokenizer/vocab.
+    draft_window: trailing-history window re-prefilled into the draft
+        model each round. Bounded so the draft program's shapes are
+        static; also bounds per-round draft prefill cost.
+    adaptive: per-slot EMA acceptance tracking that shrinks/grows k
+        within ``k_buckets``. Off = always draft ``k_buckets[-1]``.
+    ema_decay: weight on the PAST in the acceptance EMA (higher = slower
+        to move).
+    """
+
+    mode: str = "ngram"
+    k_buckets: Sequence[int] = (2, 4, 8)
+    max_ngram: int = 3
+    min_ngram: int = 1
+    draft_engine: Optional[object] = None
+    draft_window: int = 64
+    adaptive: bool = True
+    ema_decay: float = 0.7
+
+    def __post_init__(self):
+        if self.mode not in ("ngram", "draft"):
+            raise ValueError(f"speculative mode must be 'ngram' or "
+                             f"'draft', got {self.mode!r}")
+        self.k_buckets = tuple(sorted({int(k) for k in self.k_buckets}))
+        if not self.k_buckets or self.k_buckets[0] < 1:
+            raise ValueError(f"k_buckets must be >= 1: {self.k_buckets}")
+        if self.mode == "draft" and self.draft_engine is None:
+            raise ValueError("speculative mode 'draft' needs a "
+                             "draft_engine (an InferenceEngine over the "
+                             "draft model)")
+        if not (self.min_ngram >= 1 and self.max_ngram >= self.min_ngram):
+            raise ValueError(f"need 1 <= min_ngram <= max_ngram, got "
+                             f"{self.min_ngram}..{self.max_ngram}")
+
+    @property
+    def k_max(self) -> int:
+        return self.k_buckets[-1]
+
+
+def normalize_speculative(spec) -> Optional[SpeculativeConfig]:
+    """ServingEngine's ``speculative=`` kwarg: None/False/"off",
+    a mode string, a dict of SpeculativeConfig fields, or a config."""
+    if spec is None or spec is False or spec == "off":
+        return None
+    if isinstance(spec, SpeculativeConfig):
+        return spec
+    if isinstance(spec, str):
+        return SpeculativeConfig(mode=spec)
+    if isinstance(spec, dict):
+        return SpeculativeConfig(**spec)
+    raise TypeError(f"speculative= takes None/'off'/mode str/dict/"
+                    f"SpeculativeConfig, got {type(spec).__name__}")
+
+
+def pick_k_bucket(k: int, k_buckets: Sequence[int]) -> int:
+    """Smallest configured verify width holding ``k`` draft tokens
+    (k_buckets ascending; k <= k_buckets[-1] is enforced at draft time)."""
+    for b in k_buckets:
+        if k <= b:
+            return b
+    return k_buckets[-1]
+
+
+# --------------------------------------------------- in-jit acceptance
+def speculative_acceptance(logits, tokens, draft_len, temp, rng, *,
+                           do_sample: bool, top_k: int = 0,
+                           top_p: float = 1.0, pad_token_id: int = 0):
+    """Accept/reject ``k`` point-mass draft tokens against the target
+    model's verify logits; traced inside the verify program.
+
+    logits: [B, k+1, V] target logits — position i scored AFTER seeing
+        ``tokens[:, i]`` (so it is the target's distribution for the
+        token FOLLOWING tokens[:, i]).
+    tokens: [B, k+1] int32 — column 0 the last committed token, columns
+        1..k the drafts (pad past each row's ``draft_len``).
+    draft_len: [B] int32 — real draft tokens per row (0 = plain decode).
+
+    Returns ``(out_tokens [B, k+1], n_emit [B])``: row b emits
+    ``out_tokens[b, :n_emit[b]]`` — its accepted draft prefix plus ONE
+    token from the target distribution (bonus on full acceptance,
+    correction on rejection). ``1 <= n_emit <= draft_len + 1`` always:
+    every verify invocation makes progress.
+
+    Greedy: accepted == draft matches target argmax, final token is the
+    target argmax at the first mismatch — the emitted stream is exactly
+    baseline greedy decode's. Sampling: Leviathan rejection sampling
+    specialized to deterministic (point-mass) proposals — accept draft x
+    w.p. ``p(x)``, on rejection resample from ``norm(p - p(x)·δ_x)`` —
+    so emitted tokens are distributed exactly as sequential sampling
+    from the target (pinned by the chi-squared test in
+    tests/unit/serving/test_speculative.py)."""
+    b, t, v = logits.shape
+    k = t - 1
+    cols = jnp.arange(t)[None, :]                                # [1, k+1]
+    logits = logits.astype(jnp.float32)
+    if not do_sample:
+        tgt = jnp.argmax(logits, axis=-1).astype(jnp.int32)      # [B, k+1]
+        match = (tokens[:, 1:] == tgt[:, :k]) & \
+            (cols[:, :k] < draft_len[:, None])
+        n_acc = jnp.sum(jnp.cumprod(match.astype(jnp.int32), axis=1),
+                        axis=1)                                  # [B]
+        out = jnp.where(cols <= n_acc[:, None], tgt, pad_token_id)
+        return out, n_acc + 1
+
+    probs = jax.nn.softmax(
+        filter_logits(logits / temp, top_k=top_k, top_p=top_p), axis=-1)
+    draft = tokens[:, 1:]                                        # [B, k]
+    p_draft = jnp.take_along_axis(probs[:, :k], draft[..., None],
+                                  axis=-1)[..., 0]               # [B, k]
+    r_u, r_res = jax.random.split(rng)
+    u = jax.random.uniform(r_u, (b, k))
+    acc = (u < p_draft) & (cols[:, :k] < draft_len[:, None])
+    n_acc = jnp.sum(jnp.cumprod(acc.astype(jnp.int32), axis=1), axis=1)
+    # final token ~ target at the boundary position: on rejection the
+    # leftover distribution with the rejected draft removed, on full
+    # acceptance the target distribution itself (bonus token)
+    row_p = jnp.take_along_axis(probs, n_acc[:, None, None],
+                                axis=1)[:, 0]                    # [B, V]
+    rejected = n_acc < draft_len                                 # [B]
+    # the draft at the boundary (clipped gather is safe: where n_acc == k
+    # there IS no draft and `rejected` is False there by construction)
+    rej_tok = jnp.take_along_axis(
+        draft, jnp.minimum(n_acc, k - 1)[:, None], axis=1)[:, 0]
+    keep = 1.0 - jax.nn.one_hot(rej_tok, v, dtype=row_p.dtype)
+    adj = jnp.where(rejected[:, None], row_p * keep, row_p)
+    adj = adj / jnp.maximum(adj.sum(-1, keepdims=True), 1e-20)
+    final = jax.random.categorical(
+        r_res, jnp.log(jnp.maximum(adj, 1e-30)), axis=-1).astype(jnp.int32)
+    draft_t = jnp.concatenate(
+        [draft, jnp.full((b, 1), pad_token_id, jnp.int32)], axis=1)
+    out = jnp.where(cols < n_acc[:, None], draft_t,
+                    jnp.where(cols == n_acc[:, None], final[:, None],
+                              pad_token_id))
+    return out, n_acc + 1
+
+
+# -------------------------------------------------------------- drafting
+def ngram_propose(history: np.ndarray, k: int, *, max_ngram: int = 3,
+                  min_ngram: int = 1) -> np.ndarray:
+    """Prompt-lookup drafting (draft-model-free): propose the ``k``
+    tokens that followed the MOST RECENT earlier occurrence of the
+    current suffix n-gram in ``history``, trying the longest n-gram
+    first. Returns an int32 array of length <= k (empty = no match, the
+    engine degenerates to a plain decode step for this slot). Pure
+    numpy, deterministic — acceptance then depends only on whether the
+    target actually re-walks its own context."""
+    h = np.asarray(history, np.int64)
+    n_hi = min(max_ngram, len(h) - 1)
+    for n in range(n_hi, min_ngram - 1, -1):
+        suffix = h[len(h) - n:]
+        windows = np.lib.stride_tricks.sliding_window_view(h, n)
+        # exclude the suffix occurrence itself (the last window)
+        starts = np.nonzero((windows[:-1] == suffix[None, :]).all(axis=1))[0]
+        if len(starts):
+            # recency bias, but never at the cost of draft LENGTH: in a
+            # periodic stream the newest match sits one period from the
+            # end and can only supply period-many tokens — prefer the
+            # most recent match with a FULL k-token continuation, fall
+            # back to the newest otherwise
+            avail = len(h) - (starts + n)
+            full = starts[avail >= k]
+            s = int(full[-1] if len(full) else starts[-1]) + n
+            cont = h[s:s + k]
+            if len(cont):
+                return cont.astype(np.int32)
+    return np.zeros((0,), np.int32)
+
+
+class NgramDrafter:
+    """Per-slot prompt-lookup drafting over host-side token histories."""
+
+    def __init__(self, config: SpeculativeConfig):
+        self.config = config
+
+    def propose(self, histories, want, kb: int) -> np.ndarray:
+        """histories: per-slot token-history arrays (None = slot idle);
+        want: [num_slots] per-slot draft-length caps; kb: verify bucket.
+        Returns int32 [num_slots, kb] drafts + [num_slots] true lengths
+        (the engine trims ``want`` already; this may return fewer)."""
+        n = len(histories)
+        drafts = np.zeros((n, kb), np.int32)
+        lens = np.zeros((n,), np.int32)
+        for i, hist in enumerate(histories):
+            if hist is None or want[i] < 1:
+                continue
+            prop = ngram_propose(hist, int(want[i]),
+                                 max_ngram=self.config.max_ngram,
+                                 min_ngram=self.config.min_ngram)
+            lens[i] = len(prop)
+            drafts[i, :len(prop)] = prop
+        return drafts, lens
+
+    def program_cache_sizes(self):
+        return {}          # host-side: nothing compiled, nothing to pin
+
+
+class DraftModelDrafter:
+    """Greedy draft-model drafting, batched over slots, through the
+    draft model's own InferenceEngine.
+
+    Stateless-window design: each round re-prefills the last
+    ``draft_window`` history tokens into a FRESH draft cache inside one
+    jitted program (InferenceEngine.slot_draft_program) and rolls k
+    greedy tokens forward. No persistent draft KV: nothing to roll back
+    on rejection, no draft/target length coupling, and the program's
+    shapes — [slots, window] ids + [slots] lengths, one program per
+    (window, k-bucket) — never vary, so the jit cache stays pinned. The
+    price is a window-length draft prefill per verify step; with a draft
+    model ~10-100x smaller than the target that is noise next to the
+    verify forward, and ``draft_window`` caps it."""
+
+    def __init__(self, config: SpeculativeConfig, num_slots: int,
+                 pad_token_id: int = 0):
+        self.config = config
+        self.engine = config.draft_engine
+        self.num_slots = num_slots
+        self.pad_token_id = pad_token_id
+        self.window = int(config.draft_window)
+        mcfg = getattr(self.engine.module, "config", None)
+        model_max = getattr(mcfg, "max_seq_len", None)
+        need = self.window + config.k_max
+        if model_max is not None and need > model_max:
+            raise ValueError(
+                f"draft_window {self.window} + k_max {config.k_max} "
+                f"exceeds the draft model's max_seq_len {model_max}")
+        self._programs = {}
+
+    def _program(self, kb: int):
+        if kb not in self._programs:
+            self._programs[kb] = self.engine.slot_draft_program(
+                self.window, self.num_slots, kb)
+        return self._programs[kb]
+
+    def propose(self, histories, want, kb: int):
+        ids = np.full((self.num_slots, self.window), self.pad_token_id,
+                      np.int32)
+        wlen = np.ones((self.num_slots,), np.int32)  # >=1: safe gather
+        for i, hist in enumerate(histories):
+            if hist is None:
+                continue
+            tail = np.asarray(hist[-self.window:], np.int32)
+            ids[i, :len(tail)] = tail
+            wlen[i] = len(tail)
+        out = self._program(kb)(self.engine.params, jnp.asarray(ids),
+                                jnp.asarray(wlen))
+        drafts = np.asarray(jax.device_get(out))                # [B, kb]
+        lens = np.minimum(np.asarray(want, np.int32), kb)
+        lens = np.where([h is not None for h in histories], lens, 0)
+        return drafts.astype(np.int32), lens.astype(np.int32)
+
+    def program_cache_sizes(self):
+        return {f"draft_{kb}": fn._cache_size()
+                for kb, fn in self._programs.items()}
+
+
+# ------------------------------------------------------------ adaptive k
+class AdaptiveK:
+    """Per-slot acceptance-EMA -> draft-length controller over the FIXED
+    ``k_buckets`` set (k never leaves the set: the verify program cache
+    stays pinned through every adaptation).
+
+    After each verify step the slot's acceptance fraction
+    ``n_accepted / draft_len`` folds into an EMA; the desired k is the
+    bucket indexed by the EMA's position in [0, 1]. Slots start
+    optimistic (EMA 1.0 -> k_max) so high-acceptance traffic pays no
+    ramp-up, and a run of rejections walks k down to ``k_buckets[0]``
+    (one wasted verify column per step at worst, never a recompile)."""
+
+    def __init__(self, config: SpeculativeConfig, num_slots: int):
+        self.buckets = config.k_buckets
+        self.decay = float(config.ema_decay)
+        self.ema = np.ones((num_slots,), np.float64)
+
+    def reset_slot(self, slot: int) -> None:
+        self.ema[slot] = 1.0            # fresh request: optimistic start
+
+    def update(self, slot: int, n_accepted: int, draft_len: int) -> None:
+        if draft_len < 1:
+            return                      # plain decode step: no signal
+        frac = n_accepted / draft_len
+        self.ema[slot] = self.decay * self.ema[slot] + \
+            (1.0 - self.decay) * frac
+
+    def desired_k(self, slot: int) -> int:
+        i = min(int(self.ema[slot] * len(self.buckets)),
+                len(self.buckets) - 1)
+        return self.buckets[i]

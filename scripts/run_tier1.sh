@@ -12,4 +12,8 @@ grep -rqs "def test_" tests/unit/serving || { echo "tier-1: serving tests missin
 # likewise the observability suite (marker `observability`): the telemetry
 # registry/sink + engine/serving instrumentation tests ride `-m 'not slow'`
 grep -rqs "def test_" tests/unit/telemetry || { echo "tier-1: observability tests missing"; exit 1; }
+# likewise the speculative-decoding suite (marker `speculative`): the
+# lossless-greedy/rejection-sampling/zero-recompile invariants ride
+# `-m 'not slow'` through tests/unit/serving/test_speculative.py
+grep -qs "def test_" tests/unit/serving/test_speculative.py || { echo "tier-1: speculative tests missing"; exit 1; }
 exit $rc

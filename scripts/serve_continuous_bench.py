@@ -1,13 +1,21 @@
-"""Continuous-batching serving bench (ISSUE 2 acceptance numbers only).
+"""Continuous-batching serving bench (ISSUE 2 / ISSUE 4 acceptance
+numbers only).
 
-Runs bench.py's serving-comparison section standalone: aggregate
+Default: bench.py's serving-comparison section standalone — aggregate
 tokens/sec + p50/p95 per-request latency of the continuous-batching
 runtime (deepspeed_tpu/serving) vs run-to-completion static batching at
 the same slot count, under a mixed-length Poisson arrival trace.
 
-Usage: python scripts/serve_continuous_bench.py
-Prints one JSON object (the "serving_continuous" entry of bench.py).
+``--speculative {off,ngram,draft}``: the ISSUE-4 comparison instead —
+speculative decoding (prompt-lookup n-gram or draft-model drafting)
+vs plain continuous batching on the same templated high-acceptance
+trace, reporting decode tokens/sec, p50/p95 latency, acceptance rate,
+tokens per verify invocation, and the zero-recompile check.
+
+Usage: python scripts/serve_continuous_bench.py [--speculative MODE]
+Prints one JSON object (the matching entry of bench.py).
 """
+import argparse
 import json
 import os
 import sys
@@ -16,13 +24,26 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
 def main():
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("--speculative", choices=("off", "ngram", "draft"),
+                   default="off",
+                   help="compare speculative decoding (n-gram prompt-"
+                        "lookup or draft-model drafting) against plain "
+                        "continuous batching instead of continuous-vs-"
+                        "static")
+    args = p.parse_args()
+
     import jax
 
-    from bench import _bench_continuous_serving
+    from bench import _bench_continuous_serving, _bench_speculative_serving
 
     on_tpu = any(d.platform in ("tpu", "axon") or "TPU" in str(d.device_kind)
                  for d in jax.devices())
-    print(json.dumps(_bench_continuous_serving(on_tpu), indent=2))
+    if args.speculative != "off":
+        out = _bench_speculative_serving(on_tpu, mode=args.speculative)
+    else:
+        out = _bench_continuous_serving(on_tpu)
+    print(json.dumps(out, indent=2))
 
 
 if __name__ == "__main__":

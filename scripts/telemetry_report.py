@@ -86,8 +86,42 @@ def aggregate(records):
         "histograms": metrics.get("histograms", {}),
         "scalars": scalars,
         "events": events,
+        "speculation": _speculation_summary(metrics),
         "n_records": len(records),
     }
+
+
+def _speculation_summary(metrics):
+    """Derived speculative-decoding view (ISSUE 4) over the serving
+    engine's raw counters/gauges/histograms: acceptance rate, committed
+    tokens per verify step, and drafting's share of the decode wall.
+    Empty dict when the run never speculated."""
+    counters = metrics.get("counters", {})
+    drafted = counters.get("serving/spec_drafted_tokens")
+    if not drafted:
+        return {}
+    accepted = counters.get("serving/spec_accepted_tokens", 0)
+    out = {
+        "drafted_tokens": drafted,
+        "accepted_tokens": accepted,
+        "acceptance_rate": round(accepted / drafted, 4),
+        "verify_steps": counters.get("serving/spec_verify_steps"),
+    }
+    gauges = metrics.get("gauges", {})
+    for key, name in (("serving/spec_tokens_per_slot_step",
+                       "tokens_per_slot_step"),
+                      ("serving/spec_draft_overhead_frac",
+                       "draft_overhead_frac"),
+                      ("serving/spec_acceptance_rate",
+                       "acceptance_rate_gauge")):
+        if gauges.get(key) is not None:
+            out[name] = gauges[key]
+    h = metrics.get("histograms", {}).get(
+        "serving/accepted_tokens_per_step")
+    if h and h.get("count"):
+        out["accepted_tokens_per_step_p50"] = h.get("p50")
+        out["accepted_tokens_per_step_max"] = h.get("max")
+    return out
 
 
 def _fmt(v):
@@ -135,6 +169,9 @@ def render(agg):
               _fmt(s["max"]))
              for k, s in agg["scalars"].items()]
     _table("scalars", ("tag", "n", "last", "min", "mean", "max"), srows, out)
+    _table("speculation", ("metric", "value"),
+           [(k, _fmt(v)) for k, v in agg.get("speculation", {}).items()],
+           out)
     erows = [(k, e["count"],
               json.dumps(e["last"], default=str)[:60])
              for k, e in agg["events"].items()]

@@ -286,19 +286,22 @@ _QUICK_MODULES = (
 )
 
 
-# Observability modules (PR 3) run after every pre-existing module (but
-# before the torch-last group): under the 870s tier-1 timeout the suite is
-# budget-bound, and inserting new modules mid-stream would push seed
-# modules past the cutoff — appending keeps the seed's dot accumulation
-# unchanged and spends only LEFTOVER budget on the new tests.
+# Post-seed modules (PR 3 observability, PR 4 speculative decoding) run
+# after every pre-existing module (but before the torch-last group):
+# under the 870s tier-1 timeout the suite is budget-bound, and inserting
+# new modules mid-stream would push seed modules past the cutoff —
+# appending keeps the seed's dot accumulation unchanged and spends only
+# LEFTOVER budget on the new tests.
 _OBSERVABILITY_MODULES = ("unit/monitor/", "unit/telemetry/",
                           "utils/test_timer", "utils/test_comms_logging")
+_LATE_MODULES = _OBSERVABILITY_MODULES + (
+    "unit/serving/test_speculative",)
 
 
 def pytest_collection_modifyitems(config, items):
     items.sort(key=lambda it: (
         any(m in it.nodeid for m in _TORCH_MODULES),
-        any(m in it.nodeid for m in _OBSERVABILITY_MODULES)))
+        any(m in it.nodeid for m in _LATE_MODULES)))
     for it in items:
         if any(m in it.nodeid for m in _QUICK_MODULES):
             it.add_marker(pytest.mark.quick)

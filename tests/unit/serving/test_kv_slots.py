@@ -33,6 +33,51 @@ def test_slot_kv_cache_shapes_and_capacity():
     assert c.hbm_bytes() == 2 * c.k.size * 4
 
 
+def test_capacity_reserves_speculative_lookahead():
+    """Boundary regression (ISSUE 4 satellite): with speculation the
+    verify step writes k draft candidates BEYOND the committed length
+    before acceptance, so a request that exactly fills the slot without
+    the k-row reserve would overflow max_len on its final verify —
+    capacity_for(…, lookahead=k) must reject it at the boundary."""
+    from deepspeed_tpu.models.gpt2 import GPT2Config, GPT2Model
+
+    model = GPT2Model(GPT2Config.tiny(), compute_dtype=jnp.float32)
+    c = SlotKVCache(model, num_slots=2, max_len=128)
+    k = 8
+    # fits without speculation ...
+    assert c.capacity_for(100, 28)
+    # ... but the last verify would write rows up to
+    # 100 + 28 - 1 + 8 = 135 > 127: rejected with the reserve
+    assert not c.capacity_for(100, 28, lookahead=k)
+    assert c.capacity_for(100, 28 - k, lookahead=k)       # exact boundary
+    assert not c.capacity_for(100, 28 - k + 1, lookahead=k)
+    assert c.capacity_for(100, 28, lookahead=0)           # default intact
+
+
+def test_multi_token_per_slot_write_matches_per_row_loop():
+    """The speculative verify path's block scatter: a [B, T] write at
+    per-slot offsets == T scalar writes per row; positions past the
+    allocation are DROPPED, never wrapped or clamped onto live rows."""
+    rng = np.random.RandomState(4)
+    l, b, h, s, dh, t = 2, 3, 2, 16, 8, 4
+    kf = jnp.asarray(rng.randn(l, b, h, s, dh), jnp.float32)
+    vf = jnp.asarray(rng.randn(l, b, h, s, dh), jnp.float32)
+    kn = jnp.asarray(rng.randn(b, t, h, dh), jnp.float32)
+    vn = jnp.asarray(rng.randn(b, t, h, dh), jnp.float32)
+    idx = jnp.asarray([5, 0, 14], jnp.int32)   # row 2 runs off the end
+    kv, vv, _, _ = write_kv_cache(kf, vf, kn, vn, jnp.int32(1), idx)
+    k_ref = np.asarray(kf).copy()
+    v_ref = np.asarray(vf).copy()
+    for i in range(b):
+        for j in range(t):
+            p = int(idx[i]) + j
+            if p < s:                           # OOB writes must drop
+                k_ref[1, i, :, p] = np.asarray(kn)[i, j]
+                v_ref[1, i, :, p] = np.asarray(vn)[i, j]
+    np.testing.assert_array_equal(np.asarray(kv), k_ref)
+    np.testing.assert_array_equal(np.asarray(vv), v_ref)
+
+
 def test_per_slot_write_matches_per_row_scalar_writes():
     """The vector-idx scatter write == one scalar slice write per row."""
     rng = np.random.RandomState(0)
