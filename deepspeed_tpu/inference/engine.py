@@ -719,6 +719,155 @@ class InferenceEngine:
             self._compiled[key] = jax.jit(draft)
         return self._compiled[key]
 
+    # --------------------------------------------- block-paged programs
+    # (ISSUE 6, serving/kv_blocks.py + serving/radix.py): the slot
+    # programs' prefix-sharing analogs. Same zero-recompile contract —
+    # the block table is a TRACED int32 operand, never a shape, so one
+    # compiled program per (bucket | k-bucket | step kind) serves every
+    # block assignment the radix index produces.
+
+    def block_prefill_program(self, bucket_len: int, num_slots: int,
+                              max_blocks: int, *, do_sample: bool = False,
+                              top_k: int = 0, top_p: float = 1.0):
+        """Jitted SUFFIX prefill against the block pool: run ONE
+        request's bucket-padded UNMATCHED suffix through the pool with
+        the slot's [1, MB] table row — the suffix tokens attend over the
+        radix-matched prefix blocks already in the pool (start = matched
+        length), and their K/V scatter through the table
+        (ops/attention.write_kv_blocks). This is where the prefix-cache
+        win lands: a matched prefix is never recomputed, and the bucket
+        is picked by SUFFIX length, so a 2k-token shared system prompt
+        with a 30-token user suffix prefills in the smallest bucket.
+
+        Signature: ``(params, k_pool, v_pool, lengths, ids[1, bucket],
+        table_row[1, MB], slot, start, suffix_len, temp, rng) ->
+        (k_pool, v_pool, lengths, first_token)`` (pool operands donated
+        on TPU). ``start`` is the matched prefix length; the slot's
+        length becomes ``start + suffix_len``."""
+        key = ("blk_pf", bucket_len, num_slots, max_blocks, do_sample,
+               top_k, float(top_p))
+        if key not in self._compiled:
+            model = self.module
+            pick = self._make_pick(do_sample, top_k, float(top_p))
+
+            def prefill(params, k_pool, v_pool, lengths, ids, table_row,
+                        slot, start, length, temp, rng):
+                idx = jnp.reshape(jnp.asarray(start, jnp.int32), (1,))
+                cache = {"k": k_pool, "v": v_pool, "index": idx,
+                         "block_table": table_row}
+                logits, cache = model.forward_with_cache(params, ids, cache)
+                lengths = jax.lax.dynamic_update_index_in_dim(
+                    lengths, start + length, slot, 0)
+                last = jax.lax.dynamic_index_in_dim(
+                    logits, length - 1, 1, keepdims=False)       # [1, V]
+                return (cache["k"], cache["v"], lengths,
+                        pick(last, temp, rng)[0])
+
+            donate = (1, 2, 3) if jax.default_backend() == "tpu" else ()
+            self._compiled[key] = jax.jit(prefill, donate_argnums=donate)
+        return self._compiled[key]
+
+    def block_decode_program(self, num_slots: int, max_blocks: int, *,
+                             do_sample: bool = False, top_k: int = 0,
+                             top_p: float = 1.0, pad_token_id: int = 0):
+        """Jitted block-paged decode step: one token for every slot,
+        KV addressed through the full [B, MB] block table (single-token
+        decode on TPU routes to the fused Pallas block kernel,
+        ops/decode_step.fused_block_decode_step). Inactive slots carry
+        sentinel tables — their writes land in the pool's garbage row.
+
+        Signature: ``(params, k_pool, v_pool, lengths[B], tables[B, MB],
+        tokens[B], active[B] bool, temp, rng) -> (k_pool, v_pool,
+        lengths, next_tokens[B])`` (pool operands donated on TPU)."""
+        key = ("blk_dec", num_slots, max_blocks, do_sample, top_k,
+               float(top_p), pad_token_id)
+        if key not in self._compiled:
+            model = self.module
+            pick = self._make_pick(do_sample, top_k, float(top_p))
+
+            def decode(params, k_pool, v_pool, lengths, tables, tokens,
+                       active, temp, rng):
+                cache = {"k": k_pool, "v": v_pool, "index": lengths,
+                         "block_table": tables}
+                logits, cache = model.forward_with_cache(
+                    params, tokens[:, None], cache)
+                nxt = jnp.where(active, pick(logits[:, -1], temp, rng),
+                                pad_token_id)
+                lengths = jnp.where(active, lengths + 1, lengths)
+                return cache["k"], cache["v"], lengths, nxt
+
+            donate = (1, 2, 3) if jax.default_backend() == "tpu" else ()
+            self._compiled[key] = jax.jit(decode, donate_argnums=donate)
+        return self._compiled[key]
+
+    def block_verify_program(self, num_slots: int, max_blocks: int, k: int,
+                             *, do_sample: bool = False, top_k: int = 0,
+                             top_p: float = 1.0, pad_token_id: int = 0):
+        """Jitted speculative verify step over the block pool — the
+        block-table analog of :meth:`slot_verify_program`. Rollback
+        stays free: rejected candidates' K/V stay dead behind the
+        per-slot length in the slot's PRIVATE decode blocks (a shared
+        prefix block is never written after admit — the radix COW fork
+        happens at admit time, before any decode write could touch a
+        shared block), and the next verify block overwrites them in
+        place through the same table.
+
+        Signature: ``(params, k_pool, v_pool, lengths[B], tables[B, MB],
+        tokens[B, k+1], draft_len[B], active[B] bool, temp, rng) ->
+        (k_pool, v_pool, lengths, out_tokens[B, k+1], n_emit[B])``."""
+        from deepspeed_tpu.serving.speculative import speculative_acceptance
+
+        key = ("blk_ver", num_slots, max_blocks, k, do_sample, top_k,
+               float(top_p), pad_token_id)
+        if key not in self._compiled:
+            model = self.module
+
+            def verify(params, k_pool, v_pool, lengths, tables, tokens,
+                       draft_len, active, temp, rng):
+                cache = {"k": k_pool, "v": v_pool, "index": lengths,
+                         "block_table": tables}
+                logits, cache = model.forward_with_cache(
+                    params, tokens, cache)
+                out_tokens, n_emit = speculative_acceptance(
+                    logits, tokens, draft_len, temp, rng,
+                    do_sample=do_sample, top_k=top_k, top_p=float(top_p),
+                    pad_token_id=pad_token_id)
+                n_emit = jnp.where(active, n_emit, 0)
+                out_tokens = jnp.where(active[:, None], out_tokens,
+                                       pad_token_id)
+                lengths = lengths + n_emit
+                return (cache["k"], cache["v"], lengths, out_tokens,
+                        n_emit)
+
+            donate = (1, 2, 3) if jax.default_backend() == "tpu" else ()
+            self._compiled[key] = jax.jit(verify, donate_argnums=donate)
+        return self._compiled[key]
+
+    def block_copy_program(self, num_blocks: int, block_size: int):
+        """Jitted one-block COW copy: duplicate pool block ``src`` into
+        ``dst`` across both pools and every layer (the device half of a
+        radix copy-on-write fork, serving/radix.PrefixCache.admit —
+        issued BEFORE the suffix prefill that partially overwrites the
+        fork). ``src``/``dst`` are traced scalars: one compiled program
+        serves every fork.
+
+        Signature: ``(k_pool, v_pool, src, dst) -> (k_pool, v_pool)``
+        (pool operands donated on TPU)."""
+        key = ("blk_copy", num_blocks, block_size)
+        if key not in self._compiled:
+            def copy(k_pool, v_pool, src, dst):
+                kb = jax.lax.dynamic_slice_in_dim(k_pool, src, 1, 1)
+                vb = jax.lax.dynamic_slice_in_dim(v_pool, src, 1, 1)
+                k_pool = jax.lax.dynamic_update_slice_in_dim(
+                    k_pool, kb, dst, 1)
+                v_pool = jax.lax.dynamic_update_slice_in_dim(
+                    v_pool, vb, dst, 1)
+                return k_pool, v_pool
+
+            donate = (0, 1) if jax.default_backend() == "tpu" else ()
+            self._compiled[key] = jax.jit(copy, donate_argnums=donate)
+        return self._compiled[key]
+
     # ------------------------------------------------------------- utilities
     def compiled_programs(self, batch: int, prompt_len: int, max_new: int,
                           *, do_sample: bool = False, top_k: int = 0,

@@ -181,8 +181,10 @@ class GPT2Model:
                                            dropout_rng=drop_rng)
             kc = vc = None
         else:
-            kc, vc, layer, idx = cache
-            attn, kc, vc = cached_attention(q, kc, vc, k_, v_, layer, idx)
+            kc, vc, layer, idx, *rest = cache
+            attn, kc, vc = cached_attention(
+                q, kc, vc, k_, v_, layer, idx,
+                block_table=rest[0] if rest else None)
         attn = attn.reshape(b, t, d)
         x = x + qdot("btd,de->bte", attn, blk["attn_out_w"]) + \
             blk["attn_out_b"].astype(x.dtype)
@@ -314,8 +316,8 @@ class GPT2Model:
                                     max_len, c.head_dim, dtype),
                 "index": jnp.zeros((), jnp.int32)}
 
-    def _block_cached(self, x, blk, kc, vc, layer, idx):
-        return self._block_impl(x, blk, None, False, (kc, vc, layer, idx))
+    def _block_cached(self, x, blk, kc, vc, layer, idx, bt):
+        return self._block_impl(x, blk, None, False, (kc, vc, layer, idx, bt))
 
     def forward_with_cache(self, params, input_ids, cache):
         """Prefill (T>1) or decode (T=1) step against the KV cache.
@@ -323,6 +325,9 @@ class GPT2Model:
 
         ``cache["index"]`` may be a scalar (uniform batch) or a per-slot
         [B] vector (continuous batching — models/base.cache_positions).
+        ``cache["block_table"]`` (optional, int32 [B, max_blocks])
+        switches the cache arrays to the block-paged pool addressing of
+        ops/attention.write_kv_blocks (prefix-sharing serving, ISSUE 6).
 
         The stacked caches ride the layer-scan CARRY (per-layer slice writes
         XLA keeps in place), not xs/ys — the ys form copied the entire cache
@@ -330,6 +335,7 @@ class GPT2Model:
         c = self.config
         b, t = input_ids.shape
         idx = cache["index"]
+        bt = cache.get("block_table")
         x = params["wte"].astype(self.compute_dtype)[input_ids]
         pos = cache_positions(idx, t)
         pe = params["wpe"].astype(self.compute_dtype)[pos]
@@ -341,7 +347,7 @@ class GPT2Model:
             # whole so qdot's kernel DMA-slices the layer in-kernel (a
             # host-side int8 operand slice copies the weight every step)
             blk = layer_view(params["blocks"], layer)
-            x, kc, vc = self._block_cached(x, blk, kc, vc, layer, idx)
+            x, kc, vc = self._block_cached(x, blk, kc, vc, layer, idx, bt)
             return (x, kc, vc, layer + 1), None
 
         (x, k_new, v_new, _), _ = jax.lax.scan(
@@ -351,7 +357,10 @@ class GPT2Model:
             unroll=self.decode_unroll if t == 1 else 1)
         hidden = layer_norm(x, params["ln_f_scale"], params["ln_f_bias"], c.eps)
         logits = self.logits(params, hidden)
-        return logits, {"k": k_new, "v": v_new, "index": idx + t}
+        out = {"k": k_new, "v": v_new, "index": idx + t}
+        if bt is not None:
+            out["block_table"] = bt
+        return logits, out
 
     # ------------------------------------------------------------------- cost
     def flops_per_token(self) -> float:

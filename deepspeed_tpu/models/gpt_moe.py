@@ -16,7 +16,8 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 
-from deepspeed_tpu.models.base import cross_entropy_loss, gelu, layer_norm
+from deepspeed_tpu.models.base import (cache_positions, cross_entropy_loss,
+                                       gelu, layer_norm)
 from deepspeed_tpu.moe.layer import MoE
 from deepspeed_tpu.ops.attention import alloc_kv_cache, cached_attention, multihead_attention
 
@@ -136,8 +137,10 @@ class GPTMoEModel:
             attn = multihead_attention(q, k_, v_, causal=True)
             kc = vc = None
         else:
-            kc, vc, layer, idx = cache
-            attn, kc, vc = cached_attention(q, kc, vc, k_, v_, layer, idx)
+            kc, vc, layer, idx, *rest = cache
+            attn, kc, vc = cached_attention(
+                q, kc, vc, k_, v_, layer, idx,
+                block_table=rest[0] if rest else None)
         x = x + attn.reshape(b, t, d) @ blk["out_w"].astype(x.dtype) + \
             blk["out_b"].astype(x.dtype)
         return x, kc, vc
@@ -158,8 +161,10 @@ class GPTMoEModel:
 
     def _embed(self, params, input_ids, start_pos=0):
         x = params["wte"].astype(self.compute_dtype)[input_ids]
-        pos = start_pos + jnp.arange(input_ids.shape[1])
-        return x + params["wpe"].astype(self.compute_dtype)[pos][None]
+        # start_pos may be a per-slot [B] vector (continuous batching)
+        pos = cache_positions(start_pos, input_ids.shape[1])
+        pe = params["wpe"].astype(self.compute_dtype)[pos]
+        return x + (pe if pos.ndim == 2 else pe[None])
 
     def _forward_blocks(self, params, x, *, rng=None, train: bool = False):
         total_aux = jnp.zeros((), jnp.float32)
@@ -214,11 +219,14 @@ class GPTMoEModel:
         inference/engine.py:274 expert groups at serve time)."""
         c = self.config
         idx = cache["index"]
+        bt = cache.get("block_table")
         x = self._embed(params, input_ids, start_pos=idx)
         kc, vc = cache["k"], cache["v"]
         for i, blk in enumerate(params["blocks"]):
-            x, kc, vc = self._attn(x, blk, cache=(kc, vc, i, idx))
+            x, kc, vc = self._attn(x, blk, cache=(kc, vc, i, idx, bt))
             x, _ = self._ffn(x, blk, i, train=False, rng=None)
         hidden = layer_norm(x, params["ln_f_scale"], params["ln_f_bias"], c.eps)
-        return self.logits(params, hidden), \
-            {"k": kc, "v": vc, "index": idx + input_ids.shape[1]}
+        out = {"k": kc, "v": vc, "index": idx + input_ids.shape[1]}
+        if bt is not None:
+            out["block_table"] = bt
+        return self.logits(params, hidden), out

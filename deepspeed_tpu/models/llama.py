@@ -148,8 +148,10 @@ class LlamaModel:
                 attn = multihead_attention(q, k_, v_, causal=True)
             kc = vc = None
         else:
-            kc, vc, layer, idx = cache
-            attn, kc, vc = cached_attention(q, kc, vc, k_, v_, layer, idx)
+            kc, vc, layer, idx, *rest = cache
+            attn, kc, vc = cached_attention(
+                q, kc, vc, k_, v_, layer, idx,
+                block_table=rest[0] if rest else None)
         x = x + qdot("bte,ed->btd", attn.reshape(b, t, hq * dh), blk["wo"])
         y = rms_norm(x, blk["mlp_norm"], c.eps)
         gate = jax.nn.silu(qdot("btd,dm->btm", y, blk["w_gate"]))
@@ -203,8 +205,9 @@ class LlamaModel:
                                     dtype),
                 "index": jnp.zeros((), jnp.int32)}
 
-    def _block_cached(self, x, blk, kc, vc, layer, idx, cos, sin):
-        return self._block_impl(x, blk, cos, sin, False, (kc, vc, layer, idx))
+    def _block_cached(self, x, blk, kc, vc, layer, idx, cos, sin, bt):
+        return self._block_impl(x, blk, cos, sin, False,
+                                (kc, vc, layer, idx, bt))
 
     def forward_with_cache(self, params, input_ids, cache):
         """Prefill (T>1) or decode (T=1) against the KV cache. Stacked caches
@@ -216,6 +219,7 @@ class LlamaModel:
         c = self.config
         b, t = input_ids.shape
         idx = cache["index"]
+        bt = cache.get("block_table")
         x = params["embed"].astype(self.compute_dtype)[input_ids]
         cos, sin = rope_frequencies(c.head_dim, c.max_seq_len, c.rope_theta)
 
@@ -226,7 +230,8 @@ class LlamaModel:
             # DMA-slices the layer in-kernel instead of paying a full
             # per-step operand copy (models/base.layer_view)
             blk = layer_view(params["blocks"], layer)
-            x, kc, vc = self._block_cached(x, blk, kc, vc, layer, idx, cos, sin)
+            x, kc, vc = self._block_cached(x, blk, kc, vc, layer, idx,
+                                           cos, sin, bt)
             return (x, kc, vc, layer + 1), None
 
         (x, k_new, v_new, _), _ = jax.lax.scan(
@@ -236,7 +241,10 @@ class LlamaModel:
             unroll=self.decode_unroll if t == 1 else 1)
         hidden = rms_norm(x, params["final_norm"], c.eps)
         logits = self.logits(params, hidden)
-        return logits, {"k": k_new, "v": v_new, "index": idx + t}
+        out = {"k": k_new, "v": v_new, "index": idx + t}
+        if bt is not None:
+            out["block_table"] = bt
+        return logits, out
 
     def flops_per_token(self) -> float:
         c = self.config

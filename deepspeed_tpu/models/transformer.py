@@ -28,8 +28,12 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from deepspeed_tpu.models.base import cross_entropy_loss, gelu, layer_norm, layer_view, qdot
-from deepspeed_tpu.ops.attention import alloc_kv_cache, cache_seq_len, cached_attention, multihead_attention
+from deepspeed_tpu.models.base import (cache_positions, cross_entropy_loss,
+                                       gelu, layer_norm, layer_view, qdot)
+from deepspeed_tpu.ops.attention import (alloc_kv_cache, cache_seq_len,
+                                         cached_attention,
+                                         multihead_attention,
+                                         pool_block_size)
 from deepspeed_tpu.ops.rotary import apply_rotary_pos_emb, rope_frequencies
 
 
@@ -296,8 +300,15 @@ class DecoderModel:
                                        scale=c.qk_scale)
             kc = vc = None
         else:
-            kc, vc, layer, _ = cache
-            s_max = cache_seq_len(kc, c.head_dim)
+            kc, vc, layer, _, *rest = cache
+            bt = rest[0] if rest else None
+            if bt is not None:
+                # block-paged pool (ISSUE 6): the attended view is the
+                # gathered block chain [B, MB * bs, ...], not the pool's
+                # physical row count
+                s_max = bt.shape[1] * pool_block_size(kc, c.head_dim)
+            else:
+                s_max = cache_seq_len(kc, c.head_dim)
             dec_bias = None
             if c.alibi:
                 dec_bias = self._alibi[:, None] * jnp.arange(
@@ -307,7 +318,7 @@ class DecoderModel:
                 window = jnp.where(local_flag, c.local_attn_window, s_max + 1)
             attn, kc, vc = cached_attention(q, kc, vc, k_, v_, layer, idx,
                                             bias=dec_bias, scale=c.qk_scale,
-                                            window=window)
+                                            window=window, block_table=bt)
         attn = attn.reshape(b, t, d)
         attn_out = qdot("btd,de->bte", attn, blk["attn_out_w"]) + \
             blk["attn_out_b"].astype(x.dtype)
@@ -342,8 +353,10 @@ class DecoderModel:
         if "project_in" in params:
             x = x @ params["project_in"].astype(x.dtype)
         if c.pos_emb == "learned":
-            pos = idx + jnp.arange(t) + c.pos_offset
-            x = x + params["wpe"].astype(self.compute_dtype)[pos][None]
+            # idx may be a per-slot [B] vector (continuous batching)
+            pos = cache_positions(idx, t) + c.pos_offset
+            pe = params["wpe"].astype(self.compute_dtype)[pos]
+            x = x + (pe if pos.ndim == 2 else pe[None])
         if c.embedding_ln:
             x = layer_norm(x, params["emb_ln_scale"], params["emb_ln_bias"],
                            c.eps)
@@ -420,6 +433,7 @@ class DecoderModel:
     def forward_with_cache(self, params, input_ids, cache):
         c = self.config
         idx = cache["index"]
+        bt = cache.get("block_table")
         x = self._embed(params, input_ids, idx)
         flags = self._local_flags
         if flags is None:
@@ -435,7 +449,7 @@ class DecoderModel:
             # host-side int8 operand slice copies the weight every step)
             blk = layer_view(params["blocks"], layer)
             x, kc, vc = self._block_impl(
-                x, blk, (kc, vc, layer, idx),
+                x, blk, (kc, vc, layer, idx, bt),
                 local_flag=flag if use_flags else None)
             return (x, kc, vc, layer + 1), None
 
@@ -447,8 +461,10 @@ class DecoderModel:
         if c.final_ln:
             x = layer_norm(x, params["ln_f_scale"], params["ln_f_bias"],
                            c.eps)
-        return self.logits(params, x), {"k": k_new, "v": v_new,
-                                        "index": idx + input_ids.shape[1]}
+        out = {"k": k_new, "v": v_new, "index": idx + input_ids.shape[1]}
+        if bt is not None:
+            out["block_table"] = bt
+        return self.logits(params, x), out
 
     def flops_per_token(self) -> float:
         c = self.config

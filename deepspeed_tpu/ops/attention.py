@@ -104,7 +104,7 @@ def cache_seq_len(k_full, head_dim: int) -> int:
 
 
 def cached_attention(q, k_full, v_full, k_new, v_new, layer, idx, *,
-                     scale=None, bias=None, window=None):
+                     scale=None, bias=None, window=None, block_table=None):
     """One cached-attention layer step: write the new block's K/V into the
     full stacked [L, B, Hkv, S, Dh] caches (possibly token-pair packed,
     see :func:`kv_pack_factor`), attend, return ``(attn, k_full, v_full)``.
@@ -121,7 +121,23 @@ def cached_attention(q, k_full, v_full, k_new, v_new, layer, idx, *,
     a ``dynamic_update_slice`` write anchors (round-4 root cause of
     batch-8 decode at half its roofline — PROFILE_DECODE.md). Everything
     else (prefill blocks, ALiBi bias, sliding windows, CPU) takes the
-    einsum path, view-unpacking packed caches first."""
+    einsum path, view-unpacking packed caches first.
+
+    ``block_table`` switches to the BLOCK-PAGED addressing mode (ISSUE 6,
+    serving/kv_blocks.py): ``k_full``/``v_full`` are then a global block
+    POOL ``[L, N_blocks, Hkv, bs(/pair), Dh(*pair)]`` and each batch
+    row's KV lives in the blocks named by its ``block_table[b]`` row —
+    logical token position p maps to pool block ``table[b, p // bs]``,
+    row ``p % bs``. ``idx`` must be the per-slot [B] length vector. The
+    table is TRACED DATA (int32 [B, max_blocks]), never a shape: one
+    compiled program serves every block assignment, which is what lets
+    the radix prefix cache remap blocks between steps without a single
+    recompile."""
+    if block_table is not None:
+        return _block_cached_attention(q, k_full, v_full, k_new, v_new,
+                                       layer, idx, block_table,
+                                       scale=scale, bias=bias,
+                                       window=window)
     b, t = q.shape[0], q.shape[1]
     dh = q.shape[3]
     pair = k_full.shape[4] // dh
@@ -222,6 +238,96 @@ def write_slot_prefix(k_full, v_full, k_pref, v_pref, slot):
     v_full = jax.lax.dynamic_update_slice(
         v_full, v_pref.astype(v_full.dtype), (zero, slot, zero, zero, zero))
     return k_full, v_full
+
+
+def pool_block_size(k_pool, head_dim: int) -> int:
+    """Tokens per block of a (possibly token-pair packed) KV block pool
+    ``[L, N, Hkv, bs/pair, Dh*pair]``."""
+    return k_pool.shape[3] * (k_pool.shape[4] // head_dim)
+
+
+def write_kv_blocks(k_pool, v_pool, k_new, v_new, layer, idx, block_table):
+    """Scatter one step's new K/V ([B, T, Hkv, Dh]) into the UNPACKED
+    block pool ``[L, N+1, Hkv, bs, Dh]`` through the per-slot block
+    table: row b's token j lands at logical position ``idx[b] + j``,
+    i.e. pool block ``block_table[b, pos // bs]``, row ``pos % bs``.
+
+    Sentinel semantics (serving/kv_blocks.py): the pool's LAST physical
+    row is a permanent garbage block that is never allocated — the
+    engine parks freed/unallocated table entries there, and logical
+    overflow past the table width routes there too. Inactive slots
+    carry stale lengths and sentinel tables, and their masked writes
+    must never corrupt a live block — with prefix sharing a stale table
+    entry may meanwhile be pinned by another request, so the garbage
+    row is a correctness requirement, not a nicety (and it lets the
+    fused Pallas block kernel skip per-row write predication
+    entirely)."""
+    n_phys, bs = k_pool.shape[1], k_pool.shape[3]
+    b, t = k_new.shape[0], k_new.shape[1]
+    mb = block_table.shape[1]
+    pos = idx[:, None] + jnp.arange(t)[None, :]                  # [B, T]
+    jb = pos // bs
+    pb = jnp.take_along_axis(block_table, jnp.clip(jb, 0, mb - 1), axis=1)
+    pb = jnp.where(jb < mb, pb, n_phys - 1)  # overflow -> garbage row
+    wi = pos % bs
+    k_pool = k_pool.at[layer, pb, :, wi, :].set(
+        k_new.astype(k_pool.dtype), mode="drop")
+    v_pool = v_pool.at[layer, pb, :, wi, :].set(
+        v_new.astype(v_pool.dtype), mode="drop")
+    return k_pool, v_pool
+
+
+def gather_block_kv(pool_layer, block_table):
+    """Per-layer slot view of the block pool: gather each row's blocks
+    ``[N+1, Hkv, bs, Dh] -> [B, Hkv, MB * bs, Dh]`` (the shape
+    :func:`decode_attention` expects). Sentinel table entries read the
+    garbage row — garbage, but FINITE (a fill-value NaN would poison
+    the PV einsum through the masked positions' 0 * NaN), and always
+    dead behind the per-slot length mask; ``mode="clip"`` keeps even a
+    corrupt table in range."""
+    n, hkv, bs, dh = pool_layer.shape
+    b, mb = block_table.shape
+    kb = jnp.take(pool_layer, block_table, axis=0, mode="clip")
+    return kb.transpose(0, 2, 1, 3, 4).reshape(b, hkv, mb * bs, dh)
+
+
+def _block_cached_attention(q, k_pool, v_pool, k_new, v_new, layer, idx,
+                            block_table, *, scale=None, bias=None,
+                            window=None):
+    """Block-paged cached attention (see :func:`cached_attention`): write
+    the new tokens' K/V through the block table, then attend each row
+    over its own gathered block chain. Single-token decode on TPU routes
+    to the fused Pallas block-table step (ops/decode_step.py) — the
+    kernel streams each slot's valid blocks straight from the pool, so
+    paging costs no extra HBM copy; everything else (suffix prefill,
+    speculative verify blocks, CPU) takes the gather + einsum path."""
+    b, t = q.shape[0], q.shape[1]
+    dh = q.shape[3]
+    l, n, hkv, bsp, dhp = k_pool.shape
+    pair = dhp // dh
+    bs = bsp * pair
+    assert jnp.ndim(idx) == 1, \
+        "block-paged attention needs the per-slot length vector"
+    if (t == 1 and bias is None and window is None
+            and jax.default_backend() == "tpu"
+            and pair == kv_pack_factor(dh)):
+        from deepspeed_tpu.ops.decode_step import (fused_block_decode_step,
+                                                   supports_block)
+
+        if supports_block(q.shape[2], hkv, bs, dh):
+            return fused_block_decode_step(q, k_pool, v_pool, k_new, v_new,
+                                           layer, idx, block_table,
+                                           scale=scale)
+    shape = (l, n, hkv, bs, dh)
+    ku = k_pool.reshape(shape) if pair > 1 else k_pool
+    vu = v_pool.reshape(shape) if pair > 1 else v_pool
+    ku, vu = write_kv_blocks(ku, vu, k_new, v_new, layer, idx, block_table)
+    kl = jax.lax.dynamic_index_in_dim(ku, layer, 0, keepdims=False)
+    vl = jax.lax.dynamic_index_in_dim(vu, layer, 0, keepdims=False)
+    attn = decode_attention(q, gather_block_kv(kl, block_table),
+                            gather_block_kv(vl, block_table), idx,
+                            scale=scale, bias=bias, window=window)
+    return attn, ku.reshape(k_pool.shape), vu.reshape(v_pool.shape)
 
 
 def decode_attention(

@@ -355,6 +355,280 @@ def _kernel(layer_ref, idx_ref, q_ref, kn_ref, vn_ref, _kin_ref, _vin_ref,
             vwin, v_ref.at[layer, :, :, pl.ds(w0, 8), :], wsem.at[1, 0]).wait()
 
 
+def supports_block(hq: int, hkv: int, block_size: int, dh: int) -> bool:
+    """Shapes the fused BLOCK-TABLE kernel can stream: minor dim must
+    tile to 128 lanes (dh % 128 == 0, or dh*pair == 128), and each
+    block's pair-row count must cover whole 8-row HBM tiles (the new
+    token's write is an 8-aligned window RMW inside one block)."""
+    if hq % hkv:
+        return False
+    if dh >= 128:
+        return dh % 128 == 0 and block_size % 8 == 0
+    return 128 % dh == 0 and block_size % (8 * (128 // dh)) == 0
+
+
+def _block_kernel(layer_ref, idx_ref, tbl_ref, q_ref, kn_ref, vn_ref,
+                  _kin_ref, _vin_ref, attn_ref, k_ref, v_ref,
+                  kbuf, vbuf, kwin, vwin, m_ref, l_ref, acc_ref, wsem, rsem,
+                  *, b: int, mb: int, csp: int, hq: int, hkv: int, dh: int,
+                  pair: int, scale: float):
+    """Block-paged decode layer-step (the block-table analog of
+    :func:`_kernel`'s per_slot path): each batch row's KV lives in the
+    pool blocks named by its ``tbl_ref[i]`` row, so both the new token's
+    window RMW and the streaming walk indirect through the table —
+    which is SMEM DATA, so remapping blocks between steps never
+    recompiles. Rows are processed one at a time (serving batches are
+    narrow; each row's block chain is its own DMA stream), with the
+    same double-buffered fetch + in-register splice + online-softmax
+    structure as the slot kernel. Sentinel table entries name the
+    pool's garbage row (kv_blocks.BlockKVPool), so inactive slots'
+    writes and reads are unconditionally safe — no predication."""
+    layer = layer_ref[0]
+    rep = hq // hkv
+    bs = csp * pair           # tokens per block
+    dhp = dh * pair
+
+    # ---- write each row's new token into its current tail block.
+    # Same RMW-window discipline as the slot kernel: HBM tiling forbids
+    # single-row writes, so fetch the 8-aligned pair-row window of the
+    # TABLE-NAMED block, vector-select the token in, write back async.
+    pbs, w0s = [], []
+    for i in range(b):
+        pos = idx_ref[i]
+        jb = jnp.minimum(pos // bs, mb - 1)
+        pbs.append(tbl_ref[i, jb])
+        w0s.append((pos % bs // pair // 8) * 8)
+
+    def kdma(i):
+        return pltpu.make_async_copy(
+            k_ref.at[layer, pl.ds(pbs[i], 1), :, pl.ds(w0s[i], 8), :],
+            kwin.at[pl.ds(i, 1)], wsem.at[0, i])
+
+    def vdma(i):
+        return pltpu.make_async_copy(
+            v_ref.at[layer, pl.ds(pbs[i], 1), :, pl.ds(w0s[i], 8), :],
+            vwin.at[pl.ds(i, 1)], wsem.at[1, i])
+
+    for i in range(b):
+        kdma(i).start()
+        vdma(i).start()
+
+    def finish_write():
+        for i in range(b):
+            kdma(i).wait()
+            vdma(i).wait()
+        bi = jax.lax.broadcasted_iota(jnp.int32, (b, hkv, 8, dhp), 0)
+        ri = jax.lax.broadcasted_iota(jnp.int32, (b, hkv, 8, dhp), 2)
+        li = jax.lax.broadcasted_iota(jnp.int32, (b, hkv, 8, dhp), 3)
+        sel = bi < 0  # all-false
+        for i in range(b):
+            r = jax.lax.rem(idx_ref[i], bs)
+            sel_i = (bi == i) & (ri == jax.lax.rem(r // pair, 8))
+            if pair > 1:
+                sel_i &= (li // dh == jax.lax.rem(r, pair))
+            sel |= sel_i
+        kwin[...] = jnp.where(sel, kn_ref[...], kwin[...])
+        vwin[...] = jnp.where(sel, vn_ref[...], vwin[...])
+        for i in range(b):
+            pltpu.make_async_copy(
+                kwin.at[pl.ds(i, 1)],
+                k_ref.at[layer, pl.ds(pbs[i], 1), :, pl.ds(w0s[i], 8), :],
+                wsem.at[0, i]).start()
+            pltpu.make_async_copy(
+                vwin.at[pl.ds(i, 1)],
+                v_ref.at[layer, pl.ds(pbs[i], 1), :, pl.ds(w0s[i], 8), :],
+                wsem.at[1, i]).start()
+
+    # ---- per-row valid-block walk (chunk == one pool block)
+    for i in range(b):
+        idx_i = idx_ref[i]
+        nblk = idx_i // bs + 1
+
+        def chunk_dma(slot, j, src, buf, t):
+            pb = tbl_ref[i, jnp.minimum(j, mb - 1)]
+            return pltpu.make_async_copy(
+                src.at[layer, pl.ds(pb, 1), :, :, :],
+                buf.at[slot], rsem.at[slot, t])
+
+        m_ref[...] = jnp.full_like(m_ref, _NEG)
+        l_ref[...] = jnp.zeros_like(l_ref)
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+        chunk_dma(0, 0, k_ref, kbuf, 0).start()
+        chunk_dma(0, 0, v_ref, vbuf, 1).start()
+        if i == 0:
+            finish_write()  # overlaps with row 0 / chunk 0's flight
+        qv = q_ref[pl.ds(i, 1)]                      # [1, Hq, 1, Dh]
+
+        def body(c, _):
+            slot = jax.lax.rem(c, 2)
+            nxt = 1 - slot
+
+            @pl.when(c + 1 < nblk)
+            def _prefetch():
+                chunk_dma(nxt, c + 1, k_ref, kbuf, 0).start()
+                chunk_dma(nxt, c + 1, v_ref, vbuf, 1).start()
+
+            chunk_dma(slot, c, k_ref, kbuf, 0).wait()
+            chunk_dma(slot, c, v_ref, vbuf, 1).wait()
+            kc = kbuf[slot]                          # [1, Hkv, CSP, Dh*pair]
+            vc = vbuf[slot]
+            # splice the new token in-register (its async window
+            # write-back may still be in flight; only its own pair-row
+            # can race, and the splice overrides exactly that row)
+            rowg = c * csp + jax.lax.broadcasted_iota(
+                jnp.int32, (1, hkv, csp, dhp), 2)
+            spl = rowg == idx_i // pair
+            if pair > 1:
+                spl &= (jax.lax.broadcasted_iota(
+                    jnp.int32, (1, hkv, csp, dhp), 3) // dh
+                        == jax.lax.rem(idx_i, pair))
+            kc = jnp.where(spl, kn_ref[pl.ds(i, 1)], kc)
+            vc = jnp.where(spl, vn_ref[pl.ds(i, 1)], vc)
+            ss = []
+            for h in range(pair):
+                k = kc[..., h * dh:(h + 1) * dh]     # [1, Hkv, CSP, Dh]
+                if rep == 1:
+                    s = jnp.sum(qv * k, -1, dtype=jnp.float32)
+                else:
+                    qg = qv.reshape(hkv, rep, dh)
+                    kg = k.reshape(hkv, csp, dh)
+                    s = jax.lax.dot_general(
+                        qg, kg, (((2,), (2,)), ((0,), (0,))),
+                        preferred_element_type=jnp.float32)
+                    s = s.reshape(1, hq, csp)
+                s = s * scale
+                pos = c * bs + pair * jax.lax.broadcasted_iota(
+                    jnp.int32, s.shape, 2) + h
+                ss.append(jnp.where(pos <= idx_i, s, _NEG))
+            m_prev = m_ref[...]                      # [1, Hq]
+            m_new = m_prev
+            for s in ss:
+                m_new = jnp.maximum(m_new, s.max(-1))
+            corr = jnp.exp(m_prev - m_new)
+            l_new = l_ref[...] * corr
+            acc = acc_ref[...] * corr[:, :, None]
+            for h, s in enumerate(ss):
+                p = jnp.exp(s - m_new[:, :, None])
+                l_new = l_new + p.sum(-1)
+                v = vc[..., h * dh:(h + 1) * dh]
+                if rep == 1:
+                    pb_ = p[:, :, :, None].astype(v.dtype)
+                    pv = jnp.sum(pb_ * v, 2, dtype=jnp.float32)
+                else:
+                    pg = p.reshape(hkv, rep, csp).astype(v.dtype)
+                    vg = v.reshape(hkv, csp, dh)
+                    pv = jax.lax.dot_general(
+                        pg, vg, (((2,), (1,)), ((0,), (0,))),
+                        preferred_element_type=jnp.float32)
+                    pv = pv.reshape(1, hq, dh)
+                acc = acc + pv
+            l_ref[...] = l_new
+            acc_ref[...] = acc
+            m_ref[...] = m_new
+            return 0
+
+        jax.lax.fori_loop(0, nblk, body, 0)
+        l_safe = jnp.maximum(l_ref[...], 1e-20)
+        attn_ref[pl.ds(i, 1)] = (acc_ref[...] / l_safe[:, :, None]) \
+            .astype(attn_ref.dtype)
+
+    # drain the async write-back before the kernel exits
+    for i in range(b):
+        pltpu.make_async_copy(
+            kwin.at[pl.ds(i, 1)],
+            k_ref.at[layer, pl.ds(pbs[i], 1), :, pl.ds(w0s[i], 8), :],
+            wsem.at[0, i]).wait()
+        pltpu.make_async_copy(
+            vwin.at[pl.ds(i, 1)],
+            v_ref.at[layer, pl.ds(pbs[i], 1), :, pl.ds(w0s[i], 8), :],
+            wsem.at[1, i]).wait()
+
+
+def fused_block_decode_step(q: jax.Array, k_pool: jax.Array,
+                            v_pool: jax.Array, k_new: jax.Array,
+                            v_new: jax.Array, layer, idx, block_table, *,
+                            scale: Optional[float] = None,
+                            interpret: Optional[bool] = None):
+    """One decode layer-step against the BLOCK-PAGED pool (ISSUE 6).
+
+    q:             [B, 1, Hq, Dh]   — the new token's queries
+    k_pool/v_pool: [L, N+1, Hkv, bs(/pair), Dh(*pair)] block pools
+                   (serving/kv_blocks.BlockKVPool; last row = garbage)
+    k_new/v_new:   [B, 1, Hkv, Dh]  — the new token's K/V (unwritten)
+    layer:         scalar int32
+    idx:           [B] int32 per-slot valid lengths
+    block_table:   [B, MB] int32 — TRACED data, one compiled program
+                   serves every block assignment.
+
+    Returns ``(attn [B, 1, Hq, Dh], k_pool, v_pool)`` with the pools
+    updated in place (the returned pools alias the inputs).
+    """
+    b, t, hq, dh = q.shape
+    assert t == 1, "fused_block_decode_step is the single-token path"
+    l, n_phys, hkv, bsp, d_last = k_pool.shape
+    pair = d_last // dh
+    bs = bsp * pair
+    assert supports_block(hq, hkv, bs, dh), (hq, hkv, bs, dh)
+    want_pair = 128 // dh if dh < 128 else 1
+    assert pair == want_pair, (d_last, dh)  # router checks kv_pack_factor
+    sc = float(scale) if scale is not None else dh ** -0.5
+
+    qf = q.transpose(0, 2, 1, 3)                   # [B, Hq, 1, Dh]
+    kn = k_new.transpose(0, 2, 1, 3)               # [B, Hkv, 1, Dh]
+    vn = v_new.transpose(0, 2, 1, 3)
+    if pair > 1:
+        kn = jnp.concatenate([kn] * pair, axis=-1)
+        vn = jnp.concatenate([vn] * pair, axis=-1)
+    layer_a = jnp.asarray(layer, jnp.int32).reshape(1)
+    idx_a = jnp.asarray(idx, jnp.int32).reshape(-1)
+    assert idx_a.shape[0] == b, (idx_a.shape, b)
+    tbl = jnp.asarray(block_table, jnp.int32)
+    mb = tbl.shape[1]
+
+    kernel = functools.partial(
+        _block_kernel, b=b, mb=mb, csp=bsp, hq=hq, hkv=hkv, dh=dh,
+        pair=pair, scale=sc)
+    attn, k_out, v_out = pl.pallas_call(
+        kernel,
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),   # layer
+            pl.BlockSpec(memory_space=pltpu.SMEM),   # idx
+            pl.BlockSpec(memory_space=pltpu.SMEM),   # block table
+            pl.BlockSpec(memory_space=pltpu.VMEM),   # q
+            pl.BlockSpec(memory_space=pltpu.VMEM),   # k_new
+            pl.BlockSpec(memory_space=pltpu.VMEM),   # v_new
+            pl.BlockSpec(memory_space=pl.ANY),       # k_pool (aliased)
+            pl.BlockSpec(memory_space=pl.ANY),       # v_pool (aliased)
+        ],
+        out_specs=[
+            pl.BlockSpec(memory_space=pltpu.VMEM),
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec(memory_space=pl.ANY),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b, hq, dh), q.dtype),
+            jax.ShapeDtypeStruct(k_pool.shape, k_pool.dtype),
+            jax.ShapeDtypeStruct(v_pool.shape, v_pool.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((2, 1, hkv, bsp, dh * pair), k_pool.dtype),
+            pltpu.VMEM((2, 1, hkv, bsp, dh * pair), v_pool.dtype),
+            pltpu.VMEM((b, hkv, 8, dh * pair), k_pool.dtype),  # write window
+            pltpu.VMEM((b, hkv, 8, dh * pair), v_pool.dtype),
+            pltpu.VMEM((1, hq), jnp.float32),                  # running max
+            pltpu.VMEM((1, hq), jnp.float32),                  # running sum
+            pltpu.VMEM((1, hq, dh), jnp.float32),              # accumulator
+            pltpu.SemaphoreType.DMA((2, b)),                   # write sems
+            pltpu.SemaphoreType.DMA((2, 2)),                   # read sems
+        ],
+        input_output_aliases={6: 1, 7: 2},
+        compiler_params=_compiler_params(),
+        interpret=(jax.default_backend() != "tpu" if interpret is None
+                   else interpret),
+    )(layer_a, idx_a, tbl, qf, kn, vn, k_pool, v_pool)
+    return attn[:, None], k_out, v_out
+
+
 def fused_decode_step(q: jax.Array, k_full: jax.Array, v_full: jax.Array,
                       k_new: jax.Array, v_new: jax.Array,
                       layer, idx, *, scale: Optional[float] = None,

@@ -1,20 +1,29 @@
 """Continuous-batching serving runtime (ISSUE 2).
 
-Iteration-level scheduling (Orca) over a slot-paged persistent KV cache
-(vLLM's paging specialized to XLA static shapes) with recompile-free
-prefill length buckets: the whole serving loop runs ``len(buckets) + 1``
-compiled programs regardless of arrival pattern. See serving/engine.py.
+Iteration-level scheduling (Orca) over a paged persistent KV cache with
+recompile-free prefill length buckets: the whole serving loop runs
+``len(buckets) + 1`` compiled programs regardless of arrival pattern.
+Two cache layouts: the slot-paged default (vLLM's paging specialized to
+XLA static shapes, serving/kv_slots.py), and the block-paged pool with
+radix-tree prefix sharing + copy-on-write (ISSUE 6 — vLLM PagedAttention
+block tables + SGLang RadixAttention, serving/kv_blocks.py +
+serving/radix.py, ``ServingEngine(prefix_cache=True)``). See
+serving/engine.py.
 """
 
 from deepspeed_tpu.serving.engine import ServingEngine
+from deepspeed_tpu.serving.kv_blocks import BlockKVPool
 from deepspeed_tpu.serving.kv_slots import SlotKVCache
+from deepspeed_tpu.serving.radix import PrefixCache
 from deepspeed_tpu.serving.scheduler import (Request, RequestResult,
                                              SlotScheduler, pick_bucket,
                                              poisson_trace,
+                                             shared_prefix_trace,
                                              templated_trace)
 from deepspeed_tpu.serving.speculative import (SpeculativeConfig,
                                                ngram_propose)
 
-__all__ = ["ServingEngine", "SlotKVCache", "SlotScheduler", "Request",
-           "RequestResult", "SpeculativeConfig", "ngram_propose",
-           "pick_bucket", "poisson_trace", "templated_trace"]
+__all__ = ["ServingEngine", "SlotKVCache", "BlockKVPool", "PrefixCache",
+           "SlotScheduler", "Request", "RequestResult", "SpeculativeConfig",
+           "ngram_propose", "pick_bucket", "poisson_trace",
+           "shared_prefix_trace", "templated_trace"]

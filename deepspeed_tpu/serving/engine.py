@@ -35,7 +35,9 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
 
+from deepspeed_tpu.serving.kv_blocks import BlockKVPool
 from deepspeed_tpu.serving.kv_slots import SlotKVCache
+from deepspeed_tpu.serving.radix import PrefixCache
 from deepspeed_tpu.serving.scheduler import (Request, RequestResult,
                                              SlotScheduler, pick_bucket)
 from deepspeed_tpu.serving.speculative import (AdaptiveK, DraftModelDrafter,
@@ -101,6 +103,19 @@ class ServingEngine:
         like prefill is by length, so the zero-recompile guarantee
         holds; slot capacity reserves ``k_max`` lookahead rows for the
         pre-acceptance draft writes.
+    prefix_cache: block-paged KV with radix prefix sharing (ISSUE 6).
+        False (default) keeps the slot-paged cache. True switches the
+        KV store to a :class:`~deepspeed_tpu.serving.kv_blocks.BlockKVPool`
+        fronted by a :class:`~deepspeed_tpu.serving.radix.PrefixCache`:
+        on admit the request's prompt is matched against the radix index
+        and only the UNMATCHED suffix is prefilled (bucketed by suffix
+        length); on finish the prompt's blocks are donated to the index
+        instead of freed. Admission accounts in free pool BLOCKS (no
+        fragmentation); ``block_size``/``num_blocks`` size the pool
+        (defaults: 16-token blocks, worst-case slot parity). Outputs are
+        bit-identical to the slot-paged engine (greedy, with and without
+        speculation — pinned by tests), and the zero-recompile invariant
+        holds: block tables are traced data, never shapes.
     """
 
     def __init__(self, engine, *, num_slots: int = 8, max_len: int = 1024,
@@ -109,7 +124,9 @@ class ServingEngine:
                  do_sample: bool = False, temperature: float = 1.0,
                  top_k: int = 0, top_p: float = 1.0,
                  time_fn: Optional[Callable[[], float]] = None,
-                 telemetry=True, speculative=None):
+                 telemetry=True, speculative=None,
+                 prefix_cache: bool = False, block_size: int = 16,
+                 num_blocks: Optional[int] = None):
         self.engine = engine
         model = engine.module
         mcfg = getattr(model, "config", None)
@@ -120,8 +137,14 @@ class ServingEngine:
             raise ValueError(
                 f"serving max_len {max_len} exceeds the model's max_seq_len "
                 f"{model_max} (position table size)")
-        self.cache = SlotKVCache(model, num_slots, max_len,
-                                 dtype=engine.dtype)
+        if prefix_cache:
+            self.cache = BlockKVPool(model, num_slots, max_len,
+                                     block_size=block_size,
+                                     num_blocks=num_blocks,
+                                     dtype=engine.dtype)
+        else:
+            self.cache = SlotKVCache(model, num_slots, max_len,
+                                     dtype=engine.dtype)
         # canonical placement: freshly-allocated carry arrays are
         # uncommitted SingleDeviceSharding while jitted-program outputs
         # carry the mesh's NamedSharding — the jit cache keys on that, so
@@ -167,9 +190,17 @@ class ServingEngine:
         self._run_t0: Optional[float] = None
         # programs (built lazily, counted by tests): bucket -> prefill fn
         self._prefill: Dict[int, Callable] = {}
-        self._decode = engine.slot_decode_program(
-            num_slots, max_len, pad_token_id=pad_token_id,
-            **self._sample_kw)
+        self._copy_fn: Optional[Callable] = None
+        if prefix_cache:
+            self._decode = engine.block_decode_program(
+                num_slots, self.cache.max_blocks_per_slot,
+                pad_token_id=pad_token_id, **self._sample_kw)
+            self._copy_fn = engine.block_copy_program(
+                self.cache.num_blocks, block_size)
+        else:
+            self._decode = engine.slot_decode_program(
+                num_slots, max_len, pad_token_id=pad_token_id,
+                **self._sample_kw)
         # ---- speculative decoding (ISSUE 4)
         self.spec = normalize_speculative(speculative)
         self._verify: Dict[int, Callable] = {}     # k-bucket -> verify fn
@@ -194,6 +225,10 @@ class ServingEngine:
         # metrics
         self.decode_steps = 0
         self.prefill_calls = 0
+        # prompt tokens actually run through a prefill program (suffix
+        # tokens in prefix-cache mode — the bench's "prefill tokens
+        # computed" axis; radix-matched tokens never hit the device)
+        self.prefill_tokens_computed = 0
         self.tokens_generated = 0
         self._active_slot_iterations = 0
         # speculative accounting (spec mode only; bench + telemetry)
@@ -212,14 +247,24 @@ class ServingEngine:
             self.telemetry = get_registry()
         else:
             self.telemetry = telemetry or None
+        # radix prefix index over the block pool (ISSUE 6) — created
+        # after telemetry so its hit/miss/COW/eviction counters land in
+        # the same registry as the serving histograms
+        self.prefix = (PrefixCache(self.cache, registry=self.telemetry)
+                       if prefix_cache else None)
         log_dist(f"ServingEngine: slots={num_slots} max_len={max_len} "
                  f"buckets={self.buckets} cache={self.cache!r}", ranks=[0])
 
     # -------------------------------------------------------------- programs
     def _prefill_fn(self, bucket: int):
         if bucket not in self._prefill:
-            self._prefill[bucket] = self.engine.slot_prefill_program(
-                bucket, self.num_slots, self.max_len, **self._sample_kw)
+            if self.prefix is not None:
+                self._prefill[bucket] = self.engine.block_prefill_program(
+                    bucket, self.num_slots, self.cache.max_blocks_per_slot,
+                    **self._sample_kw)
+            else:
+                self._prefill[bucket] = self.engine.slot_prefill_program(
+                    bucket, self.num_slots, self.max_len, **self._sample_kw)
         return self._prefill[bucket]
 
     def _verify_fn(self, kb: int):
@@ -228,9 +273,14 @@ class ServingEngine:
         adaptive-k transitions never compile (the spec analog of the
         prefill length buckets)."""
         if kb not in self._verify:
-            self._verify[kb] = self.engine.slot_verify_program(
-                self.num_slots, self.max_len, kb,
-                pad_token_id=self.pad_token_id, **self._sample_kw)
+            if self.prefix is not None:
+                self._verify[kb] = self.engine.block_verify_program(
+                    self.num_slots, self.cache.max_blocks_per_slot, kb,
+                    pad_token_id=self.pad_token_id, **self._sample_kw)
+            else:
+                self._verify[kb] = self.engine.slot_verify_program(
+                    self.num_slots, self.max_len, kb,
+                    pad_token_id=self.pad_token_id, **self._sample_kw)
         return self._verify[kb]
 
     @property
@@ -238,8 +288,11 @@ class ServingEngine:
         """Compiled serving programs built so far (== len(buckets) + 1
         after warmup without speculation — the no-recompile tests pin
         this; speculation adds one verify program per k-bucket plus the
-        draft-model programs)."""
+        draft-model programs; the prefix cache adds exactly one COW
+        block-copy program)."""
         n = len(self._prefill) + 1 + len(self._verify)
+        if self._copy_fn is not None:
+            n += 1
         if self._drafter is not None:
             n += len(self._drafter.program_cache_sizes())
         return n
@@ -255,6 +308,8 @@ class ServingEngine:
             out[f"prefill_{b}"] = fn._cache_size()
         for kb, fn in self._verify.items():
             out[f"verify_{kb}"] = fn._cache_size()
+        if self._copy_fn is not None:
+            out["block_copy"] = self._copy_fn._cache_size()
         if self._drafter is not None:
             out.update(self._drafter.program_cache_sizes())
         return out
@@ -270,25 +325,42 @@ class ServingEngine:
         if self._warm:
             return
         eng = self.engine
+        paged = self.prefix is not None
         for _ in range(2):
             for b in self.buckets:
                 ids = jnp.zeros((1, b), jnp.int32)
-                out = self._prefill_fn(b)(
-                    eng.params, *self.cache.carry(), ids, np.int32(0),
-                    np.int32(1), self._temp, self._zero_key)
+                if paged:
+                    # sentinel table row: the dummy prefill's writes land
+                    # in the pool's garbage block, never a real one
+                    out = self._prefill_fn(b)(
+                        eng.params, *self.cache.carry(), ids,
+                        self.cache.table_row(0), np.int32(0), np.int32(0),
+                        np.int32(1), self._temp, self._zero_key)
+                else:
+                    out = self._prefill_fn(b)(
+                        eng.params, *self.cache.carry(), ids, np.int32(0),
+                        np.int32(1), self._temp, self._zero_key)
                 self.cache.update(*out[:3])
             toks = np.zeros((self.num_slots,), np.int32)
             active = np.zeros((self.num_slots,), bool)
             out = self._decode(eng.params, *self.cache.carry(),
+                               *self._table_args(),
                                jnp.asarray(toks), jnp.asarray(active),
                                self._temp, self._zero_key)
             self.cache.update(*out[:3])
+            if paged:
+                # COW copy program: garbage row onto itself is a no-op
+                k, v = self._copy_fn(self.cache.k, self.cache.v,
+                                     np.int32(self.cache.sentinel),
+                                     np.int32(self.cache.sentinel))
+                self.cache.update_kv(k, v)
             if self.spec is not None:
                 zeros = jnp.zeros((self.num_slots,), jnp.int32)
                 for kb in self.spec.k_buckets:
                     blk = jnp.zeros((self.num_slots, kb + 1), jnp.int32)
                     out = self._verify_fn(kb)(
-                        eng.params, *self.cache.carry(), blk, zeros,
+                        eng.params, *self.cache.carry(),
+                        *self._table_args(), blk, zeros,
                         jnp.asarray(active), self._temp, self._zero_key)
                     self.cache.update(*out[:3])
                     if isinstance(self._drafter, DraftModelDrafter):
@@ -301,6 +373,19 @@ class ServingEngine:
             self.cache.lengths = self._canon(
                 jnp.zeros((self.num_slots,), jnp.int32))
         self._warm = True
+
+    def _table_args(self) -> tuple:
+        """Extra traced operand for the block-paged programs: the full
+        [B, MB] block table from the host tables (empty in slot-paged
+        mode). ``table_array()`` caches the device mirror and only
+        re-uploads after ``PrefixCache.admit``/``finish`` call
+        ``invalidate_tables()`` — any new code path that mutates
+        ``pool.tables`` must invalidate too. Same shape/dtype every
+        call — traced DATA, so remapping blocks between steps reuses
+        the compiled programs."""
+        if self.prefix is None:
+            return ()
+        return (self.cache.table_array(),)
 
     # ----------------------------------------------------------------- queue
     def submit(self, request: Request) -> None:
@@ -353,6 +438,11 @@ class ServingEngine:
         st.result.finish_reason = reason
         self._slots[slot] = None
         self.scheduler.release(slot)
+        if self.prefix is not None:
+            # insert-on-finish: donate the prompt's full blocks to the
+            # radix index (one cached prefill serves every future match),
+            # free the rest, park the table row at the sentinel
+            self.prefix.finish(slot)
         if self.telemetry is not None:
             res = st.result
             reg = self.telemetry
@@ -383,42 +473,92 @@ class ServingEngine:
             return self._finish(slot, now, "length")
         return None
 
+    def _prefix_fits(self, req: Request) -> bool:
+        """Block-granular admission predicate (scheduler ``fits`` hook):
+        the request's UNMATCHED block demand — prompt + max_new +
+        speculative lookahead, minus radix-matched full blocks — must be
+        servable from free + evictable pool blocks."""
+        return self.prefix.fits(
+            req.prompt,
+            len(req.prompt) + req.max_new_tokens + self._lookahead)
+
     def _admit(self, now: float) -> List[RequestResult]:
         """Prefill arrived requests into free slots (may finish a
-        1-token request immediately)."""
+        1-token request immediately).
+
+        Prefix-cache mode admits ONE request per scheduler call (each
+        admission consumes pool blocks the next ``fits`` check must
+        see), matches the prompt against the radix index, pins + names
+        the matched block chain in the slot's table, runs the COW fork
+        copies, and prefills only the unmatched suffix — bucketed by
+        SUFFIX length, so a long shared system prompt with a short
+        unique tail prefills in the smallest bucket."""
         finished = []
         eng = self.engine
-        for req, slot in self.scheduler.admit(now):
-            plen = len(req.prompt)
-            bucket = pick_bucket(plen, self.buckets)
-            ids = np.full((1, bucket), self.pad_token_id, np.int32)
-            ids[0, :plen] = np.asarray(req.prompt, np.int32)
-            with jax.profiler.TraceAnnotation("dstpu/serving_prefill"):
-                out = self._prefill_fn(bucket)(
-                    eng.params, *self.cache.carry(), jnp.asarray(ids),
-                    np.int32(slot), np.int32(plen), self._temp,
-                    self._next_rng())
-                self.cache.update(*out[:3])
-                tok = int(jax.device_get(out[3]))
-            self.prefill_calls += 1
-            self.tokens_generated += 1
-            res = RequestResult(rid=req.rid, prompt_len=plen,
-                                tokens=[tok], arrival_time=req.arrival_time,
-                                admitted_time=now,
-                                first_token_time=self._now(now))
-            if self.telemetry is not None:
-                reg = self.telemetry
-                reg.counter("serving/prefills").inc()
-                reg.histogram("serving/queue_wait_ms").observe(
-                    max(now - req.arrival_time, 0.0) * 1e3)
-                reg.histogram("serving/ttft_ms").observe(
-                    max(res.first_token_time - req.arrival_time, 0.0) * 1e3)
-            self._slots[slot] = _SlotState(req, res, tok)
-            if self._adaptive is not None:
-                self._adaptive.reset_slot(slot)
-            done = self._maybe_finish(slot, now)
-            if done is not None:
-                finished.append(done)
+        while True:
+            if self.prefix is not None:
+                pairs = self.scheduler.admit(now, fits=self._prefix_fits,
+                                             limit=1)
+            else:
+                pairs = self.scheduler.admit(now)
+            if not pairs:
+                break
+            for req, slot in pairs:
+                plen = len(req.prompt)
+                start = 0
+                with jax.profiler.TraceAnnotation("dstpu/serving_prefill"):
+                    if self.prefix is not None:
+                        total = (plen + req.max_new_tokens
+                                 + self._lookahead)
+                        start, copies = self.prefix.admit(
+                            slot, req.prompt, total)
+                        for src, dst in copies:
+                            k, v = self._copy_fn(
+                                self.cache.k, self.cache.v,
+                                np.int32(src), np.int32(dst))
+                            self.cache.update_kv(k, v)
+                    suffix = req.prompt[start:]
+                    bucket = pick_bucket(len(suffix), self.buckets)
+                    ids = np.full((1, bucket), self.pad_token_id, np.int32)
+                    ids[0, :len(suffix)] = np.asarray(suffix, np.int32)
+                    if self.prefix is not None:
+                        out = self._prefill_fn(bucket)(
+                            eng.params, *self.cache.carry(),
+                            jnp.asarray(ids), self.cache.table_row(slot),
+                            np.int32(slot), np.int32(start),
+                            np.int32(len(suffix)), self._temp,
+                            self._next_rng())
+                    else:
+                        out = self._prefill_fn(bucket)(
+                            eng.params, *self.cache.carry(),
+                            jnp.asarray(ids), np.int32(slot),
+                            np.int32(plen), self._temp, self._next_rng())
+                    self.cache.update(*out[:3])
+                    tok = int(jax.device_get(out[3]))
+                self.prefill_calls += 1
+                self.prefill_tokens_computed += len(suffix)
+                self.tokens_generated += 1
+                res = RequestResult(rid=req.rid, prompt_len=plen,
+                                    tokens=[tok],
+                                    arrival_time=req.arrival_time,
+                                    admitted_time=now,
+                                    first_token_time=self._now(now))
+                if self.telemetry is not None:
+                    reg = self.telemetry
+                    reg.counter("serving/prefills").inc()
+                    reg.histogram("serving/queue_wait_ms").observe(
+                        max(now - req.arrival_time, 0.0) * 1e3)
+                    reg.histogram("serving/ttft_ms").observe(
+                        max(res.first_token_time - req.arrival_time, 0.0)
+                        * 1e3)
+                self._slots[slot] = _SlotState(req, res, tok)
+                if self._adaptive is not None:
+                    self._adaptive.reset_slot(slot)
+                done = self._maybe_finish(slot, now)
+                if done is not None:
+                    finished.append(done)
+            if self.prefix is None:
+                break
         return finished
 
     def step(self, now: Optional[float] = None) -> List[RequestResult]:
@@ -460,6 +600,7 @@ class ServingEngine:
         t0 = time.perf_counter()
         with jax.profiler.TraceAnnotation("dstpu/serving_decode"):
             out = self._decode(self.engine.params, *self.cache.carry(),
+                               *self._table_args(),
                                jnp.asarray(toks), jnp.asarray(active),
                                self._temp, self._next_rng())
             self.cache.update(*out[:3])
@@ -543,6 +684,7 @@ class ServingEngine:
         with jax.profiler.TraceAnnotation("dstpu/serving_verify"):
             out = self._verify_fn(kb)(
                 self.engine.params, *self.cache.carry(),
+                *self._table_args(),
                 jnp.asarray(tokens), jnp.asarray(lens),
                 jnp.asarray(active), self._temp, self._next_rng())
             self.cache.update(*out[:3])
@@ -654,6 +796,15 @@ class ServingEngine:
             reg.gauge("serving/mean_batch_fill_ratio").set(
                 self._active_slot_iterations /
                 (self.decode_steps * self.num_slots))
+        if self.prefix is not None:
+            # cumulative cache effectiveness (counters already streamed
+            # per admit/evict/fork by PrefixCache); occupancy covers
+            # running slots' blocks + radix-cached blocks
+            reg.gauge("serving/prefix_hit_rate").set(self.prefix.hit_rate())
+            reg.gauge("serving/prefix_pool_occupancy").set(
+                self.cache.occupancy())
+            reg.gauge("serving/prefix_cached_blocks").set(
+                self.prefix.cached_blocks())
         if self.spec is not None:
             if self.spec_drafted_tokens:
                 reg.gauge("serving/spec_acceptance_rate").set(

@@ -1,0 +1,180 @@
+"""Block-paged persistent KV pool for prefix-sharing serving (ISSUE 6).
+
+The vLLM PagedAttention layout on top of the slot-paged design of
+serving/kv_slots.py: instead of each slot owning one contiguous
+``max_len`` KV region, the cache is ONE global pool of fixed-size token
+blocks ``[L, N_blocks(+1), Hkv, bs(/pair), Dh(*pair)]`` (same head-major,
+token-pair-packed layout as ops/attention.alloc_kv_cache — the pool is
+literally ``model.init_cache(num_blocks + 1, block_size)`` with the
+batch dim repurposed as the block dim), and each slot's logical KV
+space is a fixed-width BLOCK-TABLE row ``[max_blocks_per_slot]`` naming
+which pool blocks hold its tokens: logical position ``p`` lives in pool
+block ``table[slot, p // bs]``, row ``p % bs``.
+
+What that buys over whole-slot pages:
+
+  * **Prefix sharing**: two slots whose prompts share a prefix can name
+    the SAME pool blocks in their tables — one cached prefill serves
+    every request that matches it (serving/radix.py owns the matching);
+  * **No fragmentation**: admission accounts in free blocks, not
+    contiguous rows — any ``ceil(need / bs)`` free blocks serve any
+    request;
+  * **Zero recompiles, still**: the table is TRACED DATA (int32
+    ``[B, MB]``), never a shape — remapping blocks between steps reuses
+    the same compiled programs (the PR-2 invariant, pinned by tests).
+
+Sentinel row: the pool allocates ``num_blocks + 1`` physical rows and
+reserves the LAST one (index ``num_blocks``) as a permanent garbage
+block that is never handed out. Freed/unallocated table entries park at
+the sentinel, so inactive slots' masked writes land in (and their
+gathers read from) a row nobody owns — no predication in the fused
+Pallas block kernel, no ``mode=...`` corner cases corrupting a block
+that prefix sharing may meanwhile have pinned for someone else.
+
+Host-side bookkeeping (free list, per-block pin refcounts, the tables
+themselves) is plain numpy — the device only ever sees the pool arrays,
+the per-slot length vector, and the table as a traced operand.
+"""
+
+from __future__ import annotations
+
+from typing import List, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+
+class BlockKVPool:
+    """Owns the block-paged pool arrays + per-slot lengths + host-side
+    block accounting (free list, pin refcounts, block tables).
+
+    Pinning: ``ref[b]`` counts RUNNING SLOTS currently naming block
+    ``b`` through the radix index (shared prefix blocks). A slot's own
+    private blocks are tracked by the PrefixCache's per-slot records,
+    not refcounts; radix-cached blocks with ``ref == 0`` are the LRU
+    eviction pool. ``free_block`` refuses to free a pinned block.
+    """
+
+    def __init__(self, model, num_slots: int, max_len: int, *,
+                 block_size: int = 16, num_blocks: int = None, dtype=None):
+        if num_slots < 1:
+            raise ValueError(f"num_slots must be >= 1, got {num_slots}")
+        if block_size < 1:
+            raise ValueError(f"block_size must be >= 1, got {block_size}")
+        if max_len % block_size:
+            raise ValueError(
+                f"max_len {max_len} must be a multiple of block_size "
+                f"{block_size} (the block table is fixed-width)")
+        self.block_size = block_size
+        self.max_len = max_len
+        self.num_slots = num_slots
+        self.max_blocks_per_slot = max_len // block_size
+        if num_blocks is None:
+            # worst-case parity with SlotKVCache: every slot can hold a
+            # full max_len request with nothing shared; anything the
+            # radix index caches on top lives in whatever is left over
+            num_blocks = num_slots * self.max_blocks_per_slot
+        if num_blocks < self.max_blocks_per_slot:
+            raise ValueError(
+                f"num_blocks {num_blocks} below max_blocks_per_slot "
+                f"{self.max_blocks_per_slot}: a single full-length request "
+                f"could never be admitted")
+        self.num_blocks = num_blocks
+        self.sentinel = num_blocks          # the extra physical garbage row
+        base = model.init_cache(num_blocks + 1, block_size, dtype=dtype)
+        self.k = base["k"]
+        self.v = base["v"]
+        self.lengths = jnp.zeros((num_slots,), jnp.int32)
+        head_dim = model.config.head_dim
+        self.pair = self.k.shape[4] // head_dim
+        # host-side accounting
+        self.tables = np.full((num_slots, self.max_blocks_per_slot),
+                              self.sentinel, np.int32)
+        self._free: List[int] = list(range(num_blocks - 1, -1, -1))
+        self.ref = np.zeros((num_blocks,), np.int64)
+        self._tables_dev = None  # device mirror, see table_array()
+
+    # ------------------------------------------------------------- carry
+    def carry(self) -> Tuple:
+        """(k, v, lengths) operands for a serving program call (the block
+        table rides separately — it is rebuilt from the host tables each
+        call, see :meth:`table_array`)."""
+        return self.k, self.v, self.lengths
+
+    def update(self, k, v, lengths) -> None:
+        self.k, self.v, self.lengths = k, v, lengths
+
+    def update_kv(self, k, v) -> None:
+        self.k, self.v = k, v
+
+    def table_array(self) -> jnp.ndarray:
+        """The full [num_slots, MB] block table as a traced int32 operand.
+        Cached on device between calls — tables only change at
+        admit/finish (PrefixCache calls :meth:`invalidate_tables`), so
+        steady-state decode steps reuse one upload instead of paying a
+        host->device transfer per iteration."""
+        if self._tables_dev is None:
+            self._tables_dev = jnp.asarray(self.tables)
+        return self._tables_dev
+
+    def invalidate_tables(self) -> None:
+        """Drop the device mirror after a host-side table edit."""
+        self._tables_dev = None
+
+    def table_row(self, slot: int) -> jnp.ndarray:
+        """One slot's [1, MB] table row (the single-request prefill
+        program's addressing operand)."""
+        return jnp.asarray(self.tables[slot:slot + 1])
+
+    # ------------------------------------------------------------ blocks
+    def blocks_for(self, tokens: int) -> int:
+        return -(-tokens // self.block_size)
+
+    @property
+    def free_count(self) -> int:
+        return len(self._free)
+
+    def alloc_block(self) -> int:
+        if not self._free:
+            raise RuntimeError("block pool exhausted (admission should have "
+                               "evicted or deferred — this is a bug)")
+        return self._free.pop()
+
+    def free_block(self, block: int) -> None:
+        if self.ref[block] != 0:
+            raise ValueError(
+                f"freeing block {block} with refcount {self.ref[block]} "
+                f"(still pinned by a running slot)")
+        self._free.append(block)
+
+    def pin(self, block: int) -> None:
+        self.ref[block] += 1
+
+    def unpin(self, block: int) -> None:
+        if self.ref[block] <= 0:
+            raise ValueError(f"unpin of unpinned block {block}")
+        self.ref[block] -= 1
+
+    # ------------------------------------------------------------ sizing
+    def capacity_for(self, prompt_len: int, max_new_tokens: int,
+                     lookahead: int = 0) -> bool:
+        """Whether the fixed-width block table can hold the request end
+        to end (prompt + every generated token + the speculative
+        lookahead reserve — same contract as SlotKVCache.capacity_for,
+        the bound is just rounded up to whole blocks)."""
+        return (self.blocks_for(prompt_len + max_new_tokens + lookahead)
+                <= self.max_blocks_per_slot)
+
+    def hbm_bytes(self) -> int:
+        return int(self.k.size * self.k.dtype.itemsize
+                   + self.v.size * self.v.dtype.itemsize)
+
+    def occupancy(self) -> float:
+        """Fraction of real (non-sentinel) pool blocks currently handed
+        out (running slots' blocks + radix-cached blocks)."""
+        return 1.0 - len(self._free) / max(self.num_blocks, 1)
+
+    def __repr__(self):
+        return (f"BlockKVPool(blocks={self.num_blocks}x{self.block_size}t, "
+                f"slots={self.num_slots}, mb={self.max_blocks_per_slot}, "
+                f"pair={self.pair}, hbm={self.hbm_bytes() / 1e6:.1f}MB)")

@@ -112,12 +112,25 @@ class SlotScheduler:
         return self._waiting[0].arrival_time
 
     # -------------------------------------------------------- scheduling
-    def admit(self, now: float) -> List[Tuple[Request, int]]:
+    def admit(self, now: float, fits=None,
+              limit: Optional[int] = None) -> List[Tuple[Request, int]]:
         """Pop (request, slot) pairs: arrived requests into free slots,
-        FIFO order, called between decode iterations."""
+        FIFO order, called between decode iterations.
+
+        ``fits(request) -> bool`` gates admission on a resource the
+        scheduler does not own — the block-paged engine (ISSUE 6)
+        accounts in free KV-pool BLOCKS rather than whole slots, so a
+        free slot alone is not admissible. FIFO is preserved: a head
+        that does not fit blocks everything behind it (no later arrival
+        jumps the queue on block luck). ``limit`` caps admissions per
+        call — the block engine admits one at a time because each
+        admission consumes blocks the next ``fits`` check must see."""
         out: List[Tuple[Request, int]] = []
         while self._free and self._waiting \
-                and self._waiting[0].arrival_time <= now:
+                and self._waiting[0].arrival_time <= now \
+                and (limit is None or len(out) < limit):
+            if fits is not None and not fits(self._waiting[0]):
+                break
             slot = self._free.popleft()
             req = self._waiting.popleft()
             self.admissions_per_slot[slot] += 1
@@ -175,6 +188,36 @@ def templated_trace(rng, n_requests: int, *, rate: float,
         reqs.append(Request(
             rid=start_rid + i,
             prompt=patterns[int(rng.randint(len(patterns)))] * repeats,
+            max_new_tokens=max_new_tokens,
+            arrival_time=t))
+    return reqs
+
+
+def shared_prefix_trace(rng, n_requests: int, *, rate: float,
+                        prefix_len: int, suffix_lens: Sequence[int],
+                        max_new_tokens: int, vocab_size: int,
+                        n_prefixes: int = 2,
+                        start_rid: int = 0) -> List[Request]:
+    """Synthetic MULTI-TENANT trace for prefix caching (the ISSUE-6
+    bench + test workload): every prompt is one of ``n_prefixes`` long
+    shared system prompts (drawn per request — N tenants hammering the
+    same few templates) followed by a short UNIQUE user suffix drawn
+    from ``suffix_lens``. The redundancy profile of a production
+    few-shot / system-prompt API: the radix index should serve
+    ``prefix_len``-ish tokens of every request after the first per
+    template, leaving only the suffix to prefill. Poisson arrivals like
+    :func:`poisson_trace`."""
+    prefixes = [rng.randint(0, vocab_size, size=prefix_len).tolist()
+                for _ in range(max(n_prefixes, 1))]
+    reqs: List[Request] = []
+    t = 0.0
+    for i in range(n_requests):
+        t += float(rng.exponential(1.0 / rate)) if rate > 0 else 0.0
+        slen = int(rng.choice(list(suffix_lens)))
+        suffix = rng.randint(0, vocab_size, size=slen).tolist()
+        reqs.append(Request(
+            rid=start_rid + i,
+            prompt=prefixes[int(rng.randint(len(prefixes)))] + suffix,
             max_new_tokens=max_new_tokens,
             arrival_time=t))
     return reqs

@@ -16,4 +16,8 @@ grep -rqs "def test_" tests/unit/telemetry || { echo "tier-1: observability test
 # lossless-greedy/rejection-sampling/zero-recompile invariants ride
 # `-m 'not slow'` through tests/unit/serving/test_speculative.py
 grep -qs "def test_" tests/unit/serving/test_speculative.py || { echo "tier-1: speculative tests missing"; exit 1; }
+# likewise the prefix-cache suite (marker `prefix_cache`): block-paged
+# KV + radix COW-losslessness/eviction/zero-recompile invariants ride
+# `-m 'not slow'` through tests/unit/serving/test_prefix_cache.py
+grep -qs "def test_" tests/unit/serving/test_prefix_cache.py || { echo "tier-1: prefix-cache tests missing"; exit 1; }
 exit $rc

@@ -12,7 +12,14 @@ vs plain continuous batching on the same templated high-acceptance
 trace, reporting decode tokens/sec, p50/p95 latency, acceptance rate,
 tokens per verify invocation, and the zero-recompile check.
 
+``--prefix-cache {on,off}``: the ISSUE-6 comparison instead — block-paged
+KV with radix prefix sharing (on) vs the plain slot-paged engine (off is
+the default continuous-vs-static bench) on a shared-prefix multi-tenant
+trace, reporting TTFT p50/p95, prefill tokens computed, cache hit rate,
+COW/eviction counters, and the zero-recompile + lossless checks.
+
 Usage: python scripts/serve_continuous_bench.py [--speculative MODE]
+                                                [--prefix-cache {on,off}]
 Prints one JSON object (the matching entry of bench.py).
 """
 import argparse
@@ -31,15 +38,27 @@ def main():
                         "lookup or draft-model drafting) against plain "
                         "continuous batching instead of continuous-vs-"
                         "static")
+    p.add_argument("--prefix-cache", choices=("on", "off"), default="off",
+                   help="compare the block-paged radix prefix cache "
+                        "against the cache-off engine on a shared-prefix "
+                        "multi-tenant trace instead of continuous-vs-"
+                        "static")
     args = p.parse_args()
+    if args.prefix_cache == "on" and args.speculative != "off":
+        p.error("--prefix-cache on and --speculative are separate "
+                "comparisons; pass one or the other")
 
     import jax
 
-    from bench import _bench_continuous_serving, _bench_speculative_serving
+    from bench import (_bench_continuous_serving,
+                       _bench_prefix_cache_serving,
+                       _bench_speculative_serving)
 
     on_tpu = any(d.platform in ("tpu", "axon") or "TPU" in str(d.device_kind)
                  for d in jax.devices())
-    if args.speculative != "off":
+    if args.prefix_cache == "on":
+        out = _bench_prefix_cache_serving(on_tpu)
+    elif args.speculative != "off":
         out = _bench_speculative_serving(on_tpu, mode=args.speculative)
     else:
         out = _bench_continuous_serving(on_tpu)

@@ -87,6 +87,7 @@ def aggregate(records):
         "scalars": scalars,
         "events": events,
         "speculation": _speculation_summary(metrics),
+        "prefix_cache": _prefix_cache_summary(metrics),
         "n_records": len(records),
     }
 
@@ -121,6 +122,34 @@ def _speculation_summary(metrics):
     if h and h.get("count"):
         out["accepted_tokens_per_step_p50"] = h.get("p50")
         out["accepted_tokens_per_step_max"] = h.get("max")
+    return out
+
+
+def _prefix_cache_summary(metrics):
+    """Derived prefix-cache view (ISSUE 6) over the serving engine's raw
+    counters/gauges: tokens served from the radix index vs prefilled,
+    the resulting hit rate, COW fork / LRU eviction counts, and pool
+    occupancy. Empty dict when the run never enabled the cache."""
+    counters = metrics.get("counters", {})
+    gauges = metrics.get("gauges", {})
+    hit = counters.get("serving/prefix_hit_tokens")
+    miss = counters.get("serving/prefix_miss_tokens")
+    if hit is None and miss is None \
+            and gauges.get("serving/prefix_hit_rate") is None:
+        return {}
+    hit, miss = hit or 0, miss or 0
+    out = {
+        "hit_tokens": hit,
+        "miss_tokens": miss,
+        "hit_rate": round(hit / (hit + miss), 4) if hit + miss else 0.0,
+        "blocks_cowed": counters.get("serving/blocks_cowed", 0),
+        "blocks_evicted": counters.get("serving/blocks_evicted", 0),
+    }
+    for key, name in (("serving/prefix_hit_rate", "hit_rate_gauge"),
+                      ("serving/prefix_pool_occupancy", "pool_occupancy"),
+                      ("serving/prefix_cached_blocks", "cached_blocks")):
+        if gauges.get(key) is not None:
+            out[name] = gauges[key]
     return out
 
 
@@ -171,6 +200,9 @@ def render(agg):
     _table("scalars", ("tag", "n", "last", "min", "mean", "max"), srows, out)
     _table("speculation", ("metric", "value"),
            [(k, _fmt(v)) for k, v in agg.get("speculation", {}).items()],
+           out)
+    _table("prefix_cache", ("metric", "value"),
+           [(k, _fmt(v)) for k, v in agg.get("prefix_cache", {}).items()],
            out)
     erows = [(k, e["count"],
               json.dumps(e["last"], default=str)[:60])

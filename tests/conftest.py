@@ -295,7 +295,8 @@ _QUICK_MODULES = (
 _OBSERVABILITY_MODULES = ("unit/monitor/", "unit/telemetry/",
                           "utils/test_timer", "utils/test_comms_logging")
 _LATE_MODULES = _OBSERVABILITY_MODULES + (
-    "unit/serving/test_speculative",)
+    "unit/serving/test_speculative",
+    "unit/serving/test_prefix_cache",)
 
 
 def pytest_collection_modifyitems(config, items):
