@@ -868,6 +868,153 @@ class InferenceEngine:
             self._compiled[key] = jax.jit(copy, donate_argnums=donate)
         return self._compiled[key]
 
+    # ----------------------------------------- SLO-aware serving programs
+    # (ISSUE 8, serving/engine.py): chunked prefill against the
+    # slot-paged cache, and the device halves of preemption KV
+    # swap-out/in for both cache modes. Same zero-recompile contract as
+    # every serving program: slot / start / length / block lists are
+    # traced DATA, so chunk counts and preemption patterns are invisible
+    # to the jit cache.
+
+    def slot_chunk_prefill_program(self, bucket_len: int, num_slots: int,
+                                   max_len: int, *, do_sample: bool = False,
+                                   top_k: int = 0, top_p: float = 1.0):
+        """Jitted mid-prompt CHUNK prefill against the slot-paged cache
+        (ISSUE 8): run ONE request's bucket-padded prompt chunk with the
+        slot's own cache row — the chunk's queries attend over the
+        slot's already-prefilled prefix (``start`` tokens, a traced
+        scalar) plus the chunk's own causal block, and its K/V scatter
+        in at ``start .. start+length`` through the per-slot vector
+        write path (ops/attention.write_kv_cache). The slot's row pair
+        is sliced out (ops/attention.extract_slot_kv), stepped as a
+        batch-1 cache, and written back. Slot/start/length are all
+        traced, so ONE compiled program per bucket serves every chunk of
+        every prompt — chunk COUNT is data, which is what lets long
+        prompts prefill in fixed-bucket-sized pieces interleaved with
+        decode steps without a single recompile (the block-paged mode
+        needs no new program: block_prefill_program's ``start`` operand
+        already is the chunk offset).
+
+        The returned token is the pick at the chunk's TRUE last
+        position — meaningful only on the FINAL chunk (the engine
+        discards it for intermediate chunks; the first generated token
+        of a chunked prompt exists only after the last chunk, which is
+        also when TTFT is stamped).
+
+        Signature: ``(params, k_slots, v_slots, lengths, ids[1, bucket],
+        slot, start, length, temp, rng) -> (k_slots, v_slots, lengths,
+        token)`` (cache operands donated on TPU)."""
+        from deepspeed_tpu.ops.attention import (extract_slot_kv,
+                                                 insert_slot_kv)
+
+        key = ("slot_chunk_pf", bucket_len, num_slots, max_len, do_sample,
+               top_k, float(top_p))
+        if key not in self._compiled:
+            model = self.module
+            pick = self._make_pick(do_sample, top_k, float(top_p))
+
+            def chunk(params, k_slots, v_slots, lengths, ids, slot, start,
+                      length, temp, rng):
+                k_row, v_row = extract_slot_kv(k_slots, v_slots, slot)
+                idx = jnp.reshape(jnp.asarray(start, jnp.int32), (1,))
+                cache = {"k": k_row, "v": v_row, "index": idx}
+                logits, cache = model.forward_with_cache(params, ids, cache)
+                k_slots, v_slots = insert_slot_kv(
+                    k_slots, v_slots, cache["k"], cache["v"], slot)
+                lengths = jax.lax.dynamic_update_index_in_dim(
+                    lengths, start + length, slot, 0)
+                last = jax.lax.dynamic_index_in_dim(
+                    logits, length - 1, 1, keepdims=False)       # [1, V]
+                return k_slots, v_slots, lengths, pick(last, temp, rng)[0]
+
+            donate = (1, 2, 3) if jax.default_backend() == "tpu" else ()
+            self._compiled[key] = jax.jit(chunk, donate_argnums=donate)
+        return self._compiled[key]
+
+    def slot_swap_out_program(self, num_slots: int, max_len: int):
+        """Jitted preemption swap-OUT for the slot-paged cache: slice
+        slot ``slot``'s full row pair out (the engine device_gets it
+        into the host swap buffer). Read-only — the cache operands are
+        NOT donated, the caller keeps using them.
+
+        Signature: ``(k_slots, v_slots, slot) -> (k_row, v_row)`` with
+        rows ``[L, 1, Hkv, S(/pair), Dh(*pair)]``."""
+        from deepspeed_tpu.ops.attention import extract_slot_kv
+
+        key = ("slot_swap_out", num_slots, max_len)
+        if key not in self._compiled:
+            self._compiled[key] = jax.jit(
+                lambda k, v, slot: extract_slot_kv(k, v, slot))
+        return self._compiled[key]
+
+    def slot_swap_in_program(self, num_slots: int, max_len: int):
+        """Jitted preemption swap-IN for the slot-paged cache: write a
+        host-uploaded row pair back into slot ``slot`` and restore its
+        valid length — after this the slot decodes exactly as if it had
+        never been preempted (bit-identical, pinned by tests).
+
+        Signature: ``(k_slots, v_slots, k_row, v_row, lengths, slot,
+        length) -> (k_slots, v_slots, lengths)`` (cache operands donated
+        on TPU)."""
+        from deepspeed_tpu.ops.attention import insert_slot_kv
+
+        key = ("slot_swap_in", num_slots, max_len)
+        if key not in self._compiled:
+            def swap_in(k_slots, v_slots, k_row, v_row, lengths, slot,
+                        length):
+                k_slots, v_slots = insert_slot_kv(
+                    k_slots, v_slots, k_row, v_row, slot)
+                lengths = jax.lax.dynamic_update_index_in_dim(
+                    lengths, jnp.asarray(length, jnp.int32), slot, 0)
+                return k_slots, v_slots, lengths
+
+            donate = (0, 1, 4) if jax.default_backend() == "tpu" else ()
+            self._compiled[key] = jax.jit(swap_in, donate_argnums=donate)
+        return self._compiled[key]
+
+    def block_swap_out_program(self, num_blocks: int, max_blocks: int):
+        """Jitted preemption swap-OUT for the block pool: gather the
+        contents of one slot's table-named blocks (sentinel entries
+        gather the garbage row — the engine trims to the blocks the
+        request actually used before parking them on host). Read-only.
+
+        Signature: ``(k_pool, v_pool, table[MB]) -> (k_blocks, v_blocks)``
+        with blocks ``[L, MB, Hkv, bs(/pair), Dh(*pair)]``."""
+        from deepspeed_tpu.ops.attention import gather_pool_blocks
+
+        key = ("blk_swap_out", num_blocks, max_blocks)
+        if key not in self._compiled:
+            self._compiled[key] = jax.jit(
+                lambda k, v, table: gather_pool_blocks(k, v, table))
+        return self._compiled[key]
+
+    def block_swap_in_program(self, num_blocks: int, max_blocks: int):
+        """Jitted preemption swap-IN for the block pool: scatter
+        host-uploaded block contents into the pool rows named by
+        ``dst`` and restore the slot's valid length. Entries the
+        restore skips (radix re-matched shared prefix blocks, allocated
+        but never-written tail blocks) name the garbage row, so the
+        program's shapes never vary with how much actually uploads.
+
+        Signature: ``(k_pool, v_pool, k_blocks, v_blocks, dst[MB],
+        lengths, slot, length) -> (k_pool, v_pool, lengths)`` (pool
+        operands donated on TPU)."""
+        from deepspeed_tpu.ops.attention import scatter_pool_blocks
+
+        key = ("blk_swap_in", num_blocks, max_blocks)
+        if key not in self._compiled:
+            def swap_in(k_pool, v_pool, k_blocks, v_blocks, dst, lengths,
+                        slot, length):
+                k_pool, v_pool = scatter_pool_blocks(
+                    k_pool, v_pool, k_blocks, v_blocks, dst)
+                lengths = jax.lax.dynamic_update_index_in_dim(
+                    lengths, jnp.asarray(length, jnp.int32), slot, 0)
+                return k_pool, v_pool, lengths
+
+            donate = (0, 1, 5) if jax.default_backend() == "tpu" else ()
+            self._compiled[key] = jax.jit(swap_in, donate_argnums=donate)
+        return self._compiled[key]
+
     # ------------------------------------------------------------- utilities
     def compiled_programs(self, batch: int, prompt_len: int, max_new: int,
                           *, do_sample: bool = False, top_k: int = 0,

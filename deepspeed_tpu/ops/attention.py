@@ -240,6 +240,64 @@ def write_slot_prefix(k_full, v_full, k_pref, v_pref, slot):
     return k_full, v_full
 
 
+def extract_slot_kv(k_full, v_full, slot):
+    """Slice slot ``slot``'s row pair out of the slot-paged caches as a
+    batch-1 stacked cache ``[L, 1, Hkv, S(/pair), Dh(*pair)]`` in the
+    persistent pack factor. Two callers (ISSUE 8):
+
+      * the chunked-prefill program steps the sliced row as a batch-1
+        cache (the chunk's queries attend over the slot's own
+        already-prefilled prefix) and writes it back;
+      * preemption swap-out hands the row to the host swap buffer.
+
+    ``slot`` is a traced scalar — one compiled program serves every
+    slot."""
+    slot = jnp.asarray(slot, jnp.int32)
+    return (jax.lax.dynamic_slice_in_dim(k_full, slot, 1, 1),
+            jax.lax.dynamic_slice_in_dim(v_full, slot, 1, 1))
+
+
+def insert_slot_kv(k_full, v_full, k_row, v_row, slot):
+    """Write a batch-1 row pair (the persistent pack factor — exactly
+    what :func:`extract_slot_kv` produced) back into slot ``slot`` of
+    the slot-paged caches: the chunk-prefill write-back and the
+    preemption swap-in (ISSUE 8). One ``dynamic_update_slice`` per
+    cache, traced slot."""
+    slot = jnp.asarray(slot, jnp.int32)
+    zero = jnp.zeros((), jnp.int32)
+    starts = (zero, slot, zero, zero, zero)
+    return (jax.lax.dynamic_update_slice(
+                k_full, k_row.astype(k_full.dtype), starts),
+            jax.lax.dynamic_update_slice(
+                v_full, v_row.astype(v_full.dtype), starts))
+
+
+def gather_pool_blocks(k_pool, v_pool, table):
+    """Gather one slot's table-named block CONTENTS
+    ``[L, MB, Hkv, bs(/pair), Dh(*pair)]`` out of the block pool — the
+    device half of preemption swap-OUT (ISSUE 8): the engine
+    device_gets the result into the host swap buffer before freeing the
+    blocks. Sentinel table entries gather the pool's garbage row
+    (finite junk the restore never uploads). ``table`` is traced int32
+    ``[MB]`` — one compiled program serves every block assignment."""
+    return (jnp.take(k_pool, table, axis=1, mode="clip"),
+            jnp.take(v_pool, table, axis=1, mode="clip"))
+
+
+def scatter_pool_blocks(k_pool, v_pool, k_blocks, v_blocks, dst):
+    """Scatter ``[L, MB, ...]`` block contents into the pool rows named
+    by ``dst`` — preemption swap-IN (ISSUE 8). Entries the restore must
+    SKIP (radix re-matched shared blocks, never-written tail blocks)
+    point at the pool's garbage row: their writes land where nobody
+    reads, so the program's shapes never vary with how much actually
+    needs uploading (duplicate garbage-row writes race only against
+    each other)."""
+    return (k_pool.at[:, dst].set(k_blocks.astype(k_pool.dtype),
+                                  mode="drop"),
+            v_pool.at[:, dst].set(v_blocks.astype(v_pool.dtype),
+                                  mode="drop"))
+
+
 def pool_block_size(k_pool, head_dim: int) -> int:
     """Tokens per block of a (possibly token-pair packed) KV block pool
     ``[L, N, Hkv, bs/pair, Dh*pair]``."""

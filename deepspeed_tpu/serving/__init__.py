@@ -7,8 +7,10 @@ Two cache layouts: the slot-paged default (vLLM's paging specialized to
 XLA static shapes, serving/kv_slots.py), and the block-paged pool with
 radix-tree prefix sharing + copy-on-write (ISSUE 6 — vLLM PagedAttention
 block tables + SGLang RadixAttention, serving/kv_blocks.py +
-serving/radix.py, ``ServingEngine(prefix_cache=True)``). See
-serving/engine.py.
+serving/radix.py, ``ServingEngine(prefix_cache=True)``). SLO-aware
+overload control (ISSUE 8): chunked prefill under a per-iteration token
+budget, priority classes with aging, and preemption with host KV swap
+(serving/swap.py). See serving/engine.py.
 """
 
 from deepspeed_tpu.serving.engine import ServingEngine
@@ -16,14 +18,18 @@ from deepspeed_tpu.serving.kv_blocks import BlockKVPool
 from deepspeed_tpu.serving.kv_slots import SlotKVCache
 from deepspeed_tpu.serving.radix import PrefixCache
 from deepspeed_tpu.serving.scheduler import (Request, RequestResult,
-                                             SlotScheduler, pick_bucket,
-                                             poisson_trace,
+                                             SlotScheduler, bimodal_trace,
+                                             bursty_poisson_trace,
+                                             pick_bucket, poisson_trace,
                                              shared_prefix_trace,
+                                             straggler_trace,
                                              templated_trace)
 from deepspeed_tpu.serving.speculative import (SpeculativeConfig,
                                                ngram_propose)
+from deepspeed_tpu.serving.swap import HostSwapBuffer
 
 __all__ = ["ServingEngine", "SlotKVCache", "BlockKVPool", "PrefixCache",
            "SlotScheduler", "Request", "RequestResult", "SpeculativeConfig",
-           "ngram_propose", "pick_bucket", "poisson_trace",
-           "shared_prefix_trace", "templated_trace"]
+           "HostSwapBuffer", "ngram_propose", "pick_bucket",
+           "poisson_trace", "shared_prefix_trace", "templated_trace",
+           "bursty_poisson_trace", "bimodal_trace", "straggler_trace"]

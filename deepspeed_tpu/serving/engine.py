@@ -44,6 +44,7 @@ from deepspeed_tpu.serving.speculative import (AdaptiveK, DraftModelDrafter,
                                                NgramDrafter,
                                                normalize_speculative,
                                                pick_k_bucket)
+from deepspeed_tpu.serving.swap import HostSwapBuffer
 from deepspeed_tpu.utils.logging import log_dist
 
 # accepted-tokens-per-step / tokens-per-decode-call histograms count small
@@ -56,15 +57,46 @@ class _SlotState:
     """Host-side state of one occupied slot. The speculative drafters'
     token-history view is DERIVED (request.prompt + result.tokens), not
     stored — a second copy could silently desynchronize from the
-    emitted stream."""
+    emitted stream.
 
-    __slots__ = ("request", "result", "last_token")
+    A slot is in the PREFILL phase while ``prefill_pos <
+    prefill_total`` (chunked prefill, ISSUE 8): it consumes prefill
+    budget between decode iterations, emits no tokens, and is excluded
+    from the decode batch. The first generated token (and TTFT) exists
+    only once the last chunk lands. ``order`` is the engine's admission
+    sequence — chunk continuations run priority-then-admission order,
+    so earlier same-class prompts finish prefilling first."""
+
+    __slots__ = ("request", "result", "last_token", "prefill_pos",
+                 "prefill_total", "order")
 
     def __init__(self, request: Request, result: RequestResult,
-                 last_token: int):
+                 last_token: int, prefill_pos: int, prefill_total: int,
+                 order: int):
         self.request = request
         self.result = result
         self.last_token = last_token
+        self.prefill_pos = prefill_pos
+        self.prefill_total = prefill_total
+        self.order = order
+
+    @property
+    def prefilling(self) -> bool:
+        return self.prefill_pos < self.prefill_total
+
+
+class _Preempted:
+    """Host-side state of one preempted (swapped-out) request: the slot
+    state to reattach on resume, the KV length it had computed, and the
+    engine-clock instant it left the slot set (the preempted interval
+    is queue wait, not decode latency)."""
+
+    __slots__ = ("state", "length", "since")
+
+    def __init__(self, state: _SlotState, length: int, since: float):
+        self.state = state
+        self.length = length
+        self.since = since
 
 
 class ServingEngine:
@@ -116,6 +148,38 @@ class ServingEngine:
         bit-identical to the slot-paged engine (greedy, with and without
         speculation — pinned by tests), and the zero-recompile invariant
         holds: block tables are traced data, never shapes.
+    prefill_token_budget: chunked prefill (ISSUE 8, Sarathi-style
+        stall-free scheduling). None (default) keeps monolithic
+        prefills. An int caps the BUCKET-PADDED prefill tokens (the
+        compute actually dispatched) per serving iteration: long
+        prompts prefill in fixed-bucket-sized chunks
+        (at most the largest bucket <= budget per chunk) interleaved
+        with decode steps, so a 2k-token prompt can no longer
+        monopolize an iteration and spike every decoding tenant's
+        TPOT. Chunk count is traced data — the zero-recompile
+        invariant holds across chunk transitions — and prompts LONGER
+        than the largest bucket become servable (submit's bucket
+        rejection lifts; the slot capacity check remains). TTFT is
+        stamped when the LAST chunk emits the first token.
+    preemption: "swap" enables priority preemption with host KV swap
+        (ISSUE 8): when an arrived request of a strictly higher class
+        cannot be admitted (no free slot, or — block-paged — the pool
+        doesn't fit it), the worst lower-class running slot is swapped
+        OUT to a host-side numpy buffer (serving/swap.py), its
+        slot/blocks freed, and the request re-queued at its original
+        arrival position; it swaps back IN when resources free and
+        finishes bit-identically to an uninterrupted run (pinned by
+        tests). None (default) disables preemption.
+    priority_aging_sec: scheduler aging rate — a waiting request gains
+        one full priority class per ``priority_aging_sec`` seconds
+        waited, so the lowest class never starves under sustained
+        high-priority load. None disables aging (raw classes only).
+    tpot_slo_ms: decode-TPOT SLO guard for the admission side: when the
+        EMA of inter-decode-invocation wall time exceeds this budget
+        while decode-phase slots exist, the iteration's prefill budget
+        drops to 0 (decode runs first, prefill defers) — for at most
+        ``slo_max_defer`` consecutive iterations, so prefill always
+        makes progress. Requires ``prefill_token_budget``.
     """
 
     def __init__(self, engine, *, num_slots: int = 8, max_len: int = 1024,
@@ -126,7 +190,12 @@ class ServingEngine:
                  time_fn: Optional[Callable[[], float]] = None,
                  telemetry=True, speculative=None,
                  prefix_cache: bool = False, block_size: int = 16,
-                 num_blocks: Optional[int] = None):
+                 num_blocks: Optional[int] = None,
+                 prefill_token_budget: Optional[int] = None,
+                 preemption: Optional[str] = None,
+                 priority_aging_sec: Optional[float] = None,
+                 tpot_slo_ms: Optional[float] = None,
+                 slo_max_defer: int = 4):
         self.engine = engine
         model = engine.module
         mcfg = getattr(model, "config", None)
@@ -184,12 +253,52 @@ class ServingEngine:
         self._rng = jax.random.PRNGKey(engine.config.seed + 1)
         self._zero_key = jax.random.PRNGKey(0)
 
-        self.scheduler = SlotScheduler(num_slots)
+        # ---- SLO-aware scheduling (ISSUE 8)
+        if prefill_token_budget is not None:
+            if prefill_token_budget < self.buckets[0]:
+                raise ValueError(
+                    f"prefill_token_budget {prefill_token_budget} below the "
+                    f"smallest prefill bucket {self.buckets[0]}: no chunk "
+                    f"program could ever run under it")
+            # chunks are fixed-bucket-sized: the largest bucket the
+            # budget holds (chunk count is data, bucket set is fixed —
+            # the recompile-free invariant)
+            self._chunk_max: Optional[int] = max(
+                b for b in self.buckets if b <= prefill_token_budget)
+        else:
+            self._chunk_max = None
+        self.prefill_token_budget = prefill_token_budget
+        if preemption not in (None, "swap"):
+            raise ValueError(f"preemption policy must be None or 'swap', "
+                             f"got {preemption!r}")
+        self.preemption = preemption
+        self.swap = HostSwapBuffer() if preemption else None
+        self._preempted: Dict[int, _Preempted] = {}
+        if tpot_slo_ms is not None and prefill_token_budget is None:
+            raise ValueError(
+                "tpot_slo_ms needs prefill_token_budget: the SLO guard "
+                "defers budgeted prefill work, and monolithic admission "
+                "has no budget to defer")
+        self.tpot_slo_ms = tpot_slo_ms
+        self._slo_max_defer = slo_max_defer
+        self._defer_streak = 0
+        self._decode_gap_ema: Optional[float] = None
+        self._last_decode_t: Optional[float] = None
+        self._admit_seq = 0
+
+        self.scheduler = SlotScheduler(num_slots,
+                                       aging_sec=priority_aging_sec)
         self._slots: List[Optional[_SlotState]] = [None] * num_slots
         self._warm = False
         self._run_t0: Optional[float] = None
         # programs (built lazily, counted by tests): bucket -> prefill fn
         self._prefill: Dict[int, Callable] = {}
+        # slot-paged chunk-prefill programs (chunked mode only; the
+        # block-paged mode chunks through the same suffix-prefill
+        # programs via their `start` operand)
+        self._chunk_prefill: Dict[int, Callable] = {}
+        self._swap_out_fn: Optional[Callable] = None
+        self._swap_in_fn: Optional[Callable] = None
         self._copy_fn: Optional[Callable] = None
         if prefix_cache:
             self._decode = engine.block_decode_program(
@@ -230,6 +339,14 @@ class ServingEngine:
         # computed" axis; radix-matched tokens never hit the device)
         self.prefill_tokens_computed = 0
         self.tokens_generated = 0
+        # SLO-aware scheduling accounting (ISSUE 8; bench + telemetry)
+        self.prefill_chunks = 0
+        self.preemptions = 0
+        # swap traffic in pool blocks (block-paged) / slot pages
+        # (slot-paged: the whole slot row is the swap unit, 1 per trip)
+        self.swapped_blocks_out = 0
+        self.swapped_blocks_in = 0
+        self.slo_deferred_steps = 0
         self._active_slot_iterations = 0
         # speculative accounting (spec mode only; bench + telemetry)
         self.spec_drafted_tokens = 0
@@ -267,6 +384,37 @@ class ServingEngine:
                     bucket, self.num_slots, self.max_len, **self._sample_kw)
         return self._prefill[bucket]
 
+    def _chunk_fn(self, bucket: int):
+        """Slot-paged mid-prompt chunk prefill (ISSUE 8) — the chunk
+        attends over the slot's own already-written prefix, so unlike
+        the monolithic bucket prefill it can start at a traced offset.
+        Block-paged chunking needs no separate program (the suffix
+        prefill's ``start`` operand is the chunk offset)."""
+        if bucket not in self._chunk_prefill:
+            self._chunk_prefill[bucket] = \
+                self.engine.slot_chunk_prefill_program(
+                    bucket, self.num_slots, self.max_len, **self._sample_kw)
+        return self._chunk_prefill[bucket]
+
+    def _build_swap_programs(self) -> None:
+        """Preemption swap-out/in programs for the active cache mode
+        (ISSUE 8) — compiled at warmup when the policy is on, so a
+        preemption mid-trace never compiles."""
+        if self._swap_out_fn is not None:
+            return
+        eng = self.engine
+        if self.prefix is not None:
+            mb = self.cache.max_blocks_per_slot
+            self._swap_out_fn = eng.block_swap_out_program(
+                self.cache.num_blocks, mb)
+            self._swap_in_fn = eng.block_swap_in_program(
+                self.cache.num_blocks, mb)
+        else:
+            self._swap_out_fn = eng.slot_swap_out_program(
+                self.num_slots, self.max_len)
+            self._swap_in_fn = eng.slot_swap_in_program(
+                self.num_slots, self.max_len)
+
     def _verify_fn(self, kb: int):
         """Speculative verify program for draft-width bucket ``kb`` —
         one compiled program per bucket in the FIXED k_buckets set, so
@@ -291,6 +439,9 @@ class ServingEngine:
         draft-model programs; the prefix cache adds exactly one COW
         block-copy program)."""
         n = len(self._prefill) + 1 + len(self._verify)
+        n += len(self._chunk_prefill)
+        if self._swap_out_fn is not None:
+            n += 2
         if self._copy_fn is not None:
             n += 1
         if self._drafter is not None:
@@ -306,8 +457,13 @@ class ServingEngine:
         out = {"decode": self._decode._cache_size()}
         for b, fn in self._prefill.items():
             out[f"prefill_{b}"] = fn._cache_size()
+        for b, fn in self._chunk_prefill.items():
+            out[f"chunk_prefill_{b}"] = fn._cache_size()
         for kb, fn in self._verify.items():
             out[f"verify_{kb}"] = fn._cache_size()
+        if self._swap_out_fn is not None:
+            out["swap_out"] = self._swap_out_fn._cache_size()
+            out["swap_in"] = self._swap_in_fn._cache_size()
         if self._copy_fn is not None:
             out["block_copy"] = self._copy_fn._cache_size()
         if self._drafter is not None:
@@ -341,6 +497,39 @@ class ServingEngine:
                         eng.params, *self.cache.carry(), ids, np.int32(0),
                         np.int32(1), self._temp, self._zero_key)
                 self.cache.update(*out[:3])
+                if (self._chunk_max is not None and not paged
+                        and b <= self._chunk_max):
+                    # slot-paged chunk programs: chunks never exceed
+                    # _chunk_max, so only buckets up to it can run one
+                    out = self._chunk_fn(b)(
+                        eng.params, *self.cache.carry(), ids, np.int32(0),
+                        np.int32(0), np.int32(1), self._temp,
+                        self._zero_key)
+                    self.cache.update(*out[:3])
+            if self.preemption is not None:
+                # swap round trip through slot/garbage rows, with the
+                # host upload in the loop so BOTH runtime operand
+                # signatures (canonical carry + numpy-uploaded rows) are
+                # cached — a first preemption mid-trace must not compile
+                self._build_swap_programs()
+                if paged:
+                    sent = jnp.asarray(np.full(
+                        (self.cache.max_blocks_per_slot,),
+                        self.cache.sentinel, np.int32))
+                    ko, vo = self._swap_out_fn(self.cache.k, self.cache.v,
+                                               sent)
+                    args_in = (jnp.asarray(np.asarray(jax.device_get(ko))),
+                               jnp.asarray(np.asarray(jax.device_get(vo))),
+                               sent)
+                else:
+                    ko, vo = self._swap_out_fn(self.cache.k, self.cache.v,
+                                               np.int32(0))
+                    args_in = (jnp.asarray(np.asarray(jax.device_get(ko))),
+                               jnp.asarray(np.asarray(jax.device_get(vo))))
+                out = self._swap_in_fn(self.cache.k, self.cache.v,
+                                       *args_in, self.cache.lengths,
+                                       np.int32(0), np.int32(0))
+                self.cache.update(*out)
             toks = np.zeros((self.num_slots,), np.int32)
             active = np.zeros((self.num_slots,), bool)
             out = self._decode(eng.params, *self.cache.carry(),
@@ -395,10 +584,13 @@ class ServingEngine:
         if request.max_new_tokens < 1:
             raise ValueError(
                 f"request {request.rid}: max_new_tokens must be >= 1")
-        if pick_bucket(plen, self.buckets) is None:
+        if self._chunk_max is None and \
+                pick_bucket(plen, self.buckets) is None:
             raise ValueError(
                 f"request {request.rid}: prompt length {plen} exceeds the "
-                f"largest prefill bucket {self.buckets[-1]}")
+                f"largest prefill bucket {self.buckets[-1]} (set "
+                f"prefill_token_budget to serve longer prompts via "
+                f"chunked prefill)")
         if not self.cache.capacity_for(plen, request.max_new_tokens,
                                        self._lookahead):
             extra = (f" (speculation reserves {self._lookahead} lookahead "
@@ -452,11 +644,19 @@ class ServingEngine:
             # (TTFT covers the prefill). Divide by ACTUAL decode
             # invocations, not len(tokens) - 1: a speculative verify step
             # emits up to k+1 tokens per invocation, so the token count
-            # would overstate the step count and understate TPOT.
+            # would overstate the step count and understate TPOT. Time
+            # spent PREEMPTED DURING DECODE is queue wait, not decode
+            # latency — it is subtracted from the span (and decode_calls
+            # never counted swapped-out iterations in the first place);
+            # a mid-PREFILL park fell before first_token_time and is
+            # already outside the span.
             n_dec = res.decode_calls
             if n_dec > 0:
-                reg.histogram("serving/tpot_ms").observe(
-                    (res.finish_time - res.first_token_time) / n_dec * 1e3)
+                tpot = max(res.finish_time - res.first_token_time
+                           - res.decode_preempted_wall, 0.0) / n_dec * 1e3
+                reg.histogram("serving/tpot_ms").observe(tpot)
+                reg.histogram(
+                    f"serving/tpot_ms/p{res.priority}").observe(tpot)
                 reg.histogram(
                     "serving/tokens_per_decode_call",
                     buckets=_TOKENS_PER_STEP_BUCKETS).observe(
@@ -473,119 +673,458 @@ class ServingEngine:
             return self._finish(slot, now, "length")
         return None
 
-    def _prefix_fits(self, req: Request) -> bool:
-        """Block-granular admission predicate (scheduler ``fits`` hook):
-        the request's UNMATCHED block demand — prompt + max_new +
-        speculative lookahead, minus radix-matched full blocks — must be
-        servable from free + evictable pool blocks."""
+    def _admit_fits(self, req: Request) -> bool:
+        """Admission predicate (scheduler ``fits`` hook). Slot-paged:
+        the free-slot list is the only resource, always True.
+        Block-paged: the request's UNMATCHED block demand — prompt +
+        max_new + speculative lookahead, minus radix-matched full
+        blocks — must be servable from free + evictable pool blocks
+        (identical accounting for fresh admissions and preempted
+        resumes: ``readmit`` re-pins exactly the blocks ``fits``
+        credits)."""
+        if self.prefix is None:
+            return True
         return self.prefix.fits(
             req.prompt,
             len(req.prompt) + req.max_new_tokens + self._lookahead)
 
-    def _admit(self, now: float) -> List[RequestResult]:
-        """Prefill arrived requests into free slots (may finish a
-        1-token request immediately).
+    def _stream(self, st: _SlotState, tokens) -> None:
+        """Token-streaming callback (ISSUE 8 satellite): invoked once
+        per COMMITTED token in emission order — under speculation only
+        the accepted (post-EOS-truncation) block ever reaches it, so
+        the streamed sequence is exactly ``RequestResult.tokens``."""
+        cb = st.request.on_token
+        if cb is not None:
+            for t in tokens:
+                cb(int(t))
+
+    def _iteration_prefill_budget(self, now: float) -> Optional[int]:
+        """Prefill tokens this iteration may spend. None = unlimited
+        (monolithic mode). With ``tpot_slo_ms`` set, an iteration whose
+        decode-gap EMA exceeds the budget while decode-phase slots
+        exist defers ALL prefill work (returns 0) — decode runs
+        untaxed — but never more than ``slo_max_defer`` times in a row,
+        so prefilling requests always progress (deferral shapes WHEN
+        prefill happens, never WHETHER). The streak counts only
+        iterations that actually had prefill work to defer (an
+        in-flight chunked prompt, or an arrived fresh head): idle
+        at-risk iterations neither defer anything nor burn the streak —
+        otherwise a long prompt arriving right after an idle at-risk
+        stretch would prefill undeferred in the exact iteration the EMA
+        flags a breach."""
+        budget = self.prefill_token_budget
+        if budget is None:
+            return None
+        at_risk = (self.tpot_slo_ms is not None
+                   and self._decode_gap_ema is not None
+                   and self._decode_gap_ema * 1e3 > self.tpot_slo_ms
+                   and any(s is not None and not s.prefilling
+                           for s in self._slots))
+        if not at_risk:
+            self._defer_streak = 0
+            return budget
+        head = self.scheduler.peek(now)
+        work = (any(s is not None and s.prefilling for s in self._slots)
+                or (head is not None and head.rid not in self._preempted))
+        if not work:
+            return budget       # nothing to defer; streak untouched
+        if self._defer_streak >= self._slo_max_defer:
+            self._defer_streak = 0
+            return budget
+        self._defer_streak += 1
+        self.slo_deferred_steps += 1
+        if self.telemetry is not None:
+            self.telemetry.counter("serving/slo_deferred_steps").inc()
+        return 0
+
+    def _schedule(self, now: float, finished: List[RequestResult]) -> None:
+        """One iteration of the admit/prefill side of the serving loop
+        (ISSUE 8): continue in-flight chunked prefills (priority, then
+        admission order), then admit — preempting lower-priority slots
+        when the policy allows — all under this iteration's prefill
+        token budget. Swap-ins ride free (a resume is an HBM copy, not
+        prefill compute), so a preempted request never waits on budget.
 
         Prefix-cache mode admits ONE request per scheduler call (each
         admission consumes pool blocks the next ``fits`` check must
         see), matches the prompt against the radix index, pins + names
         the matched block chain in the slot's table, runs the COW fork
         copies, and prefills only the unmatched suffix — bucketed by
-        SUFFIX length, so a long shared system prompt with a short
-        unique tail prefills in the smallest bucket."""
-        finished = []
-        eng = self.engine
+        SUFFIX length (and chunked under a prefill budget), so a long
+        shared system prompt with a short unique tail prefills in the
+        smallest bucket."""
+        budget = self._iteration_prefill_budget(now)
+        # (1) in-flight chunked prefills first: an admitted prompt
+        # finishes prefilling before new admissions eat the budget
+        # (Sarathi's stall-free ordering — decode-phase slots are
+        # protected by the budget itself). EXCEPT when the queue head
+        # strictly outranks a prefilling slot (same double guard as
+        # preemption): its budget share is yielded so the admission
+        # loop below can preempt and admit the head — otherwise a
+        # lower-class long prompt's chunking would block an interactive
+        # arrival for its whole prefill (priority inversion).
+        spent = self._continue_prefills(now, budget, 0, finished,
+                                        yield_to_head=True)
+        # (2) admission (+ preemption to make room)
         while True:
-            if self.prefix is not None:
-                pairs = self.scheduler.admit(now, fits=self._prefix_fits,
-                                             limit=1)
-            else:
-                pairs = self.scheduler.admit(now)
+            if budget is not None and spent >= budget:
+                head = self.scheduler.peek(now)
+                if head is None or head.rid not in self._preempted:
+                    break
+            pairs = self.scheduler.admit(now, fits=self._admit_fits,
+                                         limit=1)
             if not pairs:
+                if not self._try_preempt(now):
+                    break
+                continue
+            (req, slot), = pairs
+            if req.rid in self._preempted:
+                self._resume(slot, req, now)
+                continue
+            spent += self._admit_one(
+                slot, req, now, None if budget is None else budget - spent,
+                finished)
+        # (3) leftover budget back to whoever is still prefilling (the
+        # head either got placed above or cannot be placed at all —
+        # idling the budget would help nobody)
+        self._continue_prefills(now, budget, spent, finished,
+                                yield_to_head=False)
+
+    def _continue_prefills(self, now: float, budget: Optional[int],
+                           spent: int, finished: List[RequestResult],
+                           yield_to_head: bool) -> int:
+        """Advance in-flight chunked prefills in (priority, admission)
+        order under the remaining budget. With ``yield_to_head``, a
+        slot that the best arrived queue head strictly outranks (raw
+        class AND aged effective priority — preemption's guard) is
+        skipped: its budget share belongs to the head the admission
+        loop is about to place — into a free slot, or (policy
+        permitting) into this very slot after preempting it. If the
+        head turns out unplaceable, the post-admission leftover pass
+        returns the yielded budget to the skipped slot, so yielding
+        never idles an iteration."""
+        head = self.scheduler.peek(now) if yield_to_head else None
+        eff = self.scheduler.effective_priority
+        pre = sorted((i for i, s in enumerate(self._slots)
+                      if s is not None and s.prefilling),
+                     key=lambda i: (self._slots[i].request.priority,
+                                    self._slots[i].order))
+        for slot in pre:
+            if budget is not None and spent >= budget:
                 break
-            for req, slot in pairs:
-                plen = len(req.prompt)
-                start = 0
-                with jax.profiler.TraceAnnotation("dstpu/serving_prefill"):
-                    if self.prefix is not None:
-                        total = (plen + req.max_new_tokens
-                                 + self._lookahead)
-                        start, copies = self.prefix.admit(
-                            slot, req.prompt, total)
-                        for src, dst in copies:
-                            k, v = self._copy_fn(
-                                self.cache.k, self.cache.v,
-                                np.int32(src), np.int32(dst))
-                            self.cache.update_kv(k, v)
-                    suffix = req.prompt[start:]
-                    bucket = pick_bucket(len(suffix), self.buckets)
-                    ids = np.full((1, bucket), self.pad_token_id, np.int32)
-                    ids[0, :len(suffix)] = np.asarray(suffix, np.int32)
-                    if self.prefix is not None:
-                        out = self._prefill_fn(bucket)(
-                            eng.params, *self.cache.carry(),
-                            jnp.asarray(ids), self.cache.table_row(slot),
-                            np.int32(slot), np.int32(start),
-                            np.int32(len(suffix)), self._temp,
-                            self._next_rng())
-                    else:
-                        out = self._prefill_fn(bucket)(
-                            eng.params, *self.cache.carry(),
-                            jnp.asarray(ids), np.int32(slot),
-                            np.int32(plen), self._temp, self._next_rng())
-                    self.cache.update(*out[:3])
-                    tok = int(jax.device_get(out[3]))
+            st = self._slots[slot]
+            if (head is not None
+                    and head.priority < st.request.priority
+                    and eff(head, now) < eff(st.request, now)):
+                continue
+            spent += self._run_prefill_chunks(
+                slot, now, None if budget is None else budget - spent,
+                finished)
+        return spent
+
+    def _admit_one(self, slot: int, req: Request, now: float,
+                   budget_left: Optional[int],
+                   finished: List[RequestResult]) -> int:
+        """Admit one fresh request into ``slot``: radix match + COW
+        forks (prefix-cache mode), then prefill as much of the prompt
+        as the budget allows (the rest continues on later iterations).
+        Returns prefill tokens spent."""
+        plen = len(req.prompt)
+        start = 0
+        if self.prefix is not None:
+            total = plen + req.max_new_tokens + self._lookahead
+            start, copies = self.prefix.admit(slot, req.prompt, total)
+            for src, dst in copies:
+                k, v = self._copy_fn(self.cache.k, self.cache.v,
+                                     np.int32(src), np.int32(dst))
+                self.cache.update_kv(k, v)
+        res = RequestResult(rid=req.rid, prompt_len=plen,
+                            arrival_time=req.arrival_time,
+                            admitted_time=now, priority=req.priority)
+        self._slots[slot] = _SlotState(req, res, last_token=0,
+                                       prefill_pos=start,
+                                       prefill_total=plen,
+                                       order=self._admit_seq)
+        self._admit_seq += 1
+        if self.telemetry is not None:
+            reg = self.telemetry
+            reg.counter("serving/prefills").inc()
+            reg.histogram("serving/queue_wait_ms").observe(
+                max(now - req.arrival_time, 0.0) * 1e3)
+        if self._adaptive is not None:
+            self._adaptive.reset_slot(slot)
+        return self._run_prefill_chunks(slot, now, budget_left, finished)
+
+    def _run_prefill_chunks(self, slot: int, now: float,
+                            budget_left: Optional[int],
+                            finished: List[RequestResult]) -> int:
+        """Advance slot ``slot``'s prefill by whole chunks until its
+        prompt is done or the budget is spent. Monolithic mode
+        (``budget_left`` None, no chunk cap) is the single-chunk
+        degenerate case and runs the exact pre-ISSUE-8 program path.
+        The first generated token is picked only by the LAST chunk —
+        intermediate chunk picks are never device_get (discarded, still
+        async) — and TTFT is stamped at that commit (ISSUE 8
+        latency-accounting fix)."""
+        st = self._slots[slot]
+        req = st.request
+        eng = self.engine
+        spent = 0
+        while st.prefilling and (budget_left is None or spent < budget_left):
+            remaining = st.prefill_total - st.prefill_pos
+            chunk = remaining if self._chunk_max is None \
+                else min(remaining, self._chunk_max)
+            last = st.prefill_pos + chunk == st.prefill_total
+            bucket = pick_bucket(chunk, self.buckets)
+            ids = np.full((1, bucket), self.pad_token_id, np.int32)
+            ids[0, :chunk] = np.asarray(
+                req.prompt[st.prefill_pos:st.prefill_pos + chunk], np.int32)
+            with jax.profiler.TraceAnnotation("dstpu/serving_prefill"):
+                if self.prefix is not None:
+                    out = self._prefill_fn(bucket)(
+                        eng.params, *self.cache.carry(), jnp.asarray(ids),
+                        self.cache.table_row(slot), np.int32(slot),
+                        np.int32(st.prefill_pos), np.int32(chunk),
+                        self._temp, self._next_rng())
+                elif st.prefill_pos == 0 and last:
+                    # whole prompt in one chunk: the monolithic bucket
+                    # program (fresh bucket-sized cache + slot insert)
+                    out = self._prefill_fn(bucket)(
+                        eng.params, *self.cache.carry(), jnp.asarray(ids),
+                        np.int32(slot), np.int32(chunk), self._temp,
+                        self._next_rng())
+                else:
+                    out = self._chunk_fn(bucket)(
+                        eng.params, *self.cache.carry(), jnp.asarray(ids),
+                        np.int32(slot), np.int32(st.prefill_pos),
+                        np.int32(chunk), self._temp, self._next_rng())
+                self.cache.update(*out[:3])
+            st.prefill_pos += chunk
+            # the budget is charged in BUCKET-PADDED tokens — the
+            # compute actually dispatched — so one iteration's prefill
+            # work genuinely stays near the cap (true-token charging
+            # would let padding push real work past it); chunks are
+            # never clamped below their natural size, since a padded
+            # bucket costs the same forward whether half full or full
+            spent += bucket
+            self.prefill_tokens_computed += chunk
+            self.prefill_chunks += 1
+            st.result.prefill_chunks += 1
+            if self.telemetry is not None:
+                self.telemetry.counter("serving/prefill_chunks").inc()
+            if last:
+                tok = int(jax.device_get(out[3]))
                 self.prefill_calls += 1
-                self.prefill_tokens_computed += len(suffix)
                 self.tokens_generated += 1
-                res = RequestResult(rid=req.rid, prompt_len=plen,
-                                    tokens=[tok],
-                                    arrival_time=req.arrival_time,
-                                    admitted_time=now,
-                                    first_token_time=self._now(now))
+                st.last_token = tok
+                st.result.tokens.append(tok)
+                t_emit = self._now(now)
+                st.result.first_token_time = t_emit
+                st.result.token_times.append(t_emit)
+                self._stream(st, [tok])
                 if self.telemetry is not None:
-                    reg = self.telemetry
-                    reg.counter("serving/prefills").inc()
-                    reg.histogram("serving/queue_wait_ms").observe(
-                        max(now - req.arrival_time, 0.0) * 1e3)
-                    reg.histogram("serving/ttft_ms").observe(
-                        max(res.first_token_time - req.arrival_time, 0.0)
-                        * 1e3)
-                self._slots[slot] = _SlotState(req, res, tok)
-                if self._adaptive is not None:
-                    self._adaptive.reset_slot(slot)
+                    ttft = max(t_emit - req.arrival_time, 0.0) * 1e3
+                    self.telemetry.histogram("serving/ttft_ms").observe(ttft)
+                    self.telemetry.histogram(
+                        f"serving/ttft_ms/p{req.priority}").observe(ttft)
                 done = self._maybe_finish(slot, now)
                 if done is not None:
                     finished.append(done)
-            if self.prefix is None:
-                break
-        return finished
+        return spent
+
+    # -------------------------------------------------------- preemption
+    def _try_preempt(self, now: float) -> bool:
+        """Make room for the best waiting request by swapping out one
+        strictly-lower-priority running slot (ISSUE 8). Called only
+        after admission came up empty, i.e. the candidate is blocked on
+        a slot or (block-paged) on pool blocks. Two guards bound
+        thrash: the victim's RAW class must be strictly worse (a
+        resumed request can never be preempted by the class that
+        displaced it), and its AGED effective priority must be worse
+        too — a victim that waiting has promoted past the candidate
+        would rank AHEAD of it in the queue after resubmit, so evicting
+        it would only swap it straight back in (the resume→preempt
+        ping-pong this guard exists to prevent). Victim choice: the
+        worst class, and within it the most recently admitted (least
+        sunk work). Returns True if a slot was freed (the caller
+        retries admission)."""
+        if self.preemption is None:
+            return False
+        cand = self.scheduler.peek(now)
+        if cand is None:
+            return False
+        eff = self.scheduler.effective_priority
+        cand_eff = eff(cand, now)
+        victims = [i for i, s in enumerate(self._slots)
+                   if s is not None and s.request.priority > cand.priority
+                   and eff(s.request, now) > cand_eff]
+        if not victims:
+            return False
+        victim = max(victims, key=lambda i: (self._slots[i].request.priority,
+                                             self._slots[i].order))
+        self._preempt(victim, now)
+        return True
+
+    def _preempt(self, slot: int, now: float) -> None:
+        """Swap slot ``slot``'s KV out to the host buffer and return its
+        request to the arrival queue (original position — resubmit is
+        arrival-ordered). The preempted interval counts as queue wait;
+        the slot state (emitted tokens, chunk progress, drafter
+        history) is parked host-side and reattached verbatim on resume,
+        so the finished stream is bit-identical to an uninterrupted run
+        (pinned by tests)."""
+        st = self._slots[slot]
+        self._build_swap_programs()
+        length = int(jax.device_get(self.cache.lengths[slot]))
+        if self.prefix is not None:
+            n_used = self.cache.blocks_for(length)
+            table = jnp.asarray(self.cache.tables[slot])
+            ko, vo = self._swap_out_fn(self.cache.k, self.cache.v, table)
+            # park only the blocks the request actually computed into
+            # (garbage gathers past n_used are dropped here)
+            host_k = np.asarray(jax.device_get(ko))[:, :n_used]
+            host_v = np.asarray(jax.device_get(vo))[:, :n_used]
+            self.swap.put(st.request.rid, host_k, host_v)
+            # donate fully-computed prompt blocks to the radix index
+            # (they are valid cached prefixes — the resume's re-match
+            # usually finds them again and skips their upload), free the
+            # rest; donate_upto caps at the COMPUTED length so a
+            # mid-prefill preemption never donates unwritten tails
+            self.prefix.finish(slot, donate_upto=length)
+            self.swapped_blocks_out += n_used
+        else:
+            ko, vo = self._swap_out_fn(self.cache.k, self.cache.v,
+                                       np.int32(slot))
+            self.swap.put(st.request.rid,
+                          np.asarray(jax.device_get(ko)),
+                          np.asarray(jax.device_get(vo)))
+            self.swapped_blocks_out += 1      # the slot page
+        self._slots[slot] = None
+        self.scheduler.release(slot)
+        self.scheduler.resubmit(st.request)
+        st.result.preemptions += 1
+        self._preempted[st.request.rid] = _Preempted(
+            st, length, self._now(now))
+        self.preemptions += 1
+        if self.telemetry is not None:
+            reg = self.telemetry
+            reg.counter("serving/preemptions").inc()
+            reg.counter("serving/swapped_blocks_out").inc(
+                n_used if self.prefix is not None else 1)
+
+    def _resume(self, slot: int, req: Request, now: float) -> None:
+        """Swap a preempted request back into ``slot``: upload its host
+        KV, restore its length, and reattach its slot state. Block-paged
+        mode first re-matches the prompt against the radix index —
+        still-cached full prefix blocks are re-pinned and skipped by the
+        upload (and a trie that learned a LONGER prefix while the
+        request was parked fast-forwards a mid-prefill resume past it).
+        Decode continues exactly where it left off."""
+        rec = self._preempted.pop(req.rid)
+        st = rec.state
+        host_k, host_v = self.swap.pop(req.rid)
+        length = rec.length
+        if self.prefix is not None:
+            total = len(req.prompt) + req.max_new_tokens + self._lookahead
+            shared = self.prefix.readmit(slot, req.prompt, total)
+            # the trie may now hold MORE of the prompt than this request
+            # had computed (another tenant donated it meanwhile): skip
+            # the prefill ahead over the re-pinned shared prefix
+            length = max(length, min(shared * self.cache.block_size,
+                                     st.prefill_total))
+            st.prefill_pos = max(st.prefill_pos, length) \
+                if st.prefilling else st.prefill_pos
+            n_used = host_k.shape[1]
+            mb = self.cache.max_blocks_per_slot
+            dst = np.full((mb,), self.cache.sentinel, np.int32)
+            row = self.cache.tables[slot]
+            dst[shared:n_used] = row[shared:n_used]
+            full_shape = (host_k.shape[0], mb) + host_k.shape[2:]
+            up_k = np.zeros(full_shape, host_k.dtype)
+            up_v = np.zeros(full_shape, host_v.dtype)
+            up_k[:, :n_used] = host_k
+            up_v[:, :n_used] = host_v
+            out = self._swap_in_fn(self.cache.k, self.cache.v,
+                                   jnp.asarray(up_k), jnp.asarray(up_v),
+                                   jnp.asarray(dst), self.cache.lengths,
+                                   np.int32(slot), np.int32(length))
+            swapped_in = max(n_used - shared, 0)
+        else:
+            out = self._swap_in_fn(self.cache.k, self.cache.v,
+                                   jnp.asarray(host_k), jnp.asarray(host_v),
+                                   self.cache.lengths, np.int32(slot),
+                                   np.int32(length))
+            swapped_in = 1
+        self.cache.update(*out)
+        gap = max(self._now(now) - rec.since, 0.0)
+        st.result.preempted_wall += gap
+        if st.result.tokens:
+            # decode-phase preemption (first token already out): this
+            # gap must be discounted from the TPOT span at finish. A
+            # mid-prefill park fell before TTFT — discounting it would
+            # deflate TPOT toward zero.
+            st.result.decode_preempted_wall += gap
+        st.order = self._admit_seq
+        self._admit_seq += 1
+        self._slots[slot] = st
+        if self._adaptive is not None:
+            self._adaptive.reset_slot(slot)
+        self.swapped_blocks_in += swapped_in
+        if self.telemetry is not None:
+            reg = self.telemetry
+            reg.counter("serving/swapped_blocks_in").inc(swapped_in)
+            # the preempted interval is queue wait (ISSUE 8 accounting
+            # fix): it lands in the same histogram the initial admission
+            # wait did
+            reg.histogram("serving/queue_wait_ms").observe(gap * 1e3)
 
     def step(self, now: Optional[float] = None) -> List[RequestResult]:
-        """One serving iteration: admit into free slots, then decode one
-        token for every active slot. Returns requests finished this
-        iteration."""
+        """One serving iteration: run the budgeted admit/prefill side
+        (chunk continuations, admissions, preemptions — ISSUE 8), then
+        decode one step for every DECODE-PHASE slot (slots still
+        prefilling their prompt sit the decode out). Returns requests
+        finished this iteration."""
         if not self._warm:
             self.warmup()
         if now is None:
             now = self._time()
+        finished: List[RequestResult] = []
         with jax.profiler.TraceAnnotation("dstpu/serving_admit"):
-            finished = self._admit(now)
-        active_slots = [i for i, s in enumerate(self._slots) if s is not None]
+            self._schedule(now, finished)
+        active_slots = [i for i, s in enumerate(self._slots)
+                        if s is not None and not s.prefilling]
         if self.telemetry is not None:
-            # iteration-level gauges: slot occupancy after admission and
-            # the decode batch's fill ratio (identical here since every
-            # occupied slot decodes — they diverge for engines that cap
-            # the decode batch below the slot count)
-            occ = len(active_slots) / self.num_slots
-            self.telemetry.gauge("serving/slot_occupancy").set(occ)
+            # iteration-level gauges: slot occupancy after admission
+            # (prefilling slots included) and the decode batch's fill
+            # ratio (decode-phase slots only — they diverge under
+            # chunked prefill)
+            occupied = sum(s is not None for s in self._slots)
+            self.telemetry.gauge("serving/slot_occupancy").set(
+                occupied / self.num_slots)
             if active_slots:
-                self.telemetry.gauge("serving/batch_fill_ratio").set(occ)
+                self.telemetry.gauge("serving/batch_fill_ratio").set(
+                    len(active_slots) / self.num_slots)
         if not active_slots:
+            # no decode ran: a later gap against _last_decode_t would
+            # fold queue-idle time into the TPOT-SLO EMA
+            self._last_decode_t = None
             return finished
+        self._note_decode_gap()
         if self.spec is not None:
             return self._spec_step(now, active_slots, finished)
         return self._plain_step(now, active_slots, finished)
+
+    def _note_decode_gap(self) -> None:
+        """EMA of wall time between consecutive decode invocations —
+        the signal the ``tpot_slo_ms`` admission guard watches. Host
+        wall, not the injected clock: the guard protects real decode
+        latency from real prefill compute."""
+        t = time.perf_counter()
+        if self._last_decode_t is not None:
+            gap = t - self._last_decode_t
+            self._decode_gap_ema = gap if self._decode_gap_ema is None \
+                else 0.7 * self._decode_gap_ema + 0.3 * gap
+        self._last_decode_t = t
 
     def _plain_step(self, now: float, active_slots: List[int],
                     finished: List[RequestResult]) -> List[RequestResult]:
@@ -612,13 +1151,16 @@ class ServingEngine:
             self.telemetry.counter("serving/decode_steps").inc()
             self.telemetry.counter("serving/slot_iterations_active").inc(
                 len(active_slots))
+        t_emit = self._now(now)
         for i in active_slots:
             st = self._slots[i]
             tok = int(nxt[i])
             st.result.tokens.append(tok)
+            st.result.token_times.append(t_emit)
             st.result.decode_calls += 1
             st.last_token = tok
             self.tokens_generated += 1
+            self._stream(st, [tok])
             done = self._maybe_finish(i, now)
             if done is not None:
                 finished.append(done)
@@ -654,9 +1196,11 @@ class ServingEngine:
             want[i] = max(0, min(k_des, remaining - 1))
         kb = pick_k_bucket(max(int(want.max()), 1), spec.k_buckets)
         # drafters read each slot's full token stream (prompt + emitted,
-        # derived — result.tokens IS the emitted history)
+        # derived — result.tokens IS the emitted history; slots still
+        # PREFILLING have no stream yet and sit speculation out)
         histories = [list(s.request.prompt) + s.result.tokens
-                     if s is not None else None for s in self._slots]
+                     if s is not None and not s.prefilling else None
+                     for s in self._slots]
         t0 = time.perf_counter()
         with jax.profiler.TraceAnnotation("dstpu/serving_draft"):
             drafts, lens = self._drafter.propose(histories, want, kb)
@@ -701,6 +1245,7 @@ class ServingEngine:
             reg.counter("serving/spec_verify_steps").inc()
             reg.counter("serving/slot_iterations_active").inc(
                 len(active_slots))
+        t_emit = self._now(now)
         for i in active_slots:
             st = self._slots[i]
             n = int(n_emit[i])
@@ -714,9 +1259,13 @@ class ServingEngine:
                 # by the next prefill into the slot)
                 emitted = emitted[:emitted.index(self.eos_token_id) + 1]
             st.result.tokens.extend(emitted)
+            st.result.token_times.extend([t_emit] * len(emitted))
             st.result.decode_calls += 1
             st.last_token = emitted[-1]
             self.tokens_generated += len(emitted)
+            # stream only the ACCEPTED (post-truncation) block — a
+            # rejected draft token is never observable
+            self._stream(st, emitted)
             self.spec_drafted_tokens += n_drafted
             self.spec_accepted_tokens += n_accepted
             if self._adaptive is not None:
@@ -796,6 +1345,11 @@ class ServingEngine:
             reg.gauge("serving/mean_batch_fill_ratio").set(
                 self._active_slot_iterations /
                 (self.decode_steps * self.num_slots))
+        if self.swap is not None:
+            reg.gauge("serving/swap_buffer_bytes").set(
+                self.swap.bytes_stored)
+            reg.gauge("serving/swap_buffer_peak_bytes").set(
+                self.swap.peak_bytes)
         if self.prefix is not None:
             # cumulative cache effectiveness (counters already streamed
             # per admit/evict/fork by PrefixCache); occupancy covers

@@ -240,22 +240,11 @@ class PrefixCache:
         full, partial = self._match_memoized(prompt)
         self._match_memo = None
         n_total = pool.blocks_for(total_tokens)
-        # pin BEFORE evicting: an unpinned matched leaf is in the LRU
-        # pool, and evicting a block the table is about to name would
-        # hand it to another allocation while this slot still reads it.
         # (The partial COW source needs no pin: even if evicted and
         # reallocated, nothing can WRITE it on device before the copy
         # program the engine issues right after this call — device
         # programs execute in issue order.)
-        for node in full:
-            pool.pin(node.block)
-        self._evict_lru(n_total - len(full))
-        table = pool.tables[slot]
-        table[:] = pool.sentinel
-        for j, node in enumerate(full):
-            table[j] = node.block
-        if full:
-            self._touch(full[-1])
+        table = self._pin_evict_build(slot, full, n_total)
         owned: List[int] = []
         copies: List[Tuple[int, int]] = []
         matched = len(full) * bs
@@ -270,10 +259,8 @@ class PrefixCache:
             self._touch(node)
             if self.registry is not None:
                 self.registry.counter("serving/blocks_cowed").inc()
-        for j in range(len(full) + len(owned), n_total):
-            blk = pool.alloc_block()
-            table[j] = blk
-            owned.append(blk)
+        owned.extend(self._alloc_rest(table, len(full) + len(owned),
+                                      n_total))
         self._records[slot] = _SlotRecord(prompt, full, owned)
         pool.invalidate_tables()
         miss = len(prompt) - matched
@@ -284,14 +271,78 @@ class PrefixCache:
             self.registry.counter("serving/prefix_miss_tokens").inc(miss)
         return matched, copies
 
+    def _pin_evict_build(self, slot: int, full: List[RadixNode],
+                         n_total: int):
+        """Shared admit/readmit table construction: pin the matched
+        chain BEFORE evicting (a matched unpinned leaf must not become
+        an LRU victim of its own admission), make room, rebuild the
+        slot's table with the matched blocks leading, and touch the
+        chain's LRU clock. Returns the (host numpy) table row."""
+        pool = self.pool
+        for node in full:
+            pool.pin(node.block)
+        self._evict_lru(n_total - len(full))
+        table = pool.tables[slot]
+        table[:] = pool.sentinel
+        for j, node in enumerate(full):
+            table[j] = node.block
+        if full:
+            self._touch(full[-1])
+        return table
+
+    def _alloc_rest(self, table, start_j: int, n_total: int) -> List[int]:
+        """Allocate the slot's private blocks for table positions
+        ``start_j .. n_total`` (shared admit/readmit tail)."""
+        owned: List[int] = []
+        for j in range(start_j, n_total):
+            blk = self.pool.alloc_block()
+            table[j] = blk
+            owned.append(blk)
+        return owned
+
+    def readmit(self, slot: int, prompt: Sequence[int],
+                total_tokens: int) -> int:
+        """Rebuild a PREEMPTED request's block table on resume (ISSUE 8
+        swap-in): re-pin whatever full prompt-prefix blocks the trie
+        still holds — their KV is keyed by the same tokens at the same
+        positions, so the host upload skips them — allocate private
+        blocks for the rest, and register the slot record so a later
+        ``finish``/preempt donates normally. Unlike :meth:`admit` there
+        is no COW fork (a partially-overlapping block's content comes
+        from the host swap copy, not a device fork) and no hit/miss
+        token accounting (re-matched blocks avoid swap-in UPLOADS, not
+        prefill compute — counting them as prefix hits would inflate
+        the cache's effectiveness). Returns the number of re-pinned
+        leading shared blocks; the caller uploads host KV only for
+        block positions at or past that count."""
+        pool = self.pool
+        prompt = list(prompt)
+        full, _partial = self._match_memoized(prompt)
+        self._match_memo = None
+        n_total = pool.blocks_for(total_tokens)
+        table = self._pin_evict_build(slot, full, n_total)
+        owned = self._alloc_rest(table, len(full), n_total)
+        self._records[slot] = _SlotRecord(prompt, full, owned)
+        pool.invalidate_tables()
+        return len(full)
+
     # ----------------------------------------------------------- finish
-    def finish(self, slot: int) -> None:
+    def finish(self, slot: int, donate_upto: Optional[int] = None) -> None:
         """Release slot ``slot``: unpin its shared prefix, donate its
         prompt's full private blocks to the trie (insert-on-finish), and
         free everything else (the partial prompt tail and every decode
         block — generated tokens are not indexed: matching happens
         against PROMPTS, and a prompt extending into another request's
-        output is not the workload prefix caching targets)."""
+        output is not the workload prefix caching targets).
+
+        ``donate_upto`` (preemption swap-out, ISSUE 8) caps donation at
+        the tokens the slot actually COMPUTED: a request preempted
+        mid-chunked-prefill has only written ``donate_upto`` positions,
+        and donating a block whose tail was never written would serve
+        garbage KV to every future match. Mid-decode preemption passes
+        its current length, which is >= the prompt length, so the cap
+        is inert there and the whole prompt donates as on a normal
+        finish."""
         rec = self._records.pop(slot, None)
         if rec is None:
             return
@@ -302,7 +353,9 @@ class PrefixCache:
         parent = rec.matched_nodes[-1] if rec.matched_nodes else self.root
         j = len(rec.matched_nodes)
         owned = list(rec.owned)
-        while owned and (j + 1) * bs <= len(rec.prompt):
+        cap = len(rec.prompt) if donate_upto is None \
+            else min(len(rec.prompt), donate_upto)
+        while owned and (j + 1) * bs <= cap:
             blk = owned.pop(0)
             key = tuple(rec.prompt[j * bs:(j + 1) * bs])
             child = parent.children.get(key)

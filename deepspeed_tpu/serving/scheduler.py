@@ -9,6 +9,16 @@ longest request in a static batch finishes — reclaiming the up-to
 (B-1)/B of aggregate capacity a run-to-completion batch wastes on
 stragglers.
 
+Priority classes (ISSUE 8): each request carries an integer ``priority``
+(LOWER value = more latency-critical; 0 is the default and highest
+class). Scheduling is FIFO *within* a class; *across* classes the
+scheduler picks the best effective priority, where waiting time ages a
+request toward the top (``aging_sec``) so the lowest class can never
+starve under sustained high-priority load. With a single class the
+policy degenerates to exactly the original strict FIFO. Preempted
+requests re-enter their class in arrival order (:meth:`resubmit`), so a
+swap-out never costs a request its queue position.
+
 Pure host-side policy: no jax here. The ServingEngine
 (serving/engine.py) owns the compiled programs; this module decides WHO
 runs in WHICH slot and in WHICH prefill bucket.
@@ -18,7 +28,7 @@ from __future__ import annotations
 
 import dataclasses
 from collections import deque
-from typing import List, Optional, Sequence, Tuple
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 
 @dataclasses.dataclass
@@ -29,6 +39,17 @@ class Request:
     prompt: Sequence[int]
     max_new_tokens: int
     arrival_time: float = 0.0
+    # SLO scheduling class (ISSUE 8): lower = more latency-critical
+    # (0 = interactive default). FIFO within a class; the scheduler's
+    # aging promotes long-waiting lower classes so none starves.
+    priority: int = 0
+    # token-streaming callback (ISSUE 8 satellite): invoked once per
+    # COMMITTED token, in emission order, as the engine commits it —
+    # under speculative decoding only ACCEPTED tokens stream (rejected
+    # drafts are never visible). The streamed sequence is exactly
+    # RequestResult.tokens (pinned by tests).
+    on_token: Optional[Callable[[int], None]] = dataclasses.field(
+        default=None, repr=False, compare=False)
 
 
 @dataclasses.dataclass
@@ -48,7 +69,28 @@ class RequestResult:
     # request finished at prefill). One invocation emits ONE token in
     # plain decode but up to k+1 under speculative decoding — TPOT and
     # tokens-per-step accounting divide by THIS, never len(tokens)-1.
+    # Iterations spent PREEMPTED (swapped out of the slot set) are not
+    # invocations and never count here.
     decode_calls: int = 0
+    # scheduling class the request ran under (Request.priority)
+    priority: int = 0
+    # engine-clock timestamp of every committed token, emission order
+    # (token_times[0] == first_token_time). Under speculation the whole
+    # accepted block of a verify step commits at one timestamp. The
+    # bench's inter-token-latency (decode TPOT) tails read these.
+    token_times: List[float] = dataclasses.field(default_factory=list)
+    # chunked-prefill accounting (ISSUE 8): prefill program calls this
+    # request's prompt took (1 = monolithic)
+    prefill_chunks: int = 0
+    # preemption accounting (ISSUE 8): times swapped out, and total wall
+    # spent OFF the slot set (swap-out -> swap-in). Preempted time is
+    # queueing, not decode latency: it counts in queue_wait, and the
+    # portion that fell AFTER the first token (decode_preempted_wall —
+    # a mid-prefill preemption parks before TTFT and must not discount
+    # the decode span) is excluded from the engine's TPOT accounting.
+    preemptions: int = 0
+    preempted_wall: float = 0.0
+    decode_preempted_wall: float = 0.0
 
     @property
     def latency(self) -> float:
@@ -57,6 +99,15 @@ class RequestResult:
     @property
     def first_token_latency(self) -> float:
         return self.first_token_time - self.arrival_time
+
+    @property
+    def queue_wait(self) -> float:
+        """Total time the request spent runnable but not running: the
+        initial queue wait plus every preempted interval (ISSUE 8 —
+        swap-out time is queueing, a preempted request is back in the
+        arrival queue)."""
+        return (max(self.admitted_time - self.arrival_time, 0.0)
+                + self.preempted_wall)
 
 
 def pick_bucket(prompt_len: int, buckets: Sequence[int]) -> Optional[int]:
@@ -69,70 +120,152 @@ def pick_bucket(prompt_len: int, buckets: Sequence[int]) -> Optional[int]:
 
 
 class SlotScheduler:
-    """FIFO iteration-level scheduler over a fixed slot set.
+    """Priority-class iteration-level scheduler over a fixed slot set.
 
-    Invariants (pinned by tests/unit/serving/test_scheduler.py):
+    Invariants (pinned by tests/unit/serving/test_scheduler.py and
+    test_slo.py):
       * a slot is FREE or holds exactly one request; release() makes it
         admissible on the very next admit() call (slot reuse after EOS);
-      * admission is FIFO over arrived requests — a later arrival never
-        jumps an earlier one that a free slot could serve;
+      * admission is FIFO *within* a priority class — a later arrival
+        never jumps an earlier same-class one that a free slot could
+        serve; with a single class (every request at the default
+        priority 0) the policy is exactly the original strict FIFO;
+      * across classes the best EFFECTIVE priority wins:
+        ``priority - waiting_time / aging_sec`` — waiting ages a request
+        toward the top, so the lowest class cannot starve (with
+        ``aging_sec=None`` the raw class always wins and starvation is
+        the caller's problem);
       * admit() never admits a request whose arrival_time is in the
         future, and never over-fills: len(admissions) <= free slots.
     """
 
-    def __init__(self, num_slots: int):
+    def __init__(self, num_slots: int, *, aging_sec: Optional[float] = None):
         self.num_slots = num_slots
+        self.aging_sec = aging_sec
         self._free: deque = deque(range(num_slots))
-        self._waiting: deque = deque()
+        # priority class -> deque[(submit_seq, Request)], FIFO per class
+        self._queues: Dict[int, deque] = {}
+        self._seq = 0
+        # rid -> original submission seq, kept after admission so a
+        # preempted resubmit restores the request's EXACT original
+        # total order (equal-arrival bursts included) — a handful of
+        # ints per request over the scheduler's lifetime
+        self._seq_of: Dict[int, int] = {}
         # accounting for tests / metrics
         self.admissions_per_slot = [0] * num_slots
         self.peak_queue_depth = 0
 
     # ------------------------------------------------------------ queue
     def submit(self, request: Request) -> None:
-        self._waiting.append(request)
-        self.peak_queue_depth = max(self.peak_queue_depth,
-                                    len(self._waiting))
+        q = self._queues.setdefault(request.priority, deque())
+        q.append((self._seq, request))
+        self._seq_of[request.rid] = self._seq
+        self._seq += 1
+        self.peak_queue_depth = max(self.peak_queue_depth, self.waiting)
+
+    def resubmit(self, request: Request) -> None:
+        """Re-queue a PREEMPTED request (ISSUE 8): it re-enters its
+        class under its ORIGINAL submission sequence, restoring its
+        exact original position — ahead of every same-class entry that
+        was originally behind it (equal-arrival bursts and other
+        already-resubmitted preemptees included), so a swap-out costs
+        compute, never queue position."""
+        seq = self._seq_of.get(request.rid)
+        if seq is None:          # resubmit of a never-submitted request
+            seq = self._seq
+            self._seq_of[request.rid] = seq
+            self._seq += 1
+        q = self._queues.setdefault(request.priority, deque())
+        items = list(q)
+        i = 0
+        while i < len(items) and \
+                (items[i][1].arrival_time, items[i][0]) < \
+                (request.arrival_time, seq):
+            i += 1
+        items.insert(i, (seq, request))
+        self._queues[request.priority] = deque(items)
+        self.peak_queue_depth = max(self.peak_queue_depth, self.waiting)
 
     @property
     def waiting(self) -> int:
-        return len(self._waiting)
+        return sum(len(q) for q in self._queues.values())
 
     @property
     def free_slots(self) -> int:
         return len(self._free)
 
     def next_arrival(self) -> Optional[float]:
-        """Arrival time of the QUEUE HEAD — the next request admit() can
-        actually take (admission is strict FIFO, so the engine must idle
-        until the head arrives even if a later submission has an earlier
-        timestamp)."""
-        if not self._waiting:
-            return None
-        return self._waiting[0].arrival_time
+        """Earliest arrival time over the CLASS HEADS — the next instant
+        admit() could take anything (within a class admission is strict
+        FIFO, so a later same-class submission with an earlier timestamp
+        cannot be admitted first and must not defeat the idle sleep)."""
+        heads = [q[0][1].arrival_time for q in self._queues.values() if q]
+        return min(heads) if heads else None
 
     # -------------------------------------------------------- scheduling
+    def effective_priority(self, req: Request, now: float) -> float:
+        """Aged effective priority (lower = runs sooner): waiting time
+        continuously promotes a request (one full class per
+        ``aging_sec`` waited), so any request eventually outranks every
+        fresher arrival — the no-starvation guarantee. The engine's
+        preemption policy consults the same ordering: a victim whose
+        aged priority outranks the candidate keeps its slot."""
+        if not self.aging_sec:
+            return float(req.priority)
+        return req.priority - max(now - req.arrival_time, 0.0) / self.aging_sec
+
+    def _best_head(self, now: float):
+        """(class_queue, seq, request) of the best arrived class head,
+        or None. Tie-break: effective priority, then raw class, then
+        arrival, then submission order — total and deterministic."""
+        best = None
+        for q in self._queues.values():
+            if not q:
+                continue
+            seq, req = q[0]
+            if req.arrival_time > now:
+                continue
+            key = (self.effective_priority(req, now), req.priority,
+                   req.arrival_time, seq)
+            if best is None or key < best[0]:
+                best = (key, q, seq, req)
+        return best[1:] if best is not None else None
+
+    def peek(self, now: float) -> Optional[Request]:
+        """The request admit() would take next (arrived class heads
+        only) — the engine's preemption logic compares its class against
+        the running slots' before swapping anyone out."""
+        head = self._best_head(now)
+        return head[2] if head is not None else None
+
     def admit(self, now: float, fits=None,
               limit: Optional[int] = None) -> List[Tuple[Request, int]]:
         """Pop (request, slot) pairs: arrived requests into free slots,
-        FIFO order, called between decode iterations.
+        best-effective-priority-first (FIFO within a class), called
+        between decode iterations.
 
         ``fits(request) -> bool`` gates admission on a resource the
         scheduler does not own — the block-paged engine (ISSUE 6)
         accounts in free KV-pool BLOCKS rather than whole slots, so a
-        free slot alone is not admissible. FIFO is preserved: a head
-        that does not fit blocks everything behind it (no later arrival
-        jumps the queue on block luck). ``limit`` caps admissions per
-        call — the block engine admits one at a time because each
-        admission consumes blocks the next ``fits`` check must see."""
+        free slot alone is not admissible. Class order is preserved: a
+        best head that does not fit blocks everything behind it (no
+        lower-priority arrival jumps the queue on block luck — the
+        engine's preemption path, not queue-jumping, resolves the
+        shortage). ``limit`` caps admissions per call — the engines
+        admit one at a time because each admission consumes resources
+        the next ``fits``/budget check must see."""
         out: List[Tuple[Request, int]] = []
-        while self._free and self._waiting \
-                and self._waiting[0].arrival_time <= now \
-                and (limit is None or len(out) < limit):
-            if fits is not None and not fits(self._waiting[0]):
+        while self._free and (limit is None or len(out) < limit):
+            head = self._best_head(now)
+            if head is None:
                 break
+            q, _seq, req = head
+            if fits is not None and not fits(req):
+                break
+            q.popleft()
+            if not q:
+                del self._queues[req.priority]
             slot = self._free.popleft()
-            req = self._waiting.popleft()
             self.admissions_per_slot[slot] += 1
             out.append((req, slot))
         return out
@@ -220,4 +353,103 @@ def shared_prefix_trace(rng, n_requests: int, *, rate: float,
             prompt=prefixes[int(rng.randint(len(prefixes)))] + suffix,
             max_new_tokens=max_new_tokens,
             arrival_time=t))
+    return reqs
+
+
+def _rand_prompt(rng, plen: int, vocab_size: int) -> List[int]:
+    return rng.randint(0, vocab_size, size=int(plen)).astype("int32").tolist()
+
+
+def bursty_poisson_trace(rng, n_requests: int, *, burst_size: int,
+                         burst_rate: float, prompt_lens: Sequence[int],
+                         max_new_choices: Sequence[int], vocab_size: int,
+                         priorities: Sequence[int] = (0,),
+                         start_rid: int = 0) -> List[Request]:
+    """Synthetic ADVERSARIAL bursty arrival trace (ISSUE 8): burst START
+    times are Poisson at ``burst_rate`` bursts/sec, and each burst lands
+    ``burst_size`` requests at the same instant — the flash-crowd shape
+    (cache stampedes, retry storms, fan-out backends) that overwhelms
+    admission far beyond what the mean arrival rate suggests. Prompt
+    lengths, output budgets, and priority classes are drawn uniformly
+    from their choice sets per request."""
+    reqs: List[Request] = []
+    t = 0.0
+    while len(reqs) < n_requests:
+        t += float(rng.exponential(1.0 / burst_rate)) if burst_rate > 0 \
+            else 0.0
+        for _ in range(min(burst_size, n_requests - len(reqs))):
+            reqs.append(Request(
+                rid=start_rid + len(reqs),
+                prompt=_rand_prompt(rng, rng.choice(list(prompt_lens)),
+                                    vocab_size),
+                max_new_tokens=int(rng.choice(list(max_new_choices))),
+                arrival_time=t,
+                priority=int(rng.choice(list(priorities)))))
+    return reqs
+
+
+def bimodal_trace(rng, n_requests: int, *, rate: float,
+                  short_lens: Sequence[int], long_lens: Sequence[int],
+                  long_frac: float, short_new: Sequence[int],
+                  long_new: Sequence[int], vocab_size: int,
+                  short_priority: int = 0, long_priority: int = 1,
+                  start_rid: int = 0) -> List[Request]:
+    """Synthetic BIMODAL prompt-length trace (the ISSUE-8 acceptance
+    workload): mostly short interactive prompts at the latency-critical
+    class, with a ``long_frac`` fraction of long-prompt requests at a
+    lower class — the mix where one monolithic long prefill monopolizes
+    an iteration and every decoding tenant's TPOT spikes (exactly the
+    stall chunked prefill + priority scheduling eliminate). Poisson
+    arrivals at ``rate`` requests/sec like :func:`poisson_trace`."""
+    reqs: List[Request] = []
+    t = 0.0
+    for i in range(n_requests):
+        t += float(rng.exponential(1.0 / rate)) if rate > 0 else 0.0
+        long = bool(rng.rand() < long_frac)
+        reqs.append(Request(
+            rid=start_rid + i,
+            prompt=_rand_prompt(
+                rng, rng.choice(list(long_lens if long else short_lens)),
+                vocab_size),
+            max_new_tokens=int(rng.choice(
+                list(long_new if long else short_new))),
+            arrival_time=t,
+            priority=long_priority if long else short_priority))
+    return reqs
+
+
+def straggler_trace(rng, n_requests: int, *, rate: float,
+                    prompt_lens: Sequence[int],
+                    max_new_choices: Sequence[int],
+                    straggler_every: int, straggler_prompt_len: int,
+                    straggler_max_new: int, vocab_size: int,
+                    straggler_priority: int = 1,
+                    start_rid: int = 0) -> List[Request]:
+    """Short interactive traffic with periodic LONG-CONTEXT STRAGGLERS
+    (ISSUE 8): every ``straggler_every``-th request carries a
+    ``straggler_prompt_len`` prompt and a ``straggler_max_new`` output
+    budget at a lower priority class — the document-summarization /
+    batch-analytics tenant mixed into a chat workload, the canonical
+    preemption + chunked-prefill stressor. Poisson arrivals at ``rate``
+    like :func:`poisson_trace`."""
+    if straggler_every < 1:
+        raise ValueError(f"straggler_every must be >= 1, "
+                         f"got {straggler_every}")
+    reqs: List[Request] = []
+    t = 0.0
+    for i in range(n_requests):
+        t += float(rng.exponential(1.0 / rate)) if rate > 0 else 0.0
+        if (i + 1) % straggler_every == 0:
+            reqs.append(Request(
+                rid=start_rid + i,
+                prompt=_rand_prompt(rng, straggler_prompt_len, vocab_size),
+                max_new_tokens=straggler_max_new,
+                arrival_time=t, priority=straggler_priority))
+        else:
+            reqs.append(Request(
+                rid=start_rid + i,
+                prompt=_rand_prompt(rng, rng.choice(list(prompt_lens)),
+                                    vocab_size),
+                max_new_tokens=int(rng.choice(list(max_new_choices))),
+                arrival_time=t))
     return reqs

@@ -1,0 +1,75 @@
+"""Host-side KV swap buffer for preempted serving requests (ISSUE 8).
+
+Preemption with KV swap (the vLLM swap-space idea, in the spirit of this
+framework's ``runtime/swap_tensor`` device<->host offload machinery but
+scoped to serving): under resource pressure the scheduler swaps the
+lowest-priority slot's KV OUT to host memory — freeing its slot/pool
+blocks for a higher-priority request — and swaps it back IN when the
+request resumes, bit-identical. The device halves live in
+``ops/attention`` (extract/insert_slot_kv, gather/scatter_pool_blocks)
+driven by the engine's jitted swap programs; this module owns the host
+side: plain numpy arrays keyed by request id, with byte accounting so
+telemetry (``serving/swap_buffer_bytes`` / peak) can watch host-memory
+pressure.
+
+Restore correctness does not depend on what happened on device while
+the request was parked here: the buffer holds an exact copy of every KV
+position the request had computed, so even total eviction of its blocks
+(block-paged mode) or full slot reuse (slot-paged mode) cannot lose
+state.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Tuple
+
+import numpy as np
+
+
+class HostSwapBuffer:
+    """Numpy parking lot for preempted requests' KV rows/blocks.
+
+    One entry per preempted request id: ``put`` on swap-out, ``pop`` on
+    swap-in (entries are single-use — a resumed request's KV lives on
+    device again, and keeping the stale host copy around would invite
+    restoring it twice). Byte accounting covers exactly what is stored;
+    ``peak_bytes`` is the high-water mark a deployment sizes its host
+    reservation against.
+    """
+
+    def __init__(self):
+        self._entries: Dict[int, Tuple[np.ndarray, np.ndarray]] = {}
+        self.bytes_stored = 0
+        self.peak_bytes = 0
+        self.total_swaps_out = 0
+        self.total_swaps_in = 0
+
+    def put(self, rid: int, k: np.ndarray, v: np.ndarray) -> None:
+        if rid in self._entries:
+            raise ValueError(
+                f"request {rid} is already swapped out (double preemption "
+                f"without a resume)")
+        self._entries[rid] = (k, v)
+        self.bytes_stored += k.nbytes + v.nbytes
+        self.peak_bytes = max(self.peak_bytes, self.bytes_stored)
+        self.total_swaps_out += 1
+
+    def pop(self, rid: int) -> Tuple[np.ndarray, np.ndarray]:
+        if rid not in self._entries:
+            raise KeyError(
+                f"request {rid} has no swapped-out KV (resume without a "
+                f"preemption, or a double resume)")
+        k, v = self._entries.pop(rid)
+        self.bytes_stored -= k.nbytes + v.nbytes
+        self.total_swaps_in += 1
+        return k, v
+
+    def __contains__(self, rid: int) -> bool:
+        return rid in self._entries
+
+    def __len__(self) -> int:
+        return len(self._entries)
+
+    def __repr__(self):
+        return (f"HostSwapBuffer(entries={len(self._entries)}, "
+                f"bytes={self.bytes_stored}, peak={self.peak_bytes})")

@@ -20,4 +20,9 @@ grep -qs "def test_" tests/unit/serving/test_speculative.py || { echo "tier-1: s
 # KV + radix COW-losslessness/eviction/zero-recompile invariants ride
 # `-m 'not slow'` through tests/unit/serving/test_prefix_cache.py
 grep -qs "def test_" tests/unit/serving/test_prefix_cache.py || { echo "tier-1: prefix-cache tests missing"; exit 1; }
+# likewise the SLO-scheduling suite (marker `slo`): chunked-prefill
+# losslessness, priority/preemption KV-swap round-trip bit-identity and
+# zero-recompile invariants ride `-m 'not slow'` through
+# tests/unit/serving/test_slo.py
+grep -qs "def test_" tests/unit/serving/test_slo.py || { echo "tier-1: slo tests missing"; exit 1; }
 exit $rc

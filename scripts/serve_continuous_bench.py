@@ -18,8 +18,17 @@ the default continuous-vs-static bench) on a shared-prefix multi-tenant
 trace, reporting TTFT p50/p95, prefill tokens computed, cache hit rate,
 COW/eviction counters, and the zero-recompile + lossless checks.
 
+``--slo``: the ISSUE-8 comparison instead — SLO-aware serving (chunked
+prefill under a per-iteration token budget, priority classes with
+aging, preemption with host KV swap) vs the FIFO monolithic-prefill
+engine on a bimodal long-prompt trace, reporting decode-TPOT
+(inter-token latency) and TTFT p50/p95/p99 overall and per priority
+class, throughput, preemption/chunk counters, and the zero-recompile +
+lossless checks in BOTH cache modes.
+
 Usage: python scripts/serve_continuous_bench.py [--speculative MODE]
                                                 [--prefix-cache {on,off}]
+                                                [--slo]
 Prints one JSON object (the matching entry of bench.py).
 """
 import argparse
@@ -43,20 +52,31 @@ def main():
                         "against the cache-off engine on a shared-prefix "
                         "multi-tenant trace instead of continuous-vs-"
                         "static")
+    p.add_argument("--slo", action="store_true",
+                   help="compare SLO-aware serving (chunked prefill + "
+                        "priority classes + preemption w/ host KV swap) "
+                        "against the FIFO monolithic-prefill engine on a "
+                        "bimodal long-prompt trace, both cache modes, "
+                        "instead of continuous-vs-static")
     args = p.parse_args()
-    if args.prefix_cache == "on" and args.speculative != "off":
-        p.error("--prefix-cache on and --speculative are separate "
-                "comparisons; pass one or the other")
+    exclusive = [args.prefix_cache == "on", args.speculative != "off",
+                 args.slo]
+    if sum(exclusive) > 1:
+        p.error("--prefix-cache on, --speculative, and --slo are separate "
+                "comparisons; pass one of them")
 
     import jax
 
     from bench import (_bench_continuous_serving,
                        _bench_prefix_cache_serving,
+                       _bench_slo_serving,
                        _bench_speculative_serving)
 
     on_tpu = any(d.platform in ("tpu", "axon") or "TPU" in str(d.device_kind)
                  for d in jax.devices())
-    if args.prefix_cache == "on":
+    if args.slo:
+        out = _bench_slo_serving(on_tpu)
+    elif args.prefix_cache == "on":
         out = _bench_prefix_cache_serving(on_tpu)
     elif args.speculative != "off":
         out = _bench_speculative_serving(on_tpu, mode=args.speculative)

@@ -88,6 +88,7 @@ def aggregate(records):
         "events": events,
         "speculation": _speculation_summary(metrics),
         "prefix_cache": _prefix_cache_summary(metrics),
+        "slo": _slo_summary(metrics),
         "n_records": len(records),
     }
 
@@ -153,6 +154,45 @@ def _prefix_cache_summary(metrics):
     return out
 
 
+def _slo_summary(metrics):
+    """Derived SLO-scheduling view (ISSUE 8) over the serving engine's
+    raw counters/gauges/histograms: overload-control actions (chunked
+    prefill, TPOT-guard deferrals, preemptions, host swap traffic) and
+    the per-priority-class latency tails. Empty dict when the run never
+    used the SLO machinery."""
+    counters = metrics.get("counters", {})
+    hists = metrics.get("histograms", {})
+    per_class = {name: h for name, h in hists.items()
+                 if (name.startswith("serving/ttft_ms/p")
+                     or name.startswith("serving/tpot_ms/p"))
+                 and h.get("count")}
+    keys = ("serving/prefill_chunks", "serving/preemptions",
+            "serving/slo_deferred_steps", "serving/swapped_blocks_out",
+            "serving/swapped_blocks_in")
+    # the engine records per-class histograms unconditionally (every
+    # request has a class — p0 by default), so class histograms only
+    # signal SLO usage when a NON-default class appears; otherwise a
+    # plain serving run would grow a noise section
+    multi_class = any(not name.endswith("/p0") for name in per_class)
+    if not any(counters.get(k) for k in keys) and not multi_class:
+        return {}
+    out = {}
+    for k in keys:
+        if counters.get(k) is not None:
+            out[k.split("/", 1)[1]] = counters[k]
+    gauges = metrics.get("gauges", {})
+    for key, name in (("serving/swap_buffer_bytes", "swap_buffer_bytes"),
+                      ("serving/swap_buffer_peak_bytes",
+                       "swap_buffer_peak_bytes")):
+        if gauges.get(key) is not None:
+            out[name] = gauges[key]
+    for name, h in sorted(per_class.items()):
+        out[name.split("/", 1)[1]] = {
+            "count": h.get("count"), "p50": h.get("p50"),
+            "p95": h.get("p95"), "p99": h.get("p99")}
+    return out
+
+
 def _fmt(v):
     if v is None:
         return "-"
@@ -204,6 +244,10 @@ def render(agg):
     _table("prefix_cache", ("metric", "value"),
            [(k, _fmt(v)) for k, v in agg.get("prefix_cache", {}).items()],
            out)
+    _table("slo", ("metric", "value"),
+           [(k, _fmt(v) if not isinstance(v, dict) else
+             " ".join(f"{kk}={_fmt(vv)}" for kk, vv in v.items()))
+            for k, v in agg.get("slo", {}).items()], out)
     erows = [(k, e["count"],
               json.dumps(e["last"], default=str)[:60])
              for k, e in agg["events"].items()]

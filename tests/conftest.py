@@ -296,7 +296,8 @@ _OBSERVABILITY_MODULES = ("unit/monitor/", "unit/telemetry/",
                           "utils/test_timer", "utils/test_comms_logging")
 _LATE_MODULES = _OBSERVABILITY_MODULES + (
     "unit/serving/test_speculative",
-    "unit/serving/test_prefix_cache",)
+    "unit/serving/test_prefix_cache",
+    "unit/serving/test_slo",)
 
 
 def pytest_collection_modifyitems(config, items):

@@ -1,0 +1,579 @@
+"""SLO-aware serving under overload (ISSUE 8 acceptance).
+
+All on CPU with tiny models. Pinned here:
+  * CHUNKED PREFILL is lossless: a prompt longer than the largest
+    bucket (or longer than the per-iteration budget) prefills in
+    fixed-bucket-sized chunks interleaved with decode, and every
+    request's greedy stream is BIT-IDENTICAL to the monolithic-prefill
+    engine's — in BOTH cache modes (slot-paged and block-paged);
+  * zero recompiles across chunk transitions, preemption/resume, and
+    speculation (program_cache_sizes stays at one entry per program);
+  * PREEMPTION ROUND TRIP is bit-identical: a request preempted
+    mid-decode, swapped out to the host buffer, swapped back in, and
+    finished produces exactly the tokens of an uninterrupted run (both
+    cache modes);
+  * latency accounting: TTFT is stamped when the LAST chunk emits the
+    first token, decode_calls never counts swapped-out iterations, and
+    queue_wait includes time spent preempted;
+  * token streaming: the on_token callback sees exactly
+    RequestResult.tokens, in order — under speculation only accepted
+    tokens stream;
+  * priority scheduling: FIFO within a class, higher class first
+    across classes, aging promotes the lowest class (no starvation),
+    resubmit preserves arrival order;
+  * the adversarial trace generators are reproducible and carry the
+    advertised shapes/priorities.
+"""
+
+import numpy as np
+import pytest
+
+import deepspeed_tpu
+from deepspeed_tpu.models.gpt2 import GPT2Config, GPT2Model
+from deepspeed_tpu.serving import (HostSwapBuffer, Request, ServingEngine,
+                                   SlotScheduler, SpeculativeConfig,
+                                   bimodal_trace, bursty_poisson_trace,
+                                   straggler_trace)
+from deepspeed_tpu.utils import groups
+
+pytestmark = [pytest.mark.slo, pytest.mark.serving, pytest.mark.quick]
+
+BS = 16  # block size for the block-paged variants
+
+
+class VirtualClock:
+    def __init__(self, dt=0.001):
+        self.t = 0.0
+        self.dt = dt
+
+    def __call__(self):
+        self.t += self.dt
+        return self.t
+
+
+_ENGINE = {}
+
+
+def _inference_engine():
+    """One InferenceEngine per module run: every ServingEngine variant
+    shares its params AND its compiled-program cache, which is exactly
+    the production shape (and keeps this module fast)."""
+    if "eng" not in _ENGINE:
+        groups.reset()
+        cfg = GPT2Config.tiny()
+        _ENGINE["cfg"] = cfg
+        _ENGINE["eng"] = deepspeed_tpu.init_inference(
+            GPT2Model(cfg), dtype="fp32", max_out_tokens=128)
+    return _ENGINE["cfg"], _ENGINE["eng"]
+
+
+def _serving(prefix_cache=False, num_slots=4, max_len=128,
+             buckets=(16, 96), **kw):
+    cfg, eng = _inference_engine()
+    kw.setdefault("time_fn", VirtualClock())
+    kw.setdefault("telemetry", False)
+    if prefix_cache:
+        kw.setdefault("block_size", BS)
+    return cfg, ServingEngine(eng, num_slots=num_slots, max_len=max_len,
+                              buckets=buckets, prefix_cache=prefix_cache,
+                              **kw)
+
+
+def _prompts(cfg, lens, seed=0):
+    rng = np.random.RandomState(seed)
+    return [rng.randint(0, cfg.vocab_size, size=l).tolist() for l in lens]
+
+
+# ----------------------------------------------------------- scheduler
+def test_priority_classes_order_and_fifo_within_class():
+    s = SlotScheduler(1)
+    s.submit(Request(rid=0, prompt=[1], max_new_tokens=1, priority=1))
+    s.submit(Request(rid=1, prompt=[1], max_new_tokens=1, priority=0))
+    s.submit(Request(rid=2, prompt=[1], max_new_tokens=1, priority=0))
+    order = []
+    while s.waiting:
+        [(req, slot)] = s.admit(now=10.0)
+        order.append(req.rid)
+        s.release(slot)
+    # class 0 first (FIFO within it), class 1 last
+    assert order == [1, 2, 0]
+
+
+def test_aging_promotes_lowest_class():
+    s = SlotScheduler(1, aging_sec=1.0)
+    s.submit(Request(rid=0, prompt=[1], max_new_tokens=1, priority=3,
+                     arrival_time=0.0))
+    s.submit(Request(rid=1, prompt=[1], max_new_tokens=1, priority=0,
+                     arrival_time=5.0))
+    # at t=5 rid0 has aged 5 classes: effective 3-5 < 0 -> beats rid1
+    assert s.peek(5.0).rid == 0
+    # without aging the raw class would win
+    s2 = SlotScheduler(1)
+    s2.submit(Request(rid=0, prompt=[1], max_new_tokens=1, priority=3,
+                      arrival_time=0.0))
+    s2.submit(Request(rid=1, prompt=[1], max_new_tokens=1, priority=0,
+                      arrival_time=5.0))
+    assert s2.peek(5.0).rid == 1
+
+
+def test_resubmit_rejoins_class_in_arrival_order():
+    s = SlotScheduler(1)
+    for i, t in enumerate((0.0, 1.0, 2.0)):
+        s.submit(Request(rid=i, prompt=[1], max_new_tokens=1,
+                         arrival_time=t))
+    [(r0, slot)] = s.admit(now=5.0)
+    assert r0.rid == 0
+    s.release(slot)
+    s.resubmit(r0)  # preempted: back before rids 1 and 2
+    [(again, _)] = s.admit(now=5.0)
+    assert again.rid == 0
+
+
+def test_resubmit_preserves_order_across_equal_arrival_burst():
+    """Two same-class requests from one burst (identical arrival_time),
+    both admitted then both preempted: resubmission restores the
+    ORIGINAL submission order (rid 0 before rid 1), not LIFO — the
+    original seq, not the resubmit instant, keys the re-entry."""
+    s = SlotScheduler(2)
+    for i in range(3):
+        s.submit(Request(rid=i, prompt=[1], max_new_tokens=1,
+                         arrival_time=0.0))
+    pairs = s.admit(now=1.0)
+    assert [r.rid for r, _ in pairs] == [0, 1]
+    for (req, slot) in reversed(pairs):   # preempt rid 1 first, then 0
+        s.release(slot)
+        s.resubmit(req)
+    order = []
+    while s.waiting:
+        [(req, slot)] = s.admit(now=1.0, limit=1)
+        order.append(req.rid)
+        s.release(slot)
+    assert order == [0, 1, 2]
+
+
+def test_next_arrival_is_min_over_class_heads():
+    s = SlotScheduler(1)
+    s.submit(Request(rid=0, prompt=[1], max_new_tokens=1, priority=0,
+                     arrival_time=10.0))
+    s.submit(Request(rid=1, prompt=[1], max_new_tokens=1, priority=1,
+                     arrival_time=2.0))
+    # within class 0 the head gates (strict FIFO), but class 1's head is
+    # independently admittable at t=2
+    assert s.next_arrival() == 2.0
+    [(req, _)] = s.admit(now=2.0)
+    assert req.rid == 1
+
+
+# ----------------------------------------------------- chunked prefill
+@pytest.mark.parametrize("prefix_cache", [False, True])
+def test_chunked_prefill_lossless_and_zero_recompiles(prefix_cache):
+    """A prompt LONGER than the largest bucket (chunked engine) plus
+    mixed neighbors: every stream bit-identical to the monolithic
+    engine; all jit caches stay at one entry."""
+    cfg, mono = _serving(prefix_cache, buckets=(16, 96))
+    prompts = _prompts(cfg, [70, 9, 23, 40])
+    reqs = lambda: [Request(rid=i, prompt=p, max_new_tokens=8)  # noqa: E731
+                    for i, p in enumerate(prompts)]
+    base = {r.rid: r.tokens for r in mono.run(reqs())}
+
+    _, chunked = _serving(prefix_cache, buckets=(16,),
+                          prefill_token_budget=16)
+    res = chunked.run(reqs())
+    assert {r.rid: r.tokens for r in res} == base
+    # the 70-token prompt could only have run in >= 5 chunks of 16
+    assert {r.rid: r.prefill_chunks for r in res}[0] >= 5
+    sizes = chunked.program_cache_sizes()
+    assert all(v == 1 for v in sizes.values()), sizes
+    assert chunked.recompile_count() == 0
+
+
+def test_submit_long_prompt_requires_chunking():
+    cfg, srv = _serving(buckets=(16,))
+    long_prompt = _prompts(cfg, [40])[0]
+    with pytest.raises(ValueError, match="prefill_token_budget"):
+        srv.submit(Request(rid=0, prompt=long_prompt, max_new_tokens=4))
+    _, chunked = _serving(buckets=(16,), prefill_token_budget=16)
+    chunked.submit(Request(rid=0, prompt=long_prompt, max_new_tokens=4))
+    [r] = chunked.run([])  # already submitted
+    assert len(r.tokens) == 4
+    # slot capacity still binds
+    with pytest.raises(ValueError, match="slot capacity"):
+        chunked.submit(Request(rid=1, prompt=_prompts(cfg, [120])[0],
+                               max_new_tokens=30))
+
+
+def test_prefill_budget_must_hold_a_bucket():
+    with pytest.raises(ValueError, match="smallest prefill bucket"):
+        _serving(buckets=(16, 96), prefill_token_budget=8)
+
+
+def test_chunked_ttft_stamped_at_last_chunk():
+    """TTFT is the FIRST TOKEN's commit (after the last chunk), not the
+    admission instant (ISSUE 8 latency-accounting fix); token_times[0]
+    is that same stamp, and decode_calls counts only decode
+    invocations."""
+    cfg, srv = _serving(buckets=(16,), prefill_token_budget=16)
+    clock = srv._time
+    prompt = _prompts(cfg, [70])[0]
+    [r] = srv.run([Request(rid=0, prompt=prompt, max_new_tokens=6)])
+    assert r.prefill_chunks == 5
+    assert r.token_times[0] == r.first_token_time
+    # 5 chunks ran between admission and the first token: on the
+    # virtual clock (every read advances it) the stamp must be strictly
+    # later than admission
+    assert r.first_token_time > r.admitted_time
+    assert len(r.token_times) == len(r.tokens)
+    assert r.decode_calls == len(r.tokens) - 1
+    assert clock.t > 0  # the injected clock drove the run
+
+
+def test_chunked_prefill_interleaves_decode():
+    """Stall-free scheduling: while a long prompt chunk-prefills, an
+    already-running request keeps emitting tokens (the monolithic
+    engine would stall it for the whole prefill)."""
+    cfg, srv = _serving(buckets=(16,), prefill_token_budget=16,
+                        num_slots=2)
+    short, long_p = _prompts(cfg, [9, 70])
+    srv.submit(Request(rid=0, prompt=short, max_new_tokens=12))
+    srv.warmup()
+    # let the short request prefill + decode a little
+    srv.step()
+    srv.step()
+    tokens_before = len(srv._slots[0].result.tokens) \
+        if srv._slots[0] else 0
+    srv.submit(Request(rid=1, prompt=long_p, max_new_tokens=2))
+    # one step: the long prompt gets ONE 16-token chunk, short decodes
+    srv.step()
+    st0 = srv._slots[0]
+    st1 = srv._slots[1]
+    assert st1 is not None and st1.prefilling  # mid-prefill
+    assert st1.result.tokens == []             # no token before last chunk
+    assert len(st0.result.tokens) == tokens_before + 1  # decoded anyway
+    # drain
+    res = srv.run([])
+    assert {r.rid for r in res} == {0, 1}
+
+
+# --------------------------------------------------------- preemption
+@pytest.mark.parametrize("prefix_cache", [False, True])
+def test_preemption_round_trip_bit_identical(prefix_cache):
+    """A low-priority request preempted mid-decode (swapped out to
+    host, blocks/slot freed, swapped back in) finishes with EXACTLY the
+    tokens of an uninterrupted run — prefix cache on and off."""
+    cfg, _ = _serving(prefix_cache)
+    pA, pB = _prompts(cfg, [21, 9], seed=3)
+    solo = {}
+    for rid, p, mn in ((0, pA, 24), (1, pB, 6)):
+        _, s = _serving(prefix_cache, num_slots=1, buckets=(16, 32))
+        [r] = s.run([Request(rid=rid, prompt=p, max_new_tokens=mn)])
+        solo[rid] = r.tokens
+
+    _, srv = _serving(prefix_cache, num_slots=1, buckets=(16, 32),
+                      preemption="swap")
+    res = {r.rid: r for r in srv.run([
+        Request(rid=0, prompt=pA, max_new_tokens=24, priority=1,
+                arrival_time=0.0),
+        Request(rid=1, prompt=pB, max_new_tokens=6, priority=0,
+                arrival_time=0.02)])}
+    rA, rB = res[0], res[1]
+    assert rA.preemptions >= 1
+    assert srv.preemptions == rA.preemptions
+    assert rA.tokens == solo[0]
+    assert rB.tokens == solo[1]
+    # decode_calls never counts swapped-out iterations: plain decode is
+    # one call per token after the first, preempted or not
+    assert rA.decode_calls == len(rA.tokens) - 1
+    assert rB.decode_calls == len(rB.tokens) - 1
+    # queue-wait includes the preempted interval; the preemption was
+    # mid-DECODE (first token already out), so the TPOT accounting's
+    # decode-phase share covers it in full
+    assert rA.preempted_wall > 0
+    assert rA.queue_wait >= rA.preempted_wall
+    assert rA.decode_preempted_wall == rA.preempted_wall
+    assert rB.preempted_wall == 0
+    # swap traffic flowed both ways and the buffer drained
+    assert srv.swapped_blocks_out >= 1 and srv.swapped_blocks_in >= 1
+    assert len(srv.swap) == 0 and srv.swap.bytes_stored == 0
+    assert srv.swap.peak_bytes > 0
+    # swap programs were warmed: the whole episode compiled nothing
+    sizes = srv.program_cache_sizes()
+    assert "swap_out" in sizes and "swap_in" in sizes
+    assert all(v == 1 for v in sizes.values()), sizes
+
+
+def test_no_preemption_without_strictly_lower_class():
+    """Same-class pressure never preempts (it would thrash): the later
+    arrival waits for the slot like plain FIFO."""
+    cfg, srv = _serving(num_slots=1, buckets=(16, 32), preemption="swap")
+    pA, pB = _prompts(cfg, [9, 9], seed=5)
+    res = {r.rid: r for r in srv.run([
+        Request(rid=0, prompt=pA, max_new_tokens=10, priority=0),
+        Request(rid=1, prompt=pB, max_new_tokens=4, priority=0,
+                arrival_time=0.01)])}
+    assert srv.preemptions == 0
+    assert res[0].preemptions == 0
+    # FIFO: rid 0 finished before rid 1 was admitted
+    assert res[1].admitted_time >= res[0].finish_time
+
+
+def test_aged_victim_keeps_slot_no_preemption_ping_pong():
+    """A victim whose AGED effective priority outranks the candidate is
+    not preempted: after resubmit it would rank ahead of the candidate
+    and be swapped straight back in — an infinite resume->preempt
+    ping-pong inside one scheduling pass. The guard compares the same
+    effective order admission uses, so the aged low-class request keeps
+    its slot and the candidate waits like plain FIFO."""
+    cfg, _ = _serving()
+    pA, pB = _prompts(cfg, [9, 9], seed=13)
+    # aging 0.01s on the virtual clock (dt=0.001): by the time B
+    # arrives, A has aged far past class 0
+    _, srv = _serving(num_slots=1, buckets=(16, 32), preemption="swap",
+                      priority_aging_sec=0.01)
+    res = {r.rid: r for r in srv.run([
+        Request(rid=0, prompt=pA, max_new_tokens=20, priority=3,
+                arrival_time=0.0),
+        Request(rid=1, prompt=pB, max_new_tokens=4, priority=0,
+                arrival_time=0.05)])}
+    assert srv.preemptions == 0
+    assert res[0].preemptions == 0
+    # the run terminated (no ping-pong) and FIFO-by-aging held
+    assert res[1].admitted_time >= res[0].finish_time
+
+
+def test_fresh_victim_is_preempted_under_aging():
+    """The eff-priority guard must not disable preemption outright: a
+    FRESH lower-class victim (aged less than the candidate's class
+    gap) still gets swapped out."""
+    cfg, _ = _serving()
+    pA, pB = _prompts(cfg, [9, 9], seed=17)
+    # aging 10s: negligible on this sub-second virtual-clock run
+    _, srv = _serving(num_slots=1, buckets=(16, 32), preemption="swap",
+                      priority_aging_sec=10.0)
+    res = {r.rid: r for r in srv.run([
+        Request(rid=0, prompt=pA, max_new_tokens=20, priority=3,
+                arrival_time=0.0),
+        Request(rid=1, prompt=pB, max_new_tokens=4, priority=0,
+                arrival_time=0.02)])}
+    assert srv.preemptions >= 1
+    assert res[0].preemptions >= 1
+
+
+def test_preemption_mid_chunked_prefill_round_trip():
+    """Preempting a slot that is still CHUNK-PREFILLING parks its
+    partial KV and resumes the remaining chunks — the stream still
+    matches the uninterrupted run (block-paged: the donate cap keeps
+    half-written blocks out of the radix index)."""
+    cfg, _ = _serving(True)
+    pA, pB = _prompts(cfg, [70, 9], seed=7)
+    _, s = _serving(True, num_slots=1, buckets=(16, 32),
+                    prefill_token_budget=16)
+    [rsolo] = s.run([Request(rid=0, prompt=pA, max_new_tokens=8)])
+
+    _, srv = _serving(True, num_slots=1, buckets=(16, 32),
+                      prefill_token_budget=16, preemption="swap")
+    # B arrives while A (5 chunks of 16) is still prefilling
+    res = {r.rid: r for r in srv.run([
+        Request(rid=0, prompt=pA, max_new_tokens=8, priority=1,
+                arrival_time=0.0),
+        Request(rid=1, prompt=pB, max_new_tokens=3, priority=0,
+                arrival_time=0.002)])}
+    assert res[0].preemptions >= 1
+    assert res[0].tokens == rsolo.tokens
+    assert srv.recompile_count() == 0
+    # the park happened BEFORE the first token: it counts as queue wait
+    # but must not discount the decode span (TPOT accounting fix)
+    assert res[0].preempted_wall > 0
+    assert res[0].decode_preempted_wall == 0
+
+
+# ---------------------------------------------------------- streaming
+@pytest.mark.parametrize("speculative", [None, "ngram"])
+def test_streamed_tokens_equal_result_tokens(speculative):
+    """on_token sees exactly RequestResult.tokens, in order — under
+    speculation only ACCEPTED tokens stream (a rejected draft is never
+    observable)."""
+    cfg, _ = _serving()
+    spec = None
+    if speculative:
+        spec = SpeculativeConfig(mode="ngram", k_buckets=(4, 8))
+    _, srv = _serving(buckets=(16, 48), num_slots=2, speculative=spec)
+    rng = np.random.RandomState(2)
+    pattern = rng.randint(0, cfg.vocab_size, size=6).tolist()
+    streams = {}
+    reqs = []
+    for i in range(4):
+        streams[i] = []
+        reqs.append(Request(rid=i, prompt=pattern * 6, max_new_tokens=16,
+                            on_token=(lambda i=i: lambda t:
+                                      streams[i].append(t))()))
+    res = srv.run(reqs)
+    assert len(res) == 4
+    for r in res:
+        assert streams[r.rid] == r.tokens
+    if speculative:
+        # the trace is templated: speculation actually accepted drafts,
+        # so multi-token commits streamed (not the 1-token trivial case)
+        assert srv.spec_accepted_tokens > 0
+
+
+# ---------------------------------------------------------- SLO guard
+def test_tpot_slo_defers_prefill_then_yields():
+    """With the decode-gap EMA over budget AND prefill work pending,
+    the iteration prefill budget drops to 0 (decode runs untaxed) —
+    but never more than slo_max_defer times in a row, so prefill
+    always progresses. Idle at-risk iterations (nothing to defer)
+    neither defer nor burn the streak."""
+    cfg, srv = _serving(buckets=(16,), prefill_token_budget=16,
+                        tpot_slo_ms=5.0, slo_max_defer=3, num_slots=2)
+    srv.warmup()
+    # a decode-phase slot exists and decode is "slow": defer
+    srv.submit(Request(rid=0, prompt=_prompts(cfg, [9])[0],
+                       max_new_tokens=30))
+    srv.step()
+    assert srv._slots[0] is not None and not srv._slots[0].prefilling
+    srv._decode_gap_ema = 0.1  # 100 ms >> 5 ms budget
+    now = srv._time()
+    # no prefill work pending: grant trivially, streak untouched
+    assert srv._iteration_prefill_budget(now) == 16
+    assert srv.slo_deferred_steps == 0
+    # an arrived fresh head IS deferrable work
+    srv.submit(Request(rid=1, prompt=_prompts(cfg, [40], seed=2)[0],
+                       max_new_tokens=4))
+    assert srv._iteration_prefill_budget(now) == 0
+    assert srv._iteration_prefill_budget(now) == 0
+    assert srv._iteration_prefill_budget(now) == 0
+    # streak exhausted: prefill gets its budget back
+    assert srv._iteration_prefill_budget(now) == 16
+    assert srv.slo_deferred_steps == 3
+    # healthy decode: no deferral
+    srv._decode_gap_ema = 0.001
+    assert srv._iteration_prefill_budget(now) == 16
+    # drain so the engine state is consistent
+    srv.run([])
+
+
+def test_tpot_slo_requires_budget():
+    with pytest.raises(ValueError, match="tpot_slo_ms"):
+        _serving(tpot_slo_ms=5.0)
+
+
+# ------------------------------------------------------------- traces
+def test_trace_generators_reproducible_and_shaped():
+    mk = lambda: bursty_poisson_trace(  # noqa: E731
+        np.random.RandomState(3), 20, burst_size=4, burst_rate=10.0,
+        prompt_lens=(4, 8), max_new_choices=(2, 4), vocab_size=64,
+        priorities=(0, 2))
+    t1, t2 = mk(), mk()
+    assert [r.prompt for r in t1] == [r.prompt for r in t2]
+    assert [r.arrival_time for r in t1] == [r.arrival_time for r in t2]
+    times = [r.arrival_time for r in t1]
+    assert times == sorted(times)
+    # bursts: 4 requests share each arrival instant
+    assert all(len({r.arrival_time for r in t1[i:i + 4]}) == 1
+               for i in range(0, 20, 4))
+    assert {r.priority for r in t1} <= {0, 2}
+
+    bi = bimodal_trace(np.random.RandomState(4), 40, rate=100.0,
+                       short_lens=(4, 8), long_lens=(64,), long_frac=0.3,
+                       short_new=(4,), long_new=(2,), vocab_size=64)
+    longs = [r for r in bi if len(r.prompt) == 64]
+    shorts = [r for r in bi if len(r.prompt) != 64]
+    assert longs and shorts
+    assert all(r.priority == 1 and r.max_new_tokens == 2 for r in longs)
+    assert all(r.priority == 0 and r.max_new_tokens == 4 for r in shorts)
+
+    st = straggler_trace(np.random.RandomState(5), 12, rate=100.0,
+                         prompt_lens=(4,), max_new_choices=(2,),
+                         straggler_every=4, straggler_prompt_len=48,
+                         straggler_max_new=8, vocab_size=64)
+    stragglers = st[3::4]
+    assert all(len(r.prompt) == 48 and r.priority == 1
+               and r.max_new_tokens == 8 for r in stragglers)
+    assert all(len(r.prompt) == 4 for i, r in enumerate(st)
+               if (i + 1) % 4)
+
+
+# --------------------------------------------------------- swap buffer
+def test_host_swap_buffer_accounting():
+    buf = HostSwapBuffer()
+    k = np.zeros((2, 3), np.float32)
+    v = np.zeros((2, 3), np.float32)
+    buf.put(7, k, v)
+    assert 7 in buf and len(buf) == 1
+    assert buf.bytes_stored == k.nbytes + v.nbytes == buf.peak_bytes
+    with pytest.raises(ValueError, match="already swapped out"):
+        buf.put(7, k, v)
+    k2, v2 = buf.pop(7)
+    assert k2 is k and v2 is v
+    assert buf.bytes_stored == 0 and len(buf) == 0
+    assert buf.peak_bytes == k.nbytes + v.nbytes
+    with pytest.raises(KeyError, match="no swapped-out KV"):
+        buf.pop(7)
+    assert buf.total_swaps_out == 1 and buf.total_swaps_in == 1
+
+
+# ---------------------------------------------------------- telemetry
+def test_slo_telemetry_counters_and_per_class_histograms():
+    from deepspeed_tpu.telemetry import MetricsRegistry
+
+    cfg, _ = _serving()
+    reg = MetricsRegistry()
+    _, srv = _serving(num_slots=1, buckets=(16, 32), telemetry=reg,
+                      prefill_token_budget=16, preemption="swap")
+    pA, pB = _prompts(cfg, [40, 9], seed=11)
+    srv.run([
+        Request(rid=0, prompt=pA, max_new_tokens=16, priority=1),
+        Request(rid=1, prompt=pB, max_new_tokens=4, priority=0,
+                arrival_time=0.01)])
+    snap = reg.snapshot()
+    counters = snap["counters"]
+    assert counters["serving/prefill_chunks"] >= 3
+    assert counters["serving/preemptions"] >= 1
+    assert counters["serving/swapped_blocks_out"] >= 1
+    assert counters["serving/swapped_blocks_in"] >= 1
+    # per-priority-class latency histograms
+    hists = snap["histograms"]
+    assert hists["serving/ttft_ms/p0"]["count"] == 1
+    assert hists["serving/ttft_ms/p1"]["count"] == 1
+    assert hists["serving/tpot_ms/p0"]["count"] == 1
+    assert hists["serving/tpot_ms/p1"]["count"] == 1
+    assert snap["gauges"]["serving/swap_buffer_peak_bytes"] > 0
+
+
+def test_telemetry_report_slo_section():
+    import importlib.util
+    import os
+
+    spec = importlib.util.spec_from_file_location(
+        "telemetry_report", os.path.join(
+            os.path.dirname(__file__), "..", "..", "..", "scripts",
+            "telemetry_report.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    metrics = {
+        "counters": {"serving/prefill_chunks": 12,
+                     "serving/preemptions": 2,
+                     "serving/swapped_blocks_out": 6,
+                     "serving/swapped_blocks_in": 5,
+                     "serving/slo_deferred_steps": 3},
+        "gauges": {"serving/swap_buffer_peak_bytes": 4096.0},
+        "histograms": {
+            "serving/ttft_ms/p0": {"count": 4, "p50": 10.0, "p95": 20.0,
+                                   "p99": 25.0},
+            "serving/tpot_ms/p1": {"count": 4, "p50": 5.0, "p95": 9.0,
+                                   "p99": 9.5},
+        },
+    }
+    out = mod._slo_summary(metrics)
+    assert out["prefill_chunks"] == 12
+    assert out["preemptions"] == 2
+    assert out["swapped_blocks_out"] == 6
+    assert out["swapped_blocks_in"] == 5
+    assert out["slo_deferred_steps"] == 3
+    assert out["swap_buffer_peak_bytes"] == 4096.0
+    assert out["ttft_ms/p0"] == {"count": 4, "p50": 10.0, "p95": 20.0,
+                                 "p99": 25.0}
+    assert out["tpot_ms/p1"]["p99"] == 9.5
+    # a run that never used SLO machinery renders no section
+    assert mod._slo_summary({"counters": {}, "gauges": {},
+                             "histograms": {}}) == {}
