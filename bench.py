@@ -867,6 +867,136 @@ def _bench_slo_serving(on_tpu: bool):
     return out
 
 
+def _bench_fabric_serving(on_tpu: bool):
+    """ISSUE-9 acceptance bench: 3-replica fault-tolerant fabric on the
+    bimodal long-prompt trace, CHAOS OFF vs CHAOS ON — chaos = a
+    scripted mid-trace crash of one replica (its in-flight requests
+    fail over to survivors by committed-token resume; the supervisor
+    resurrects it under a restart budget). Headline: GOODPUT (served
+    requests/sec) and p99 TTFT / decode inter-token latency with chaos
+    on, relative to the undisturbed fabric — plus the lossless check
+    (every chaos-run request's greedy tokens bit-identical to a
+    fault-free single-replica run) and zero recompiles per replica.
+    Acceptance: all requests served through the crash, lossless, with
+    goodput >= 0.7x the undisturbed fabric."""
+    import time as _time
+
+    import deepspeed_tpu
+    from deepspeed_tpu.models.gpt2 import GPT2Config, GPT2Model
+    from deepspeed_tpu.serving import (FabricRouter, InProcessReplica,
+                                       ReplicaSupervisor, ServingEngine,
+                                       bimodal_trace)
+    from deepspeed_tpu.testing import FaultInjector
+    from deepspeed_tpu.utils import groups
+
+    groups.reset()
+    if on_tpu:
+        cfg = GPT2Config.gpt2_125m()
+        dtype = "bf16"
+        slots, max_len, buckets = 8, 1024, (128, 1024)
+        n_req, crash_step, windows = 48, 8, 3
+        short_lens, short_new = (48, 64, 96), (32, 64)
+        long_lens, long_new, long_frac = (768,), (16,), 0.2
+    else:
+        cfg = GPT2Config(vocab_size=512, max_seq_len=512, num_layers=2,
+                         hidden_size=128, num_heads=4)
+        dtype = "fp32"
+        slots, max_len, buckets = 4, 256, (32, 256)
+        n_req, crash_step, windows = 24, 4, 3
+        short_lens, short_new = (8, 12, 16), (10, 14)
+        long_lens, long_new, long_frac = (96,), (8,), 0.25
+
+    trace = bimodal_trace(np.random.RandomState(0), n_req, rate=1e4,
+                          short_lens=short_lens, long_lens=long_lens,
+                          long_frac=long_frac, short_new=short_new,
+                          long_new=long_new, vocab_size=cfg.vocab_size)
+    engine = deepspeed_tpu.init_inference(GPT2Model(cfg), dtype=dtype,
+                                          max_out_tokens=max_len)
+
+    # fault-free single-replica oracle for the lossless check
+    oracle_srv = ServingEngine(engine, num_slots=slots, max_len=max_len,
+                               buckets=buckets, telemetry=False)
+    oracle = {r.rid: r.tokens for r in oracle_srv.run(trace)}
+
+    def run_once(chaos: bool):
+        inj = FaultInjector()
+        if chaos:
+            inj.crash_replica_step("r1", crash_step)
+
+        def factory(name):
+            srv = ServingEngine(engine, num_slots=slots, max_len=max_len,
+                                buckets=buckets, telemetry=False)
+            plan = inj.replica_plan(name) if chaos and name == "r1" \
+                else None
+            return InProcessReplica(name, srv, chaos=plan)
+
+        router = FabricRouter(
+            [factory(n) for n in ("r0", "r1", "r2")],
+            replica_factory=factory,
+            supervisor=ReplicaSupervisor(max_restarts=3,
+                                         restart_delay_s=0.02, jitter=0.0),
+            telemetry=False, heartbeat_interval_s=0.05,
+            retry_base_delay_s=0.005)
+        t0 = _time.perf_counter()
+        results = router.run(trace)
+        dt = _time.perf_counter() - t0
+        served = [r for r in results
+                  if r.finish_reason in ("eos", "length")]
+        gaps = sorted(g for r in served
+                      for g in (r.token_times[i] - r.token_times[i - 1]
+                                for i in range(1, len(r.token_times))))
+        ttfts = sorted(r.first_token_latency for r in served)
+        stats = {
+            "goodput_req_per_sec": round(len(served) / max(dt, 1e-9), 2),
+            "served": len(served), "shed": len(results) - len(served),
+            "ttft_p99_ms": _pct_ms(ttfts, 0.99),
+            "decode_tpot_p99_ms": _pct_ms(gaps, 0.99),
+            "failovers": router.failovers,
+            "replica_crashes": router.replica_crashes,
+            "replica_restarts": router.replica_restarts,
+            "retries": router.retries,
+            "recompiles_after_warmup": router.recompile_count(),
+        }
+        return results, stats
+
+    def better(best, stats):
+        if best is None:
+            return dict(stats)
+        for k, v in stats.items():
+            if k == "goodput_req_per_sec":
+                best[k] = max(best[k], v)
+            elif k.endswith("_ms"):
+                best[k] = min(best[k], v)
+            else:
+                best[k] = max(best[k], v)
+        return best
+
+    base = chaos = None
+    base_res = chaos_res = None
+    best_ratio = None
+    for _ in range(windows):
+        res_b, stats_b = run_once(False)
+        res_c, stats_c = run_once(True)
+        base_res, chaos_res = res_b, res_c
+        ratio = (stats_c["goodput_req_per_sec"]
+                 / max(stats_b["goodput_req_per_sec"], 1e-9))
+        best_ratio = ratio if best_ratio is None else max(best_ratio, ratio)
+        base = better(base, stats_b)
+        chaos = better(chaos, stats_c)
+    match = all(r.tokens == oracle[r.rid] for r in chaos_res
+                if r.finish_reason in ("eos", "length"))
+    all_served = all(r.finish_reason in ("eos", "length")
+                     for r in chaos_res)
+    return {
+        "replicas": 3, "slots_per_replica": slots, "n_requests": n_req,
+        "trace": "bimodal_long_prompt", "crash_step": crash_step,
+        "chaos_off": base, "chaos_on": chaos,
+        "goodput_ratio_chaos_on": round(best_ratio, 3),
+        "all_requests_served_through_crash": all_served,
+        "lossless_greedy_match": match,
+    }
+
+
 def _bench_observability_overhead(on_tpu: bool):
     """ISSUE-3 acceptance: instrumented vs bare train step and serving
     decode step (2% overhead budget), plus p50/p95 serving latencies from
@@ -1081,6 +1211,15 @@ def main():
         print(json.dumps(_bench_slo_serving(on_tpu), indent=2))
         return
 
+    if "serving_fabric" in sys.argv[1:]:
+        # standalone ISSUE-9 mode: 3-replica fault-tolerant fabric with
+        # a scripted mid-trace crash (chaos on) vs undisturbed (chaos
+        # off) on the bimodal trace, one JSON object
+        on_tpu = any(d.platform in ("tpu", "axon")
+                     or "TPU" in str(d.device_kind) for d in jax.devices())
+        print(json.dumps(_bench_fabric_serving(on_tpu), indent=2))
+        return
+
     if "--774m" in sys.argv:
         import json as _json
 
@@ -1183,6 +1322,10 @@ def main():
     except Exception as e:
         serving_slo = {"error": f"{type(e).__name__}: {e}"}
     try:
+        serving_fabric = _bench_fabric_serving(on_tpu)
+    except Exception as e:
+        serving_fabric = {"error": f"{type(e).__name__}: {e}"}
+    try:
         longseq = _bench_zero_flash_longseq(on_tpu)
     except Exception as e:
         longseq = {"error": f"{type(e).__name__}: {e}"}
@@ -1234,6 +1377,11 @@ def main():
         # p99 >= 2x better at <= 10% throughput cost, lossless greedy,
         # zero recompiles, both cache modes)
         "serving_slo": serving_slo,
+        # 3-replica fault-tolerant fabric, scripted mid-trace crash vs
+        # undisturbed (ISSUE 9 acceptance: every request served through
+        # the crash, lossless greedy vs a fault-free single-replica
+        # run, zero recompiles, goodput >= 0.7x chaos-off)
+        "serving_fabric": serving_fabric,
         "train_zero2_flash_longseq": longseq,  # seq_len inside the value
         # ISSUE-3 acceptance: instrumented vs bare train/decode steps (2%
         # budget) + telemetry-histogram p50/p95 vs direct measurement

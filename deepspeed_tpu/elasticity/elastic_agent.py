@@ -35,6 +35,22 @@ from deepspeed_tpu.elasticity.preemption import PREEMPTION_EXIT_CODE
 from deepspeed_tpu.utils.logging import logger
 
 
+def backoff_delay(consecutive_failures: int, *, base_s: float,
+                  factor: float, cap_s: float, jitter: float = 0.0,
+                  rng=random) -> float:
+    """Capped exponential backoff with optional jitter — the restart
+    schedule shared by :class:`ElasticAgent` (training worker groups)
+    and the serving fabric's
+    :class:`~deepspeed_tpu.serving.fabric.supervisor.ReplicaSupervisor`
+    (ISSUE 9): ``base_s * factor**(k-1)``, capped at ``cap_s``, jittered
+    multiplicatively so a fleet's agents don't re-rendezvous in
+    lockstep. ``rng`` is injectable for deterministic tests."""
+    delay = min(cap_s, base_s * factor ** max(consecutive_failures - 1, 0))
+    if jitter:
+        delay *= 1.0 + jitter * rng.uniform(-1.0, 1.0)
+    return max(delay, 0.0)
+
+
 class ElasticAgent:
     def __init__(self, spawn_fn: Callable[[], List], monitor_fn: Callable,
                  max_restarts: int = 3, restart_delay_s: float = 1.0,
@@ -71,12 +87,11 @@ class ElasticAgent:
         return len(self._restart_times)
 
     def _backoff_delay(self, consecutive_failures: int) -> float:
-        delay = min(self.max_restart_delay_s,
-                    self.restart_delay_s *
-                    self.backoff_factor ** max(consecutive_failures - 1, 0))
-        if self.jitter:
-            delay *= 1.0 + self.jitter * random.uniform(-1.0, 1.0)
-        return max(delay, 0.0)
+        return backoff_delay(consecutive_failures,
+                             base_s=self.restart_delay_s,
+                             factor=self.backoff_factor,
+                             cap_s=self.max_restart_delay_s,
+                             jitter=self.jitter)
 
     def run(self) -> int:
         """Supervise worker groups until clean exit or restart budget spent.

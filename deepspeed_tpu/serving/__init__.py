@@ -10,10 +10,27 @@ block tables + SGLang RadixAttention, serving/kv_blocks.py +
 serving/radix.py, ``ServingEngine(prefix_cache=True)``). SLO-aware
 overload control (ISSUE 8): chunked prefill under a per-iteration token
 budget, priority classes with aging, and preemption with host KV swap
-(serving/swap.py). See serving/engine.py.
+(serving/swap.py). See serving/engine.py. The fault-tolerant
+multi-replica fabric (ISSUE 9) — health-checked routing, failover,
+load shedding, supervised restarts — lives in serving/fabric/ with its
+typed error hierarchy in serving/errors.py.
 """
 
 from deepspeed_tpu.serving.engine import ServingEngine
+from deepspeed_tpu.serving.errors import (EmptyPromptError, FabricError,
+                                          InvalidMaxNewTokensError,
+                                          InvalidRequestError,
+                                          NoHealthyReplicaError,
+                                          PromptTooLongError,
+                                          ReplicaCrashedError,
+                                          RetriesExhaustedError,
+                                          RouterOverloadedError, ServingError,
+                                          SlotCapacityError,
+                                          SwapCapacityError,
+                                          TransientReplicaError)
+from deepspeed_tpu.serving.fabric import (CircuitBreaker, FabricRouter,
+                                          InProcessReplica, Replica,
+                                          ReplicaHealth, ReplicaSupervisor)
 from deepspeed_tpu.serving.kv_blocks import BlockKVPool
 from deepspeed_tpu.serving.kv_slots import SlotKVCache
 from deepspeed_tpu.serving.radix import PrefixCache
@@ -32,4 +49,14 @@ __all__ = ["ServingEngine", "SlotKVCache", "BlockKVPool", "PrefixCache",
            "SlotScheduler", "Request", "RequestResult", "SpeculativeConfig",
            "HostSwapBuffer", "ngram_propose", "pick_bucket",
            "poisson_trace", "shared_prefix_trace", "templated_trace",
-           "bursty_poisson_trace", "bimodal_trace", "straggler_trace"]
+           "bursty_poisson_trace", "bimodal_trace", "straggler_trace",
+           # fabric (ISSUE 9)
+           "CircuitBreaker", "FabricRouter", "InProcessReplica", "Replica",
+           "ReplicaHealth", "ReplicaSupervisor",
+           # typed errors (ISSUE 9)
+           "ServingError", "InvalidRequestError", "EmptyPromptError",
+           "InvalidMaxNewTokensError", "PromptTooLongError",
+           "SlotCapacityError", "SwapCapacityError", "FabricError",
+           "RouterOverloadedError", "NoHealthyReplicaError",
+           "RetriesExhaustedError", "ReplicaCrashedError",
+           "TransientReplicaError"]

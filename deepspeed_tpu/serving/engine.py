@@ -35,6 +35,11 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
 
+from deepspeed_tpu.serving.errors import (EmptyPromptError,
+                                          InvalidMaxNewTokensError,
+                                          PromptTooLongError,
+                                          SlotCapacityError,
+                                          SwapCapacityError)
 from deepspeed_tpu.serving.kv_blocks import BlockKVPool
 from deepspeed_tpu.serving.kv_slots import SlotKVCache
 from deepspeed_tpu.serving.radix import PrefixCache
@@ -170,6 +175,12 @@ class ServingEngine:
         arrival position; it swaps back IN when resources free and
         finishes bit-identically to an uninterrupted run (pinned by
         tests). None (default) disables preemption.
+    swap_max_bytes: byte cap on the host swap buffer (ISSUE 9): a
+        preemption whose KV would push the buffer past the cap is
+        declined (typed SwapCapacityError internally, surfaced as the
+        ``serving/swap_capacity_rejections`` counter) so sustained
+        preemption pressure cannot grow host memory without bound.
+        None (default) leaves the buffer unbounded.
     priority_aging_sec: scheduler aging rate — a waiting request gains
         one full priority class per ``priority_aging_sec`` seconds
         waited, so the lowest class never starves under sustained
@@ -193,6 +204,7 @@ class ServingEngine:
                  num_blocks: Optional[int] = None,
                  prefill_token_budget: Optional[int] = None,
                  preemption: Optional[str] = None,
+                 swap_max_bytes: Optional[int] = None,
                  priority_aging_sec: Optional[float] = None,
                  tpot_slo_ms: Optional[float] = None,
                  slo_max_defer: int = 4):
@@ -272,7 +284,13 @@ class ServingEngine:
             raise ValueError(f"preemption policy must be None or 'swap', "
                              f"got {preemption!r}")
         self.preemption = preemption
-        self.swap = HostSwapBuffer() if preemption else None
+        # swap_max_bytes (ISSUE 9 satellite) caps the host swap buffer:
+        # a preemption whose KV would not fit is DECLINED (typed
+        # SwapCapacityError inside, counted outside) so sustained
+        # preemption pressure degrades into "candidate waits" instead
+        # of unbounded host-memory growth
+        self.swap = HostSwapBuffer(max_bytes=swap_max_bytes) \
+            if preemption else None
         self._preempted: Dict[int, _Preempted] = {}
         if tpot_slo_ms is not None and prefill_token_budget is None:
             raise ValueError(
@@ -346,6 +364,7 @@ class ServingEngine:
         # (slot-paged: the whole slot row is the swap unit, 1 per trip)
         self.swapped_blocks_out = 0
         self.swapped_blocks_in = 0
+        self.swap_capacity_rejections = 0
         self.slo_deferred_steps = 0
         self._active_slot_iterations = 0
         # speculative accounting (spec mode only; bench + telemetry)
@@ -578,15 +597,22 @@ class ServingEngine:
 
     # ----------------------------------------------------------------- queue
     def submit(self, request: Request) -> None:
+        """Queue a request, validating it up front (ISSUE 9 satellite):
+        a malformed prompt/budget raises a TYPED error here — at submit
+        time, where the caller can act on it — instead of surfacing as
+        an XLA shape or trace failure several decode iterations later.
+        All the types subclass ``ValueError`` (serving/errors.py), so
+        pre-typed call sites keep working."""
         plen = len(request.prompt)
         if plen < 1:
-            raise ValueError(f"request {request.rid}: empty prompt")
+            raise EmptyPromptError(f"request {request.rid}: empty prompt")
         if request.max_new_tokens < 1:
-            raise ValueError(
-                f"request {request.rid}: max_new_tokens must be >= 1")
+            raise InvalidMaxNewTokensError(
+                f"request {request.rid}: max_new_tokens must be >= 1, "
+                f"got {request.max_new_tokens}")
         if self._chunk_max is None and \
                 pick_bucket(plen, self.buckets) is None:
-            raise ValueError(
+            raise PromptTooLongError(
                 f"request {request.rid}: prompt length {plen} exceeds the "
                 f"largest prefill bucket {self.buckets[-1]} (set "
                 f"prefill_token_budget to serve longer prompts via "
@@ -596,11 +622,39 @@ class ServingEngine:
             extra = (f" (speculation reserves {self._lookahead} lookahead "
                      f"rows for pre-acceptance draft writes)"
                      if self._lookahead else "")
-            raise ValueError(
+            raise SlotCapacityError(
                 f"request {request.rid}: prompt {plen} + max_new "
                 f"{request.max_new_tokens} exceeds slot capacity "
                 f"{self.max_len}{extra}")
         self.scheduler.submit(request)
+
+    def cancel(self, rid: int) -> bool:
+        """Withdraw a request wherever it currently lives (ISSUE 9 —
+        the fabric router's failover/timeout path: a request being
+        re-dispatched to another replica must not also finish here).
+        Queued: removed from the scheduler (a preempted request's host
+        KV is dropped too). Running: its slot is freed — in
+        prefix-cache mode the blocks it COMPUTED are donated to the
+        radix index (they are valid prefixes; unwritten tails are not)
+        and the rest freed. Returns False when the rid is unknown or
+        already finished; no result is ever emitted for a cancelled
+        request."""
+        if self.scheduler.remove(rid):
+            if rid in self._preempted:
+                self._preempted.pop(rid)
+                # discard, not pop: nothing returns to the device, so
+                # this must not count as a swap-in
+                self.swap.discard(rid)
+            return True
+        for i, st in enumerate(self._slots):
+            if st is not None and st.request.rid == rid:
+                self._slots[i] = None
+                self.scheduler.release(i)
+                if self.prefix is not None:
+                    length = int(jax.device_get(self.cache.lengths[i]))
+                    self.prefix.finish(i, donate_upto=length)
+                return True
+        return False
 
     @property
     def pending(self) -> int:
@@ -828,8 +882,27 @@ class ServingEngine:
         """Admit one fresh request into ``slot``: radix match + COW
         forks (prefix-cache mode), then prefill as much of the prompt
         as the budget allows (the rest continues on later iterations).
-        Returns prefill tokens spent."""
+        Returns prefill tokens spent.
+
+        A request whose ``deadline`` already passed is SHED here —
+        after it won its slot but BEFORE any prefill compute (ISSUE 9:
+        an answer nobody is waiting for must not waste the iteration
+        budget): it finishes immediately with ``finish_reason
+        "shed_deadline"`` and the slot is released. Preempted resumes
+        never pass through here, so sunk prefill work is never thrown
+        away by the shed."""
         plen = len(req.prompt)
+        if req.deadline is not None and now > req.deadline:
+            self.scheduler.release(slot)
+            res = RequestResult(rid=req.rid, prompt_len=plen,
+                                arrival_time=req.arrival_time,
+                                admitted_time=now, priority=req.priority)
+            res.finish_time = self._now(now)
+            res.finish_reason = "shed_deadline"
+            finished.append(res)
+            if self.telemetry is not None:
+                self.telemetry.counter("serving/shed_deadline").inc()
+            return 0
         start = 0
         if self.prefix is not None:
             total = plen + req.max_new_tokens + self._lookahead
@@ -962,7 +1035,20 @@ class ServingEngine:
             return False
         victim = max(victims, key=lambda i: (self._slots[i].request.priority,
                                              self._slots[i].order))
-        self._preempt(victim, now)
+        try:
+            self._preempt(victim, now)
+        except SwapCapacityError:
+            # swap buffer at its max_bytes cap (ISSUE 9 satellite): the
+            # preemption is declined BEFORE any engine state mutated
+            # (put happens first in _preempt) — the candidate waits for
+            # a natural slot release instead of the host growing
+            # unboundedly; surfaced via counter + gauge so operators
+            # see sustained pressure
+            self.swap_capacity_rejections += 1
+            if self.telemetry is not None:
+                self.telemetry.counter(
+                    "serving/swap_capacity_rejections").inc()
+            return False
         return True
 
     def _preempt(self, slot: int, now: float) -> None:
@@ -1350,6 +1436,9 @@ class ServingEngine:
                 self.swap.bytes_stored)
             reg.gauge("serving/swap_buffer_peak_bytes").set(
                 self.swap.peak_bytes)
+            if self.swap.max_bytes is not None:
+                reg.gauge("serving/swap_buffer_max_bytes").set(
+                    self.swap.max_bytes)
         if self.prefix is not None:
             # cumulative cache effectiveness (counters already streamed
             # per admit/evict/fork by PrefixCache); occupancy covers

@@ -1,0 +1,95 @@
+"""Typed error hierarchy for the serving stack (ISSUE 9).
+
+Every failure a caller can act on programmatically gets its own type:
+admission-time request validation (bad prompt/budget shapes that used to
+surface as downstream XLA shape or trace failures mid-step), host-swap
+capacity pressure, and the fabric's traffic-layer conditions
+(backpressure, deadlines, replica death). Two design rules:
+
+  * **Compatibility** — request-validation errors subclass ``ValueError``
+    and capacity errors subclass ``RuntimeError``, so pre-existing
+    ``except ValueError`` call sites (and tests) keep working while new
+    code can catch the precise type.
+  * **Transient vs permanent** — the fabric router's retry policy keys
+    on the TYPE, never on string matching: :class:`TransientReplicaError`
+    is retryable (flaky step, failed probe), :class:`ReplicaCrashedError`
+    means the replica is gone and in-flight work must fail over, and
+    :class:`InvalidRequestError` is permanent (retrying the same request
+    anywhere else would fail identically).
+"""
+
+from __future__ import annotations
+
+
+class ServingError(Exception):
+    """Base of every typed serving-stack error."""
+
+
+# --------------------------------------------------------- submit validation
+class InvalidRequestError(ServingError, ValueError):
+    """The request itself is malformed — permanent, never retried
+    (subclasses ``ValueError`` for backward compatibility with the
+    pre-typed ``ServingEngine.submit`` checks)."""
+
+
+class EmptyPromptError(InvalidRequestError):
+    """Submitted prompt has no tokens."""
+
+
+class InvalidMaxNewTokensError(InvalidRequestError):
+    """``max_new_tokens`` is not a positive integer."""
+
+
+class PromptTooLongError(InvalidRequestError):
+    """Prompt exceeds the largest prefill bucket and chunked prefill is
+    off (set ``prefill_token_budget`` to serve it in chunks)."""
+
+
+class SlotCapacityError(InvalidRequestError):
+    """prompt + max_new_tokens (+ speculative lookahead) exceeds the
+    per-slot KV capacity — no admission order could ever serve it."""
+
+
+# ------------------------------------------------------------- host KV swap
+class SwapCapacityError(ServingError, RuntimeError):
+    """The host swap buffer's ``max_bytes`` cap would be exceeded: the
+    preemption that wanted the space is declined instead of silently
+    growing host memory (ISSUE 9 satellite)."""
+
+
+# ----------------------------------------------------------------- fabric
+class FabricError(ServingError):
+    """Base of the multi-replica fabric's traffic-layer errors."""
+
+
+class RouterOverloadedError(FabricError):
+    """Typed backpressure: the router's bounded queue is full and the
+    submitted request is not higher-class than anything sheddable —
+    the caller should slow down or retry later."""
+
+
+class DeadlineExceededError(FabricError):
+    """The request's deadline expired before it could be served (shed
+    from the router queue before wasting prefill)."""
+
+
+class NoHealthyReplicaError(FabricError):
+    """Every replica is dead (or permanently abandoned by the
+    supervisor's restart budget) — the fabric cannot make progress."""
+
+
+class RetriesExhaustedError(FabricError):
+    """The request failed more dispatch attempts than the router's
+    retry budget allows."""
+
+
+class ReplicaCrashedError(FabricError):
+    """The replica died (process crash / preemption without grace).
+    In-flight requests fail over to a survivor; the supervisor decides
+    whether to resurrect the replica."""
+
+
+class TransientReplicaError(FabricError):
+    """A retryable replica-level hiccup (flaky step, failed health
+    probe): the replica is still alive, the operation may be retried.
+    Repeated transients trip the replica's circuit breaker."""

@@ -1,0 +1,21 @@
+"""Fault-tolerant multi-replica serving fabric (ISSUE 9).
+
+The traffic layer over N :class:`~deepspeed_tpu.serving.engine.ServingEngine`
+replicas (ROADMAP item 2): health-checked least-loaded routing with
+per-replica circuit breakers, retry/backoff failover that resumes a
+dead replica's in-flight requests on a survivor bit-identically (greedy),
+bounded-queue backpressure + priority/deadline load shedding, and an
+ElasticAgent-style replica supervisor — all behind the small
+:class:`~deepspeed_tpu.serving.fabric.replica.Replica` interface that a
+real multi-host transport plugs into later. Chaos seams live in
+``deepspeed_tpu/testing/fault_injection.py``.
+"""
+
+from deepspeed_tpu.serving.fabric.health import CircuitBreaker
+from deepspeed_tpu.serving.fabric.replica import (InProcessReplica, Replica,
+                                                  ReplicaHealth)
+from deepspeed_tpu.serving.fabric.router import FabricRouter
+from deepspeed_tpu.serving.fabric.supervisor import ReplicaSupervisor
+
+__all__ = ["CircuitBreaker", "FabricRouter", "InProcessReplica", "Replica",
+           "ReplicaHealth", "ReplicaSupervisor"]

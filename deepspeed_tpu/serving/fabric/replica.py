@@ -1,0 +1,178 @@
+"""Replica abstraction for the multi-replica serving fabric (ISSUE 9).
+
+A :class:`Replica` is the router's unit of dispatch, health, and fault
+isolation: it accepts requests, advances one serving iteration at a
+time, answers health probes, and can cancel in-flight work. The fabric
+ships ONE implementation — :class:`InProcessReplica`, a thin shell
+around a :class:`~deepspeed_tpu.serving.engine.ServingEngine` — which
+is both the tier-1 test vehicle (everything runs in-process, in
+virtual time, following the ``ScriptedWorkerGroup``/``FakeClock``
+pattern of testing/fault_injection.py) and the seam where a real
+multi-host transport (gRPC/pathways proxy per host) plugs in later:
+the router only ever speaks this interface.
+
+Failure model: a replica is either ALIVE or CRASHED. Crash is
+terminal — every method raises
+:class:`~deepspeed_tpu.serving.errors.ReplicaCrashedError` afterwards,
+exactly like RPCs against a dead process, and recovery means the
+supervisor building a FRESH replica (new KV cache, same shared
+compiled programs). Transient hiccups (flaky step, failed probe) raise
+:class:`~deepspeed_tpu.serving.errors.TransientReplicaError` and leave
+the replica alive. The chaos seams
+(:class:`~deepspeed_tpu.testing.fault_injection.ReplicaFaultPlan`)
+inject both, scripted per step, in virtual time.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional
+
+from deepspeed_tpu.serving.errors import ReplicaCrashedError
+from deepspeed_tpu.serving.scheduler import Request, RequestResult
+from deepspeed_tpu.testing.fault_injection import (ReplicaFaultPlan,
+                                                   SimulatedCrash)
+
+
+@dataclasses.dataclass
+class ReplicaHealth:
+    """One heartbeat's worth of placement signal (the PR 3 telemetry
+    quantities the router's least-loaded policy reads): queue depth,
+    free slots, free KV blocks (block-paged mode only), and total
+    unfinished requests."""
+
+    name: str
+    queue_depth: int
+    free_slots: int
+    pending: int
+    free_blocks: Optional[int] = None
+
+    @property
+    def load(self) -> float:
+        """Scalar placement load: unfinished requests, fractionally
+        discounted by free capacity so two equally-pending replicas
+        tie-break toward the one with more open slots."""
+        return self.pending - 1e-3 * self.free_slots
+
+
+class Replica:
+    """Interface the router dispatches against (duck-typed; this base
+    only documents and raises)."""
+
+    name: str = "?"
+
+    def warmup(self) -> None:
+        raise NotImplementedError
+
+    def submit(self, request: Request) -> None:
+        raise NotImplementedError
+
+    def step(self, now: float) -> List[RequestResult]:
+        raise NotImplementedError
+
+    def probe(self, now: float) -> ReplicaHealth:
+        raise NotImplementedError
+
+    def cancel(self, rid: int) -> bool:
+        raise NotImplementedError
+
+    def recompile_count(self) -> int:
+        raise NotImplementedError
+
+    @property
+    def pending(self) -> int:
+        """Unfinished requests on this replica (queued + in slots) —
+        the router's cheap placement signal between heartbeats."""
+        raise NotImplementedError
+
+
+class InProcessReplica(Replica):
+    """A :class:`ServingEngine` behind the :class:`Replica` interface.
+
+    Parameters
+    ----------
+    name: stable identity (supervisor budgets and telemetry gauges key
+        on it; a resurrected replica keeps the name of the one it
+        replaces).
+    serving: the wrapped ServingEngine. Multiple replicas typically
+        share one underlying ``InferenceEngine`` (params + compiled
+        programs — the production single-host shape and what keeps the
+        zero-recompile invariant per replica: same shapes, same cached
+        executables).
+    chaos: optional scripted fault plan
+        (``FaultInjector.replica_plan(name)``) consulted entering every
+        step and probe.
+    clock: optional virtual clock (an object with ``advance``) the
+        chaos plan's slow-straggler faults stall; with a real clock
+        straggling is not simulated (leave None).
+    """
+
+    def __init__(self, name: str, serving, *,
+                 chaos: Optional[ReplicaFaultPlan] = None, clock=None):
+        self.name = name
+        self.serving = serving
+        self.chaos = chaos
+        self._clock = clock
+        self.alive = True
+        self.steps = 0
+
+    # ------------------------------------------------------------ lifecycle
+    def _check_alive(self) -> None:
+        if not self.alive:
+            raise ReplicaCrashedError(f"replica {self.name} is dead")
+
+    def warmup(self) -> None:
+        self._check_alive()
+        self.serving.warmup()
+
+    # -------------------------------------------------------------- serving
+    def submit(self, request: Request) -> None:
+        self._check_alive()
+        self.serving.submit(request)
+
+    def step(self, now: float) -> List[RequestResult]:
+        self._check_alive()
+        if self.chaos is not None:
+            try:
+                self.chaos.on_step(self._clock)
+            except SimulatedCrash as e:
+                # process death: terminal — the engine's host state
+                # (slots, queues, KV) is unreachable from here on, the
+                # router must fail over from ITS OWN committed-token
+                # record, never from anything of ours
+                self.alive = False
+                raise ReplicaCrashedError(str(e)) from e
+            # TransientReplicaError propagates as-is: replica alive,
+            # this iteration just didn't happen
+        self.steps += 1
+        return self.serving.step(now)
+
+    def probe(self, now: float) -> ReplicaHealth:
+        """Heartbeat: cheap host-side scheduler reads, no device work —
+        safe at any probe frequency."""
+        self._check_alive()
+        if self.chaos is not None:
+            self.chaos.on_probe()
+        eng = self.serving
+        free_blocks = None
+        if eng.prefix is not None:
+            free_blocks = eng.cache.free_count()
+        return ReplicaHealth(
+            name=self.name, queue_depth=eng.scheduler.waiting,
+            free_slots=eng.scheduler.free_slots, pending=eng.pending,
+            free_blocks=free_blocks)
+
+    def cancel(self, rid: int) -> bool:
+        self._check_alive()
+        return self.serving.cancel(rid)
+
+    def recompile_count(self) -> int:
+        return self.serving.recompile_count()
+
+    @property
+    def pending(self) -> int:
+        return self.serving.pending if self.alive else 0
+
+    def __repr__(self):
+        return (f"InProcessReplica({self.name}, alive={self.alive}, "
+                f"steps={self.steps})")

@@ -1,0 +1,742 @@
+"""Fault-tolerant request router over N serving replicas (ISSUE 9).
+
+The traffic layer that turns one :class:`ServingEngine` into a service:
+ROADMAP item 2's router/replica split, built with robustness as the
+headline — at "millions of users" scale replica failure is the steady
+state, and the fabric must keep serving (and keep its SLOs) through
+crashes, stragglers, and overload. Four pillars:
+
+**Health-checked dispatch.** Periodic heartbeat probes feed per-replica
+circuit breakers (fabric/health.py): ``failure_threshold`` consecutive
+probe/step failures quarantine a replica (OPEN), a cooldown later one
+half-open probe decides between full recovery and another quarantine
+round. Placement is least-loaded over the healthy set, driven by the
+PR 3 telemetry signals a replica exposes (pending requests, free
+slots/blocks).
+
+**Failover.** The router records every COMMITTED token per request (it
+interposes on the PR 7 streaming callback), so when a replica dies its
+in-flight requests are re-dispatched to a survivor by resubmitting
+``prompt + committed_tokens`` with the remaining budget. Greedy decode
+is a deterministic function of the context, and slot isolation makes a
+request's tokens independent of its co-tenants (pinned since PR 2) —
+so the merged stream is BIT-IDENTICAL to a fault-free run, and since
+the resumed request's committed tokens ride in its PROMPT, nothing is
+ever re-streamed to the client (the idempotency argument). Retries
+back off exponentially with deterministic jitter; per-attempt timeouts
+re-dispatch work stuck on a straggler (cancelling the stale copy so it
+cannot finish twice). Crashed replicas are resurrected through a
+:class:`~deepspeed_tpu.serving.fabric.supervisor.ReplicaSupervisor`
+(ElasticAgent-style rolling restart budget).
+
+**Graceful degradation.** The router queue is bounded: overflow sheds
+the lowest-priority queued request if the arrival outranks it,
+otherwise the arrival is refused with a typed
+:class:`RouterOverloadedError` (backpressure the caller can act on).
+Requests whose deadline expires while queued are shed before they
+waste prefill compute they can no longer use.
+
+**Chaos-tested.** Everything runs against in-process replicas in
+virtual time; the scripted fault seams live in
+``testing/fault_injection.py`` and the acceptance suite drives the
+PR 7 adversarial traces through a 3-replica fabric under mid-trace
+crash schedules, asserting losslessness and zero recompiles.
+"""
+
+from __future__ import annotations
+
+import random
+import time
+from typing import Callable, Dict, List, Optional, Sequence
+
+from deepspeed_tpu.elasticity.elastic_agent import backoff_delay
+from deepspeed_tpu.serving.errors import (InvalidRequestError,
+                                          NoHealthyReplicaError,
+                                          ReplicaCrashedError,
+                                          RouterOverloadedError,
+                                          TransientReplicaError)
+from deepspeed_tpu.serving.fabric.health import (CLOSED, STATE_GAUGE,
+                                                 CircuitBreaker)
+from deepspeed_tpu.serving.fabric.replica import Replica
+from deepspeed_tpu.serving.fabric.supervisor import ReplicaSupervisor
+from deepspeed_tpu.serving.scheduler import Request, RequestResult
+from deepspeed_tpu.utils.logging import log_dist
+
+# breaker states 0..2 (health.STATE_GAUGE); the router extends the
+# scale with its own terminal/parking states
+_STATE_RESTARTING = 3.0
+_STATE_DEAD = 4.0
+
+
+class _Tracked:
+    """Router-side lifetime record of one request: the original
+    request, the user's streaming callback, and every token the fabric
+    has COMMITTED to the client — the failover unit. The committed
+    list, not any replica's state, is the source of truth for resume:
+    a dead replica's memory is unreachable by definition."""
+
+    __slots__ = ("request", "user_cb", "committed", "committed_times",
+                 "first_token_time", "retries", "failovers", "not_before",
+                 "crash_t", "replica", "dispatch_t", "seq")
+
+    def __init__(self, request: Request, seq: int):
+        self.request = request
+        self.user_cb = request.on_token
+        self.committed: List[int] = []
+        self.committed_times: List[float] = []
+        self.first_token_time: Optional[float] = None
+        self.retries = 0          # re-dispatches (first dispatch is free)
+        self.failovers = 0        # re-dispatches caused by replica death
+        self.not_before = 0.0     # retry backoff gate
+        self.crash_t: Optional[float] = None   # failover-latency start
+        self.replica: Optional[str] = None     # current assignment
+        self.dispatch_t: Optional[float] = None
+        self.seq = seq
+
+
+class FabricRouter:
+    """Routes requests across replicas with health-checked dispatch,
+    retry/backoff failover, load shedding, and supervised restarts.
+
+    Parameters
+    ----------
+    replicas: the initial replica set (fabric/replica.py). Names must
+        be unique; they key supervisor budgets and telemetry gauges.
+    replica_factory: ``name -> Replica`` builder the router calls to
+        resurrect a crashed replica (typically: fresh ServingEngine
+        over the SHARED InferenceEngine, wrapped in InProcessReplica).
+        Without it (or without a supervisor) a crashed replica stays
+        dead and the fabric serves on with the survivors.
+    supervisor: restart policy (rolling budget, backoff, restartable
+        exits); None disables resurrection.
+    max_queue: bound on the ROUTER queue (dispatched work queues inside
+        its replica). Overflow sheds the worst lower-class queued
+        request, else raises :class:`RouterOverloadedError`. None =
+        unbounded.
+    max_dispatch_depth: cap on one replica's unfinished requests before
+        the router stops picking it as a target — keeps work shed-able
+        in the router queue instead of buried in a replica backlog.
+        None = dispatch eagerly.
+    heartbeat_interval_s: virtual-time gap between probe rounds.
+    failure_threshold / breaker_cooldown_s: circuit-breaker knobs.
+    retry_max: max RE-dispatches per request before it fails with
+        ``finish_reason="failed"``.
+    retry_base_delay_s / retry_backoff_factor / retry_max_delay_s /
+    retry_jitter: failover backoff schedule (jitter drawn from a
+        seeded RNG — deterministic across runs).
+    request_timeout_s: per-ATTEMPT timeout: an in-flight request with
+        no finish after this long is cancelled on its replica and
+        re-dispatched elsewhere (straggler mitigation). None disables.
+    time_fn: clock (virtual in tests); defaults to time.monotonic.
+    telemetry: like ServingEngine — True = global registry, a
+        MetricsRegistry = private, False/None = bare.
+    """
+
+    def __init__(self, replicas: Sequence[Replica], *,
+                 replica_factory: Optional[Callable[[str], Replica]] = None,
+                 supervisor: Optional[ReplicaSupervisor] = None,
+                 max_queue: Optional[int] = None,
+                 max_dispatch_depth: Optional[int] = None,
+                 heartbeat_interval_s: float = 0.1,
+                 failure_threshold: int = 3,
+                 breaker_cooldown_s: float = 0.5,
+                 retry_max: int = 5,
+                 retry_base_delay_s: float = 0.02,
+                 retry_backoff_factor: float = 2.0,
+                 retry_max_delay_s: float = 1.0,
+                 retry_jitter: float = 0.0,
+                 request_timeout_s: Optional[float] = None,
+                 time_fn: Optional[Callable[[], float]] = None,
+                 telemetry=True, seed: int = 0):
+        if not replicas:
+            raise ValueError("fabric needs at least one replica")
+        names = [r.name for r in replicas]
+        if len(set(names)) != len(names):
+            raise ValueError(f"duplicate replica names: {names}")
+        self.replicas: Dict[str, Replica] = {r.name: r for r in replicas}
+        self.replica_factory = replica_factory
+        self.supervisor = supervisor
+        self.max_queue = max_queue
+        self.max_dispatch_depth = max_dispatch_depth
+        self.heartbeat_interval_s = heartbeat_interval_s
+        self.breakers: Dict[str, CircuitBreaker] = {
+            n: CircuitBreaker(failure_threshold=failure_threshold,
+                              cooldown_s=breaker_cooldown_s)
+            for n in self.replicas}
+        self._failure_threshold = failure_threshold
+        self._breaker_cooldown_s = breaker_cooldown_s
+        self.retry_max = retry_max
+        self.retry_base_delay_s = retry_base_delay_s
+        self.retry_backoff_factor = retry_backoff_factor
+        self.retry_max_delay_s = retry_max_delay_s
+        self.retry_jitter = retry_jitter
+        self.request_timeout_s = request_timeout_s
+        self._rng = random.Random(seed)
+        self._time = time_fn or time.monotonic
+        self._real_clock = self._time in (time.monotonic, time.time,
+                                          time.perf_counter)
+        self._t0: Optional[float] = None
+        self._last_hb = float("-inf")
+        self._seq = 0
+        self._queue: List[_Tracked] = []
+        self._inflight: Dict[int, _Tracked] = {}
+        # terminal results accumulated since the last step() drain
+        # (sheds can happen inside submit(), between steps)
+        self._done: List[RequestResult] = []
+        self._restarting: Dict[str, float] = {}   # name -> resurrect-at
+        self._dead: set = set()                   # permanently abandoned
+        # consecutive per-attempt timeouts per replica: a straggler's
+        # steps SUCCEED (so the breaker's error path never sees it) —
+        # failure_threshold strikes without a completion in between
+        # trip the breaker explicitly
+        self._timeout_strikes: Dict[str, int] = {}
+        # fabric accounting (bench + tests read these directly)
+        self.dispatches = 0
+        self.failovers = 0
+        self.retries = 0
+        self.timeouts = 0
+        self.shed_overload = 0
+        self.shed_deadline = 0
+        self.replica_crashes = 0
+        self.replica_restarts = 0
+        self.quarantines = 0
+        self.completed = 0
+        if telemetry is True:
+            from deepspeed_tpu.telemetry import get_registry
+
+            self.telemetry = get_registry()
+        else:
+            self.telemetry = telemetry or None
+        log_dist(f"FabricRouter: replicas={names} max_queue={max_queue} "
+                 f"hb={heartbeat_interval_s}s timeout={request_timeout_s}",
+                 ranks=[0])
+
+    # ------------------------------------------------------------- telemetry
+    def _count(self, name: str, n: int = 1) -> None:
+        if self.telemetry is not None:
+            self.telemetry.counter(name).inc(n)
+
+    def _gauge(self, name: str, v: float) -> None:
+        if self.telemetry is not None:
+            self.telemetry.gauge(name).set(v)
+
+    def _observe(self, name: str, v: float) -> None:
+        if self.telemetry is not None:
+            self.telemetry.histogram(name).observe(v)
+
+    def _state_gauge(self, name: str) -> None:
+        if name in self._dead:
+            v = _STATE_DEAD
+        elif name in self._restarting:
+            v = _STATE_RESTARTING
+        else:
+            v = STATE_GAUGE[self.breakers[name].state]
+        self._gauge(f"fabric/replica_state/{name}", v)
+
+    # ----------------------------------------------------------------- clock
+    def _now(self) -> float:
+        if self._t0 is None:
+            return 0.0
+        return self._time() - self._t0
+
+    # ----------------------------------------------------------------- queue
+    @property
+    def pending(self) -> int:
+        return len(self._queue) + len(self._inflight)
+
+    def submit(self, request: Request, now: Optional[float] = None) -> None:
+        """Enqueue a request, applying bounded-queue backpressure: when
+        full, the worst STRICTLY-LOWER-class queued request is shed to
+        make room (lowest priority class first — PR 7's classes);
+        when the arrival itself is the worst, it is refused with
+        :class:`RouterOverloadedError`. The raise is the typed
+        backpressure signal; :meth:`run` converts it into a
+        ``shed_overload`` result for trace replays."""
+        now = self._now() if now is None else now
+        if self.max_queue is not None and len(self._queue) >= self.max_queue:
+            victim = None
+            for tr in self._queue:
+                if tr.request.priority <= request.priority:
+                    continue      # equal-or-better class: not sheddable
+                if victim is None \
+                        or (tr.request.priority, tr.request.arrival_time,
+                            tr.seq) > (victim.request.priority,
+                                       victim.request.arrival_time,
+                                       victim.seq):
+                    victim = tr
+            if victim is None:
+                raise RouterOverloadedError(
+                    f"router queue full ({self.max_queue}) and request "
+                    f"{request.rid} (class {request.priority}) outranks "
+                    f"nothing sheddable")
+            self._queue.remove(victim)
+            self._finish_shed(victim, now, "shed_overload")
+        tr = _Tracked(request, self._seq)
+        self._seq += 1
+        self._queue.append(tr)
+        self._gauge("fabric/queue_depth", len(self._queue))
+
+    def _finish_shed(self, tr: _Tracked, now: float, reason: str):
+        """Emit a terminal non-served result (shed/failed/error)."""
+        res = RequestResult(
+            rid=tr.request.rid, prompt_len=len(tr.request.prompt),
+            arrival_time=tr.request.arrival_time, finish_time=now,
+            finish_reason=reason, priority=tr.request.priority,
+            failovers=tr.failovers)
+        res.tokens = list(tr.committed)
+        res.token_times = list(tr.committed_times)
+        if reason == "shed_overload":
+            self.shed_overload += 1
+            self._count("fabric/shed_requests")
+            self._count("fabric/shed_overload")
+        elif reason == "shed_deadline":
+            self.shed_deadline += 1
+            self._count("fabric/shed_requests")
+            self._count("fabric/shed_deadline")
+        elif reason == "rejected":
+            self._count("fabric/rejected_requests")
+        else:
+            self._count("fabric/failed_requests")
+        self._done.append(res)
+        return res
+
+    # ------------------------------------------------------------ iteration
+    def step(self, now: Optional[float] = None) -> List[RequestResult]:
+        """One fabric iteration: resurrect due replicas, heartbeat +
+        breaker bookkeeping, shed expired deadlines, re-dispatch timed
+        out attempts, dispatch the queue least-loaded, then advance
+        every busy replica one serving iteration. Returns every
+        request that reached a terminal state (served, shed, failed)."""
+        if now is None:
+            now = self._now()
+        self._maybe_resurrect(now)
+        self._maybe_heartbeat(now)
+        self._shed_expired(now)
+        self._check_timeouts(now)
+        self._dispatch(now)
+        self._step_replicas(now)
+        done, self._done = self._done, []
+        return done
+
+    # ------------------------------------------------------- replica health
+    def _alive(self, name: str) -> bool:
+        return (name not in self._dead and name not in self._restarting
+                and getattr(self.replicas[name], "alive", True))
+
+    def _maybe_resurrect(self, now: float) -> None:
+        for name, at in sorted(self._restarting.items()):
+            if now < at or self.replica_factory is None:
+                continue
+            replica = self.replica_factory(name)
+            self.replicas[name] = replica
+            self.breakers[name] = CircuitBreaker(
+                failure_threshold=self._failure_threshold,
+                cooldown_s=self._breaker_cooldown_s)
+            del self._restarting[name]
+            self.replica_restarts += 1
+            self._count("fabric/replica_restarts")
+            self._state_gauge(name)
+            log_dist(f"fabric: replica {name} resurrected at t={now:.3f}",
+                     ranks=[0])
+
+    def _maybe_heartbeat(self, now: float) -> None:
+        if now - self._last_hb < self.heartbeat_interval_s:
+            return
+        self._last_hb = now
+        for name in sorted(self.replicas):
+            if not self._alive(name):
+                self._state_gauge(name)
+                continue
+            breaker = self.breakers[name]
+            if not breaker.allow_probe(now):
+                self._state_gauge(name)
+                continue
+            self._count("fabric/heartbeats")
+            try:
+                health = self.replicas[name].probe(now)
+            except ReplicaCrashedError:
+                self._on_crash(name, now)
+                continue
+            except TransientReplicaError:
+                self._count("fabric/probe_failures")
+                if breaker.record_failure(now):
+                    self._quarantine(name, now)
+                self._state_gauge(name)
+                continue
+            was_open = breaker.state != CLOSED
+            breaker.record_success(now)
+            if was_open:
+                self._count("fabric/breaker_recoveries")
+            self._gauge(f"fabric/replica_load/{name}", health.load)
+            self._gauge(f"fabric/replica_queue_depth/{name}",
+                        health.queue_depth)
+            self._gauge(f"fabric/replica_free_slots/{name}",
+                        health.free_slots)
+            if health.free_blocks is not None:
+                self._gauge(f"fabric/replica_free_blocks/{name}",
+                            health.free_blocks)
+            self._state_gauge(name)
+        self._gauge("fabric/healthy_replicas",
+                    sum(self._alive(n) and self.breakers[n].state == CLOSED
+                        for n in self.replicas))
+
+    def _quarantine(self, name: str, now: float) -> None:
+        """The breaker tripped OPEN on a still-alive replica: stop
+        dispatching to it and move its in-flight work to survivors —
+        cancelling each request on the replica first, so the stale copy
+        can never ALSO finish (the no-duplicates half of the failover
+        idempotency argument)."""
+        self.quarantines += 1
+        self._count("fabric/quarantines")
+        replica = self.replicas[name]
+        for rid, tr in sorted(self._inflight.items()):
+            if tr.replica != name:
+                continue
+            try:
+                replica.cancel(rid)
+            except ReplicaCrashedError:
+                self._on_crash(name, now)   # requeues the rest too
+                return
+            self._requeue(tr, now, crashed=False)
+        log_dist(f"fabric: replica {name} quarantined at t={now:.3f} "
+                 f"({self.breakers[name]!r})", ranks=[0])
+
+    def _on_crash(self, name: str, now: float) -> None:
+        """Replica died: fail its in-flight requests over (committed-
+        token resume), then ask the supervisor whether to resurrect."""
+        self.replica_crashes += 1
+        self._count("fabric/replica_crashes")
+        for rid, tr in sorted(self._inflight.items()):
+            if tr.replica == name:
+                self._requeue(tr, now, crashed=True)
+        if self.supervisor is not None and self.replica_factory is not None:
+            at = self.supervisor.on_failure(name, now)
+        else:
+            at = None
+        if at is None:
+            self._dead.add(name)
+            self._count("fabric/replicas_abandoned")
+        else:
+            self._restarting[name] = at
+        # the dead incarnation's straggler strikes die with it — a
+        # resurrected replica starts clean (its breaker already does)
+        self._timeout_strikes.pop(name, None)
+        self._state_gauge(name)
+        log_dist(f"fabric: replica {name} crashed at t={now:.3f}; "
+                 + (f"restart at t={at:.3f}" if at is not None
+                    else "abandoned"), ranks=[0])
+
+    # -------------------------------------------------------- retry/failover
+    def _retry_delay(self, k: int) -> float:
+        return backoff_delay(k, base_s=self.retry_base_delay_s,
+                             factor=self.retry_backoff_factor,
+                             cap_s=self.retry_max_delay_s,
+                             jitter=self.retry_jitter, rng=self._rng)
+
+    def _requeue(self, tr: _Tracked, now: float, *, crashed: bool) -> None:
+        """Return an in-flight request to the router queue for another
+        attempt: committed tokens ride along (the resume context), the
+        retry budget is charged, and backoff gates the re-dispatch."""
+        self._inflight.pop(tr.request.rid, None)
+        tr.replica = None
+        tr.dispatch_t = None
+        tr.retries += 1
+        if crashed:
+            tr.failovers += 1
+            tr.crash_t = now
+            self.failovers += 1
+            self._count("fabric/failovers")
+        if tr.retries > self.retry_max:
+            self._finish_shed(tr, now, "failed")
+            return
+        self.retries += 1
+        self._count("fabric/retries")
+        tr.not_before = now + self._retry_delay(tr.retries)
+        self._queue.append(tr)
+
+    def _shed_expired(self, now: float) -> None:
+        """Drop queued requests whose deadline already passed — before
+        they waste prefill compute on an answer nobody is waiting for."""
+        for tr in list(self._queue):
+            dl = tr.request.deadline
+            if dl is not None and now > dl:
+                self._queue.remove(tr)
+                self._finish_shed(tr, now, "shed_deadline")
+
+    def _check_timeouts(self, now: float) -> None:
+        """Per-attempt router-side timeout: cancel the stale copy on
+        its (straggling) replica and re-dispatch elsewhere. The cancel
+        MUST succeed before the request re-enters the queue — a copy
+        we cannot cancel is a copy that could finish twice — so a
+        cancel on a crashed replica degrades into the crash path."""
+        if self.request_timeout_s is None:
+            return
+        for rid, tr in sorted(self._inflight.items()):
+            if tr.dispatch_t is None \
+                    or now - tr.dispatch_t <= self.request_timeout_s:
+                continue
+            name = tr.replica
+            self.timeouts += 1
+            self._count("fabric/timeouts")
+            try:
+                self.replicas[name].cancel(rid)
+            except ReplicaCrashedError:
+                self._on_crash(name, now)
+                continue
+            self._requeue(tr, now, crashed=False)
+            # straggler detection: timeouts are the only signal a slow-
+            # but-alive replica emits (its steps and probes all SUCCEED,
+            # so the breaker's error path never fires). failure_threshold
+            # consecutive strikes without a completed request in between
+            # trip the breaker explicitly.
+            strikes = self._timeout_strikes.get(name, 0) + 1
+            self._timeout_strikes[name] = strikes
+            if strikes >= self._failure_threshold:
+                self._timeout_strikes[name] = 0
+                self.breakers[name].trip(now)
+                self._quarantine(name, now)
+
+    # --------------------------------------------------------------- dispatch
+    def _dispatch_targets(self) -> List[str]:
+        out = []
+        for name in sorted(self.replicas):
+            if not self._alive(name) or not self.breakers[name].dispatchable:
+                continue
+            if self.max_dispatch_depth is not None and \
+                    self.replicas[name].pending >= self.max_dispatch_depth:
+                continue
+            out.append(name)
+        return out
+
+    def _dispatch(self, now: float) -> None:
+        if not self._queue:
+            return
+        if (not self._restarting
+                and all(not getattr(r, "alive", True) or n in self._dead
+                        for n, r in self.replicas.items())):
+            # every replica is permanently gone: nothing will ever be
+            # served again — fail the backlog loudly instead of
+            # spinning forever
+            err = NoHealthyReplicaError("all replicas dead/abandoned")
+            for tr in list(self._queue):
+                self._queue.remove(tr)
+                self._finish_shed(tr, now, "failed")
+                log_dist(f"fabric: {err}: failing request "
+                         f"{tr.request.rid}", ranks=[0])
+            return
+        ready = sorted(
+            (tr for tr in self._queue
+             if tr.request.arrival_time <= now and tr.not_before <= now),
+            key=lambda tr: (tr.request.priority, tr.request.arrival_time,
+                            tr.seq))
+        for tr in ready:
+            targets = self._dispatch_targets()
+            if not targets:
+                break
+            name = min(targets,
+                       key=lambda n: (self.replicas[n].pending, n))
+            try:
+                self.replicas[name].submit(self._wrap(tr))
+            except InvalidRequestError as e:
+                # permanent: the request would fail identically anywhere
+                self._queue.remove(tr)
+                self._finish_shed(tr, now, "rejected")
+                log_dist(f"fabric: request {tr.request.rid} rejected: {e}",
+                         ranks=[0])
+                continue
+            except ReplicaCrashedError:
+                self._on_crash(name, now)
+                continue
+            except TransientReplicaError:
+                if self.breakers[name].record_failure(now):
+                    self._quarantine(name, now)
+                continue
+            self._queue.remove(tr)
+            self._inflight[tr.request.rid] = tr
+            tr.replica = name
+            tr.dispatch_t = now
+            self.dispatches += 1
+            self._count("fabric/dispatches")
+            if tr.crash_t is not None:
+                # failover latency: replica death -> work back on a
+                # healthy replica (detection + backoff + placement)
+                self._observe("fabric/failover_latency_ms",
+                              max(now - tr.crash_t, 0.0) * 1e3)
+                tr.crash_t = None
+
+    def _wrap(self, tr: _Tracked) -> Request:
+        """The engine-level request for the CURRENT attempt: original
+        prompt + every committed token as the prompt (so a resumed
+        request re-prefills its own history and continues exactly where
+        the stream left off), remaining budget, and the router's
+        committing callback interposed before the user's.
+
+        The resumed prompt is LONGER than the original by the committed
+        count — prompt + max_new always fit the slot (that sum is
+        invariant), but on engines WITHOUT chunked prefill a resume can
+        outgrow the largest prefill bucket and be rejected; size
+        buckets to max_len (or enable prefill_token_budget) on fabric
+        replicas."""
+        base = tr.request
+
+        def on_token(tok: int, _tr=tr) -> None:
+            self._commit(_tr, tok)
+
+        return Request(
+            rid=base.rid,
+            prompt=list(base.prompt) + list(tr.committed),
+            max_new_tokens=base.max_new_tokens - len(tr.committed),
+            arrival_time=base.arrival_time, priority=base.priority,
+            on_token=on_token, deadline=base.deadline)
+
+    def _commit(self, tr: _Tracked, tok: int) -> None:
+        now = self._now()
+        tr.committed.append(tok)
+        tr.committed_times.append(now)
+        if tr.first_token_time is None:
+            tr.first_token_time = now
+        if tr.user_cb is not None:
+            tr.user_cb(tok)
+
+    # ----------------------------------------------------------------- step
+    def _step_replicas(self, now: float) -> None:
+        for name in sorted(self.replicas):
+            if not self._alive(name):
+                continue
+            replica = self.replicas[name]
+            if not any(tr.replica == name for tr in self._inflight.values()):
+                continue
+            breaker = self.breakers[name]
+            try:
+                results = replica.step(now)
+            except ReplicaCrashedError:
+                self._on_crash(name, now)
+                continue
+            except TransientReplicaError:
+                self._count("fabric/transient_errors")
+                if breaker.record_failure(now):
+                    self._quarantine(name, now)
+                continue
+            breaker.record_success(now)
+            for res in results:
+                self._finalize(res, now)
+
+    def _finalize(self, res: RequestResult, now: float) -> None:
+        tr = self._inflight.pop(res.rid, None)
+        if tr is None:
+            return   # cancelled concurrently (should not happen in-process)
+        # splice the fabric view over the final attempt's result: the
+        # committed stream IS the full token sequence (prior attempts'
+        # tokens rode in this attempt's prompt and never re-streamed)
+        res.tokens = list(tr.committed)
+        res.token_times = list(tr.committed_times)
+        res.prompt_len = len(tr.request.prompt)
+        if tr.first_token_time is not None:
+            res.first_token_time = tr.first_token_time
+        res.priority = tr.request.priority
+        res.failovers = tr.failovers
+        res.replica = tr.replica or ""
+        if tr.replica:
+            # a completion is real progress: the replica is not stuck
+            self._timeout_strikes[tr.replica] = 0
+        if res.finish_reason == "shed_deadline":
+            # the ENGINE shed it at admission (deadline expired while
+            # queued inside the replica, past the router's own check):
+            # account it as a shed, not a completion
+            self.shed_deadline += 1
+            self._count("fabric/shed_requests")
+            self._count("fabric/shed_deadline")
+        else:
+            self.completed += 1
+            self._count("fabric/completed_requests")
+        self._done.append(res)
+
+    def _rebase_clock(self) -> None:
+        """Anchor the offset clock at 'now' for a (re)starting run().
+        Every stored instant — breaker cooldown anchors, pending
+        restarts, retry gates, in-flight dispatch stamps, supervisor
+        restart windows — is expressed in run-relative offsets, so a
+        SECOND run() on the same router must shift them into the new
+        base or heartbeats/cooldowns would stall for the length of the
+        previous trace (and the very first heartbeat must fire
+        immediately)."""
+        new_t0 = self._time()
+        if self._t0 is not None:
+            shift = new_t0 - self._t0
+            for b in self.breakers.values():
+                if b.opened_at is not None:
+                    b.opened_at -= shift
+            self._restarting = {n: at - shift
+                                for n, at in self._restarting.items()}
+            for tr in self._queue:
+                tr.not_before -= shift
+            for tr in self._inflight.values():
+                if tr.dispatch_t is not None:
+                    tr.dispatch_t -= shift
+                if tr.crash_t is not None:
+                    tr.crash_t -= shift
+            if self.supervisor is not None:
+                self.supervisor.rebase(shift)
+        self._last_hb = float("-inf")
+        self._t0 = new_t0
+
+    # ------------------------------------------------------------------ run
+    def run(self, requests: Sequence[Request], *,
+            warmup: bool = True) -> List[RequestResult]:
+        """Serve a trace to completion across the fabric.
+        ``arrival_time``s are offsets from run() start. Overflow
+        backpressure (:class:`RouterOverloadedError`) is converted into
+        ``shed_overload`` results so trace replays account for every
+        request; direct :meth:`submit` callers get the raise instead."""
+        if warmup:
+            for name in sorted(self.replicas):
+                if self._alive(name):
+                    self.replicas[name].warmup()
+        future = sorted(requests, key=lambda r: (r.arrival_time, r.rid))
+        self._rebase_clock()
+        out: List[RequestResult] = []
+        i = 0
+        stall = 0
+        while i < len(future) or self._queue or self._inflight:
+            now = self._time() - self._t0
+            while i < len(future) and future[i].arrival_time <= now:
+                try:
+                    self.submit(future[i], now=now)
+                except RouterOverloadedError:
+                    tr = _Tracked(future[i], self._seq)
+                    self._seq += 1
+                    self._finish_shed(tr, now, "shed_overload")
+                i += 1
+            before = len(out)
+            out.extend(self.step(now))
+            progressed = len(out) > before or bool(self._inflight)
+            if not progressed and self._real_clock:
+                time.sleep(0.001)
+            stall = 0 if progressed else stall + 1
+            if stall > 10_000_000:
+                raise RuntimeError(
+                    "fabric clock is not advancing toward the next "
+                    "arrival/retry/restart (non-monotonic time_fn?)")
+        out.extend(self._done)   # sheds emitted after the last step drain
+        self._done = []
+        if self.telemetry is not None:
+            self._gauge("fabric/queue_depth", 0)
+            self._gauge("fabric/completed_total", self.completed)
+            self.telemetry.flush()
+        return out
+
+    # ------------------------------------------------------------- inspection
+    def recompile_count(self) -> int:
+        """Sum of post-warmup recompiles across the LIVING replica set
+        (the chaos suites pin this at zero — crash/failover/resume must
+        never change a compiled program's operand signature)."""
+        return sum(self.replicas[n].recompile_count()
+                   for n in self.replicas if self._alive(n))
+
+    def __repr__(self):
+        states = {n: ("dead" if n in self._dead else
+                      "restarting" if n in self._restarting else
+                      self.breakers[n].state)
+                  for n in sorted(self.replicas)}
+        return (f"FabricRouter(replicas={states}, queue={len(self._queue)}, "
+                f"inflight={len(self._inflight)})")

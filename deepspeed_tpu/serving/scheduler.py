@@ -50,6 +50,11 @@ class Request:
     # RequestResult.tokens (pinned by tests).
     on_token: Optional[Callable[[int], None]] = dataclasses.field(
         default=None, repr=False, compare=False)
+    # absolute completion deadline in the engine clock (same base as
+    # arrival_time), or None for no SLO. The fabric router (ISSUE 9)
+    # sheds a request whose deadline expired while still queued —
+    # before it wastes prefill compute it can no longer make use of.
+    deadline: Optional[float] = None
 
 
 @dataclasses.dataclass
@@ -91,6 +96,13 @@ class RequestResult:
     preemptions: int = 0
     preempted_wall: float = 0.0
     decode_preempted_wall: float = 0.0
+    # fabric accounting (ISSUE 9): times the request failed over to a
+    # surviving replica after a crash, and the replica that finished it
+    # ("" outside the fabric). finish_reason grows the router's
+    # terminal states: "shed_overload" | "shed_deadline" | "rejected" |
+    # "failed" alongside the engine's "eos" | "length".
+    failovers: int = 0
+    replica: str = ""
 
     @property
     def latency(self) -> float:
@@ -269,6 +281,21 @@ class SlotScheduler:
             self.admissions_per_slot[slot] += 1
             out.append((req, slot))
         return out
+
+    def remove(self, rid: int) -> bool:
+        """Withdraw a WAITING request (ISSUE 9 — the fabric router's
+        cancel path: a timed-out or failed-over request must not run
+        twice). Returns False when ``rid`` is not queued (already
+        admitted, finished, or never submitted); slots are untouched —
+        cancelling an admitted request is the engine's job."""
+        for pri, q in list(self._queues.items()):
+            for i, (_seq, req) in enumerate(q):
+                if req.rid == rid:
+                    del q[i]
+                    if not q:
+                        del self._queues[pri]
+                    return True
+        return False
 
     def release(self, slot: int) -> None:
         assert slot not in self._free, f"slot {slot} double-released"

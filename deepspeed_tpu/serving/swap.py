@@ -21,9 +21,11 @@ state.
 
 from __future__ import annotations
 
-from typing import Dict, Tuple
+from typing import Dict, Optional, Tuple
 
 import numpy as np
+
+from deepspeed_tpu.serving.errors import SwapCapacityError
 
 
 class HostSwapBuffer:
@@ -35,24 +37,59 @@ class HostSwapBuffer:
     restoring it twice). Byte accounting covers exactly what is stored;
     ``peak_bytes`` is the high-water mark a deployment sizes its host
     reservation against.
+
+    ``max_bytes`` (ISSUE 9 satellite) caps the buffer: a ``put`` that
+    would exceed it raises :class:`SwapCapacityError` BEFORE storing
+    anything, so sustained preemption pressure degrades predictably
+    (the engine declines the preemption and the candidate waits)
+    instead of silently growing host memory until the OOM killer picks
+    a victim. ``None`` keeps the historical unbounded behavior.
     """
 
-    def __init__(self):
+    def __init__(self, max_bytes: Optional[int] = None):
+        if max_bytes is not None and max_bytes <= 0:
+            raise ValueError(f"swap max_bytes must be positive or None, "
+                             f"got {max_bytes}")
+        self.max_bytes = max_bytes
         self._entries: Dict[int, Tuple[np.ndarray, np.ndarray]] = {}
         self.bytes_stored = 0
         self.peak_bytes = 0
         self.total_swaps_out = 0
         self.total_swaps_in = 0
+        self.capacity_rejections = 0
+
+    def fits(self, nbytes: int) -> bool:
+        """Would ``nbytes`` more fit under the cap right now?"""
+        return (self.max_bytes is None
+                or self.bytes_stored + nbytes <= self.max_bytes)
 
     def put(self, rid: int, k: np.ndarray, v: np.ndarray) -> None:
         if rid in self._entries:
             raise ValueError(
                 f"request {rid} is already swapped out (double preemption "
                 f"without a resume)")
+        if not self.fits(k.nbytes + v.nbytes):
+            self.capacity_rejections += 1
+            raise SwapCapacityError(
+                f"host swap buffer full: {self.bytes_stored} bytes stored "
+                f"+ {k.nbytes + v.nbytes} requested exceeds max_bytes "
+                f"{self.max_bytes} ({len(self._entries)} parked requests)")
         self._entries[rid] = (k, v)
         self.bytes_stored += k.nbytes + v.nbytes
         self.peak_bytes = max(self.peak_bytes, self.bytes_stored)
         self.total_swaps_out += 1
+
+    def discard(self, rid: int) -> bool:
+        """Drop a parked entry WITHOUT restoring it (request cancelled
+        while swapped out): frees the bytes but does not count a
+        swap-in — ``total_swaps_in`` keeps meaning 'KV actually
+        restored to device'. Returns False when nothing was parked."""
+        entry = self._entries.pop(rid, None)
+        if entry is None:
+            return False
+        k, v = entry
+        self.bytes_stored -= k.nbytes + v.nbytes
+        return True
 
     def pop(self, rid: int) -> Tuple[np.ndarray, np.ndarray]:
         if rid not in self._entries:

@@ -95,17 +95,31 @@ class JsonlSink:
         self.close()
 
 
-def read_jsonl(path: str) -> List[dict]:
-    """Parse a telemetry JSONL file, skipping lines torn by a crash
-    mid-write (same tolerance as the test-harness report reader)."""
+def read_jsonl(path: str, *, return_bad: bool = False):
+    """Parse a telemetry JSONL file, tolerating a crash mid-write
+    (ISSUE 9 satellite): a truncated or corrupt trailing line — torn
+    JSON, undecodable bytes, a non-object value — is SKIPPED AND
+    COUNTED, never raised, because the post-crash report must not fail
+    on the very artifact needed to debug the crash. The file is read as
+    bytes (a write torn inside a multi-byte UTF-8 sequence would make
+    text-mode iteration itself raise) and each line decoded leniently.
+
+    Returns the parsed records; with ``return_bad=True`` returns
+    ``(records, n_bad_lines)`` so callers can surface the damage."""
     out: List[dict] = []
-    with open(path) as f:
-        for line in f:
-            line = line.strip()
+    bad = 0
+    with open(path, "rb") as f:
+        for raw in f:
+            line = raw.decode("utf-8", errors="replace").strip()
             if not line:
                 continue
             try:
-                out.append(json.loads(line))
+                rec = json.loads(line)
             except ValueError:
+                bad += 1
                 continue
-    return out
+            if not isinstance(rec, dict):
+                bad += 1
+                continue
+            out.append(rec)
+    return (out, bad) if return_bad else out

@@ -1,9 +1,10 @@
 from deepspeed_tpu.testing.fault_injection import (
     FakeClock,
     FaultInjector,
+    ReplicaFaultPlan,
     ScriptedWorkerGroup,
     SimulatedCrash,
 )
 
-__all__ = ["FakeClock", "FaultInjector", "ScriptedWorkerGroup",
-           "SimulatedCrash"]
+__all__ = ["FakeClock", "FaultInjector", "ReplicaFaultPlan",
+           "ScriptedWorkerGroup", "SimulatedCrash"]

@@ -11,6 +11,15 @@ For the elasticity layer, :class:`FakeClock` and :class:`ScriptedWorkerGroup`
 drive :class:`~deepspeed_tpu.elasticity.elastic_agent.ElasticAgent` through
 arbitrary failure/preemption schedules in virtual time.
 
+For the SERVING fabric (ISSUE 9), the injector grows replica seams:
+:meth:`FaultInjector.replica_plan` returns a per-replica
+:class:`ReplicaFaultPlan` that an
+:class:`~deepspeed_tpu.serving.fabric.replica.InProcessReplica` consults
+on every step/probe — scripted crash on the Nth step, slow-replica
+straggling (virtual-time stalls), flaky steps, and failing health
+probes — so a 3-replica chaos suite runs entirely in-process, in
+virtual time, tier-1-safe.
+
 Usage::
 
     with FaultInjector() as inj:
@@ -23,7 +32,7 @@ Usage::
 from __future__ import annotations
 
 import time as _time
-from typing import Callable, List, Optional, Sequence
+from typing import Callable, Dict, List, Optional, Sequence
 
 from deepspeed_tpu.utils import fs
 
@@ -47,6 +56,11 @@ class FaultInjector:
         self.read_calls = 0
         self.replace_calls = 0
         self._saved = {}
+        # serving-fabric seams (ISSUE 9): replica name -> fault plan,
+        # consulted by InProcessReplica on every step/probe. Plans are
+        # plain scripted state, not monkey-patches, so restore() does
+        # not apply — they die with the injector.
+        self._replica_plans: Dict[str, "ReplicaFaultPlan"] = {}
 
     # ------------------------------------------------------------ lifecycle
     def __enter__(self) -> "FaultInjector":
@@ -160,6 +174,44 @@ class FaultInjector:
 
         self._patch("read_bytes", read_bytes)
 
+    # ------------------------------------------------- serving seams (ISSUE 9)
+    def replica_plan(self, name: str) -> "ReplicaFaultPlan":
+        """Fault plan for replica ``name`` (created on first access).
+        Hand it to ``InProcessReplica(chaos=...)``; the scripting
+        helpers below mutate the same plan by name."""
+        return self._replica_plans.setdefault(name, ReplicaFaultPlan(name))
+
+    def crash_replica_step(self, name: str, nth: int):
+        """Replica ``name`` dies entering its ``nth`` step (1-based,
+        counting from when the plan attaches): models a replica process
+        SIGKILLed mid-trace — the router must fail its in-flight
+        requests over to a survivor."""
+        self.replica_plan(name).crash_at_step = nth
+
+    def flaky_replica_step(self, name: str, nth: int, count: int = 1):
+        """Steps ``nth .. nth+count-1`` of replica ``name`` raise a
+        retryable transient error (the replica stays alive): repeated
+        transients should trip the router's circuit breaker."""
+        plan = self.replica_plan(name)
+        plan.flaky_steps.update(range(nth, nth + count))
+
+    def straggle_replica(self, name: str, delay_s: float, *,
+                         from_step: int = 1, until_step: Optional[int] = None):
+        """Replica ``name`` becomes a straggler: every step in
+        ``[from_step, until_step]`` stalls the (virtual) clock by
+        ``delay_s`` — the slow-host shape that blows per-request
+        deadlines without any crash."""
+        plan = self.replica_plan(name)
+        plan.slow_from, plan.slow_until = from_step, until_step
+        plan.slow_delay_s = delay_s
+
+    def fail_replica_probes(self, name: str, count: int = 1):
+        """The next ``count`` health probes of replica ``name`` raise a
+        transient error (probe timeout / connection refused) while
+        steps keep working — health-check flap the breaker must absorb
+        or act on."""
+        self.replica_plan(name).failing_probes += count
+
     def crash_on_replace(self, nth: int = 1):
         """Process dies at the publish step: the tmp file is complete but
         the atomic rename never happens — the prior version must survive."""
@@ -174,15 +226,78 @@ class FaultInjector:
         self._patch("replace", replace)
 
 
-class FakeClock:
-    """Deterministic virtual clock for ElasticAgent tests: pass ``.time``
-    as ``time_fn`` and ``.sleep`` as ``sleep_fn``."""
+class ReplicaFaultPlan:
+    """Scripted fault schedule for ONE serving replica (ISSUE 9).
 
-    def __init__(self, start: float = 0.0):
+    ``InProcessReplica`` calls :meth:`on_step` entering every engine
+    step and :meth:`on_probe` on every health probe; the plan raises
+    the typed serving errors the fabric's failure model is written
+    against — :class:`SimulatedCrash` for process death (the replica
+    wrapper converts it to a terminal ``ReplicaCrashedError``) and
+    ``TransientReplicaError`` for retryable flap. Slow-straggler steps
+    stall the test's virtual clock (any object with ``advance``), so
+    "this replica is 100x slower" is expressible without wall time.
+    """
+
+    def __init__(self, name: str):
+        self.name = name
+        self.crash_at_step: Optional[int] = None
+        self.flaky_steps: set = set()
+        self.slow_from: int = 0
+        self.slow_until: Optional[int] = None
+        self.slow_delay_s: float = 0.0
+        self.failing_probes: int = 0
+        self.step_calls = 0
+        self.probe_calls = 0
+
+    def on_step(self, clock=None) -> None:
+        from deepspeed_tpu.serving.errors import TransientReplicaError
+
+        self.step_calls += 1
+        n = self.step_calls
+        if self.crash_at_step is not None and n >= self.crash_at_step:
+            # one-shot: a crash kills ONE process; a resurrected replica
+            # re-attaching the same plan starts clean (script another
+            # crash with crash_replica_step again if the schedule says so)
+            self.crash_at_step = None
+            raise SimulatedCrash(
+                f"replica {self.name}: scripted crash at step {n}")
+        if (self.slow_delay_s and n >= self.slow_from
+                and (self.slow_until is None or n <= self.slow_until)):
+            advance = getattr(clock, "advance", None)
+            if advance is not None:
+                advance(self.slow_delay_s)
+        if n in self.flaky_steps:
+            raise TransientReplicaError(
+                f"replica {self.name}: scripted flaky step {n}")
+
+    def on_probe(self) -> None:
+        from deepspeed_tpu.serving.errors import TransientReplicaError
+
+        self.probe_calls += 1
+        if self.failing_probes > 0:
+            self.failing_probes -= 1
+            raise TransientReplicaError(
+                f"replica {self.name}: scripted probe failure "
+                f"#{self.probe_calls}")
+
+
+class FakeClock:
+    """Deterministic virtual clock for ElasticAgent / serving-fabric
+    tests: pass ``.time`` as ``time_fn`` and ``.sleep`` as ``sleep_fn``.
+    ``auto_dt`` > 0 advances the clock by that much per ``time()`` READ
+    — the serving engines poll the clock once per iteration, so an
+    auto-advancing clock replays arrival traces deterministically
+    without anyone calling ``advance`` (the fabric chaos suite's
+    mode)."""
+
+    def __init__(self, start: float = 0.0, auto_dt: float = 0.0):
         self.now = start
+        self.auto_dt = auto_dt
         self.sleeps: List[float] = []
 
     def time(self) -> float:
+        self.now += self.auto_dt
         return self.now
 
     def sleep(self, seconds: float):

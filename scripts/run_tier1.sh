@@ -25,4 +25,9 @@ grep -qs "def test_" tests/unit/serving/test_prefix_cache.py || { echo "tier-1: 
 # zero-recompile invariants ride `-m 'not slow'` through
 # tests/unit/serving/test_slo.py
 grep -qs "def test_" tests/unit/serving/test_slo.py || { echo "tier-1: slo tests missing"; exit 1; }
+# likewise the serving-fabric suite (marker `fabric`): multi-replica
+# failover losslessness under scripted chaos, circuit-breaker /
+# shedding / supervisor invariants ride `-m 'not slow'` through
+# tests/unit/serving/test_fabric.py
+grep -qs "def test_" tests/unit/serving/test_fabric.py || { echo "tier-1: fabric tests missing"; exit 1; }
 exit $rc

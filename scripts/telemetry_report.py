@@ -30,24 +30,32 @@ from collections import OrderedDict
 
 
 def load_records(path):
-    """Tolerant JSONL reader (torn trailing lines from a crash are
-    skipped, matching telemetry.sink.read_jsonl)."""
+    """Tolerant JSONL reader, matching telemetry.sink.read_jsonl
+    (ISSUE 9 satellite): lines torn by a crash mid-write — truncated
+    JSON, bytes cut inside a UTF-8 sequence, non-object values — are
+    skipped and COUNTED, never raised. The report renders the artifact
+    that survives a crash, so it must not fail on crash damage.
+    Returns ``(records, n_bad_lines)``."""
     out = []
-    with open(path) as f:
-        for line in f:
-            line = line.strip()
+    bad = 0
+    with open(path, "rb") as f:
+        for raw in f:
+            line = raw.decode("utf-8", errors="replace").strip()
             if not line:
                 continue
             try:
                 rec = json.loads(line)
             except ValueError:
+                bad += 1
                 continue
             if isinstance(rec, dict):
                 out.append(rec)
-    return out
+            else:
+                bad += 1
+    return out, bad
 
 
-def aggregate(records):
+def aggregate(records, n_bad_lines=0):
     last_snapshot = None
     scalars = OrderedDict()   # tag -> stats dict
     events = OrderedDict()    # name -> {count, last_fields}
@@ -89,7 +97,9 @@ def aggregate(records):
         "speculation": _speculation_summary(metrics),
         "prefix_cache": _prefix_cache_summary(metrics),
         "slo": _slo_summary(metrics),
+        "fabric": _fabric_summary(metrics),
         "n_records": len(records),
+        "n_bad_lines": n_bad_lines,
     }
 
 
@@ -193,6 +203,32 @@ def _slo_summary(metrics):
     return out
 
 
+def _fabric_summary(metrics):
+    """Derived multi-replica fabric view (ISSUE 9) over the router's
+    raw counters/gauges/histograms: dispatch/failover/retry/shed/crash
+    counters, the failover-latency tail, and the per-replica health
+    gauges (load, queue depth, free slots, breaker state). Empty dict
+    when the run never used the fabric."""
+    counters = {k: v for k, v in metrics.get("counters", {}).items()
+                if k.startswith("fabric/")}
+    gauges = {k: v for k, v in metrics.get("gauges", {}).items()
+              if k.startswith("fabric/")}
+    hists = {k: h for k, h in metrics.get("histograms", {}).items()
+             if k.startswith("fabric/") and h.get("count")}
+    if not counters and not gauges and not hists:
+        return {}
+    out = {}
+    for k, v in sorted(counters.items()):
+        out[k.split("/", 1)[1]] = v
+    for k, v in sorted(gauges.items()):
+        out[k.split("/", 1)[1]] = v
+    for k, h in sorted(hists.items()):
+        out[k.split("/", 1)[1]] = {
+            "count": h.get("count"), "p50": h.get("p50"),
+            "p95": h.get("p95"), "p99": h.get("p99")}
+    return out
+
+
 def _fmt(v):
     if v is None:
         return "-"
@@ -220,7 +256,9 @@ def _table(title, header, rows, out):
 def render(agg):
     out = [f"telemetry report — {agg['n_records']} records"
            + (f", last snapshot at step {agg['snapshot_step']}"
-              if agg["snapshot_step"] is not None else "")]
+              if agg["snapshot_step"] is not None else "")
+           + (f", {agg['n_bad_lines']} corrupt line(s) skipped"
+              if agg.get("n_bad_lines") else "")]
     _table("counters", ("counter", "value"),
            [(k, _fmt(v)) for k, v in sorted(agg["counters"].items())], out)
     _table("gauges", ("gauge", "value"),
@@ -248,6 +286,10 @@ def render(agg):
            [(k, _fmt(v) if not isinstance(v, dict) else
              " ".join(f"{kk}={_fmt(vv)}" for kk, vv in v.items()))
             for k, v in agg.get("slo", {}).items()], out)
+    _table("fabric", ("metric", "value"),
+           [(k, _fmt(v) if not isinstance(v, dict) else
+             " ".join(f"{kk}={_fmt(vv)}" for kk, vv in v.items()))
+            for k, v in agg.get("fabric", {}).items()], out)
     erows = [(k, e["count"],
               json.dumps(e["last"], default=str)[:60])
              for k, e in agg["events"].items()]
@@ -262,12 +304,12 @@ def main(argv=None) -> int:
                    help="emit the aggregate as JSON instead of tables")
     args = p.parse_args(argv)
     try:
-        records = load_records(args.path)
+        records, n_bad = load_records(args.path)
     except OSError as e:
         print(f"telemetry_report: cannot read {args.path}: {e}",
               file=sys.stderr)
         return 2
-    agg = aggregate(records)
+    agg = aggregate(records, n_bad_lines=n_bad)
     if args.json:
         print(json.dumps(agg, indent=2, default=str))
     else:

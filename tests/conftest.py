@@ -297,7 +297,8 @@ _OBSERVABILITY_MODULES = ("unit/monitor/", "unit/telemetry/",
 _LATE_MODULES = _OBSERVABILITY_MODULES + (
     "unit/serving/test_speculative",
     "unit/serving/test_prefix_cache",
-    "unit/serving/test_slo",)
+    "unit/serving/test_slo",
+    "unit/serving/test_fabric",)
 
 
 def pytest_collection_modifyitems(config, items):

@@ -1,0 +1,621 @@
+"""Fault-tolerant multi-replica serving fabric (ISSUE 9 acceptance).
+
+All in-process, on CPU, in VIRTUAL time (FakeClock + the scripted
+replica fault seams in testing/fault_injection.py). Pinned here:
+
+  * CHAOS LOSSLESSNESS: a 3-replica fabric driven through the PR 7
+    adversarial traces (bimodal long-prompt, bursty) with scripted
+    mid-trace replica crashes completes EVERY non-shed request with
+    greedy tokens BIT-IDENTICAL to a fault-free single-replica run —
+    failover resumes from the router's committed-token record — with
+    zero recompiles per replica, and the failover/retry/shed counters
+    + failover-latency histogram land in telemetry JSONL and the
+    telemetry_report fabric section;
+  * streaming idempotency: across crash + failover the client's
+    on_token stream carries NO duplicated or reordered tokens (it is
+    exactly RequestResult.tokens);
+  * circuit breaker: consecutive transient failures quarantine a
+    replica (its in-flight work is cancelled + re-dispatched — never
+    duplicated), a cooldown later one half-open probe decides recovery;
+  * straggler mitigation: per-attempt router timeouts cancel work
+    stuck on a slow replica and finish it elsewhere, losslessly;
+  * graceful degradation: bounded-queue backpressure sheds the lowest
+    priority class first (typed RouterOverloadedError when nothing is
+    sheddable), expired deadlines are shed BEFORE prefill;
+  * the replica supervisor mirrors ElasticAgent semantics in virtual
+    time: rolling restart budget, exponential backoff, restartable
+    exits that never burn budget (satellite);
+  * ServingEngine.submit raises TYPED errors at submit time
+    (satellite), HostSwapBuffer honors max_bytes with a typed capacity
+    error + predictable engine degradation (satellite), and
+    ServingEngine.cancel frees whatever the request held.
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+import deepspeed_tpu
+from deepspeed_tpu.models.gpt2 import GPT2Config, GPT2Model
+from deepspeed_tpu.serving import (CircuitBreaker, EmptyPromptError,
+                                   FabricRouter, HostSwapBuffer,
+                                   InProcessReplica,
+                                   InvalidMaxNewTokensError,
+                                   PromptTooLongError, ReplicaSupervisor,
+                                   Request, RouterOverloadedError,
+                                   ServingEngine, SlotCapacityError,
+                                   SwapCapacityError, bimodal_trace,
+                                   bursty_poisson_trace)
+from deepspeed_tpu.telemetry import JsonlSink, MetricsRegistry, read_jsonl
+from deepspeed_tpu.testing import FakeClock, FaultInjector
+from deepspeed_tpu.utils import groups
+
+pytestmark = [pytest.mark.fabric, pytest.mark.serving, pytest.mark.quick]
+
+_ENGINE = {}
+
+
+def _inference_engine():
+    """One InferenceEngine per module run: every replica's ServingEngine
+    shares its params AND compiled-program cache — the production
+    single-host shape, and what makes 'zero recompiles per replica'
+    directly checkable (same shapes -> same cached executables)."""
+    if "eng" not in _ENGINE:
+        groups.reset()
+        cfg = GPT2Config.tiny()
+        _ENGINE["cfg"] = cfg
+        _ENGINE["eng"] = deepspeed_tpu.init_inference(
+            GPT2Model(cfg), dtype="fp32", max_out_tokens=128)
+    return _ENGINE["cfg"], _ENGINE["eng"]
+
+
+def _serving(clock, **kw):
+    _, eng = _inference_engine()
+    kw.setdefault("num_slots", 4)
+    kw.setdefault("max_len", 64)
+    kw.setdefault("buckets", (16, 64))
+    kw.setdefault("telemetry", False)
+    return ServingEngine(eng, time_fn=clock.time, **kw)
+
+
+def _make_factory(clock, inj=None, chaos_for=(), engine_kw=None):
+    def factory(name):
+        srv = _serving(clock, **(engine_kw or {}))
+        chaos = inj.replica_plan(name) \
+            if inj is not None and name in chaos_for else None
+        return InProcessReplica(name, srv, chaos=chaos, clock=clock)
+    return factory
+
+
+def _bimodal(n=14, seed=0):
+    cfg, _ = _inference_engine()
+    return bimodal_trace(np.random.RandomState(seed), n, rate=200.0,
+                         short_lens=(4, 6, 8), long_lens=(24,),
+                         long_frac=0.25, short_new=(6, 8), long_new=(6,),
+                         vocab_size=cfg.vocab_size)
+
+
+def _baseline_tokens(trace):
+    """Fault-free single-replica greedy run — the chaos oracle."""
+    clock = FakeClock(auto_dt=0.001)
+    srv = _serving(clock)
+    return {r.rid: r.tokens for r in srv.run(trace)}
+
+
+# ------------------------------------------------------------ circuit breaker
+def test_circuit_breaker_state_machine():
+    b = CircuitBreaker(failure_threshold=3, cooldown_s=1.0)
+    assert b.state == "closed" and b.dispatchable
+    assert not b.record_failure(0.0)
+    assert not b.record_failure(0.1)
+    assert b.record_failure(0.2)            # 3rd consecutive trips it
+    assert b.state == "open" and not b.dispatchable
+    assert not b.allow_probe(0.5)           # still cooling down
+    assert b.allow_probe(1.3)               # cooldown elapsed -> half-open
+    assert b.state == "half_open"
+    assert not b.allow_probe(1.3)           # one trial only
+    b.record_failure(1.3)                   # trial failed -> re-open
+    assert b.state == "open" and b.trips == 2
+    assert b.allow_probe(2.4)
+    b.record_success(2.4)                   # trial passed -> recovered
+    assert b.state == "closed" and b.recoveries == 1
+    b.record_failure(2.5)
+    b.record_success(2.6)                   # success resets the streak
+    assert not b.record_failure(2.7)
+    assert not b.record_failure(2.8)
+    assert b.state == "closed"
+
+
+# ---------------------------------------------------------------- supervisor
+def test_supervisor_budget_backoff_and_restartable_exits():
+    """Satellite: virtual-time chaos regression mirroring the
+    ElasticAgent tests for the serving side — restart budget, backoff
+    escalation, restartable vs fatal exits."""
+    sup = ReplicaSupervisor(max_restarts=2, restart_delay_s=0.5,
+                            backoff_factor=2.0, jitter=0.0)
+    # fatal crashes: backoff escalates 0.5, 1.0; third exceeds budget
+    assert sup.on_failure("r0", 10.0) == 10.5
+    assert sup.on_failure("r0", 11.0) == 12.0
+    assert sup.on_failure("r0", 13.0) is None
+    assert sup.is_abandoned("r0")
+    assert sup.on_failure("r0", 99.0) is None      # stays abandoned
+    # restartable exits never burn budget and reset the failure backoff
+    sup2 = ReplicaSupervisor(max_restarts=1, restart_delay_s=0.5,
+                             backoff_factor=2.0, jitter=0.0)
+    assert sup2.on_failure("r1", 0.0) == 0.5                  # crash #1
+    for k in range(10):
+        at = sup2.on_failure("r1", float(k), restartable=True)
+        assert at is not None
+    assert sup2.restarts("r1") == 1
+    assert sup2.preemption_restarts("r1") == 10
+    # the backoff reset: the next fatal crash is consecutive #1 again
+    assert sup2.on_failure("r1", 20.0) is None    # but budget (1) is spent
+    # budgets are PER replica
+    assert sup2.on_failure("r2", 20.0) == 20.5
+
+
+def test_supervisor_rolling_window_ages_out_restarts():
+    sup = ReplicaSupervisor(max_restarts=1, restart_window_s=10.0,
+                            restart_delay_s=0.5, backoff_factor=2.0,
+                            jitter=0.0)
+    assert sup.on_failure("r0", 0.0) == 0.5
+    # 11s later the first restart aged out of the window: budget is
+    # back, and the long healthy stretch reset the backoff to base
+    assert sup.on_failure("r0", 11.0) == 11.5
+    assert not sup.is_abandoned("r0")
+    # persistent-preemption cap: restartable exits are capped too
+    sup3 = ReplicaSupervisor(max_preemption_restarts=2, restart_delay_s=0.0)
+    assert sup3.on_failure("r1", 0.0, restartable=True) is not None
+    assert sup3.on_failure("r1", 1.0, restartable=True) is not None
+    assert sup3.on_failure("r1", 2.0, restartable=True) is None
+    assert sup3.is_abandoned("r1")
+
+
+# --------------------------------------------------------------- chaos pins
+def test_chaos_bimodal_crash_lossless_with_resurrection():
+    """THE acceptance pin: 3-replica fabric on the PR 7 bimodal trace,
+    scripted mid-trace crash, supervised resurrection — every request
+    completes, greedy tokens bit-identical to a fault-free
+    single-replica run, zero recompiles per replica, and the fabric
+    counters + failover-latency histogram reach telemetry JSONL and
+    the telemetry_report fabric section."""
+    import importlib.util
+    import os
+
+    trace = _bimodal(14)
+    oracle = _baseline_tokens(trace)
+
+    clock = FakeClock(auto_dt=0.001)
+    inj = FaultInjector()
+    inj.crash_replica_step("r1", 3)
+    factory = _make_factory(clock, inj, chaos_for=("r1",))
+    reg = MetricsRegistry()
+    router = FabricRouter([factory(n) for n in ("r0", "r1", "r2")],
+                          replica_factory=factory,
+                          supervisor=ReplicaSupervisor(
+                              max_restarts=3, restart_delay_s=0.05,
+                              jitter=0.0),
+                          time_fn=clock.time, telemetry=reg,
+                          heartbeat_interval_s=0.05)
+    results = router.run(trace)
+
+    assert len(results) == len(trace)
+    assert router.replica_crashes == 1
+    assert router.failovers >= 1          # the crash had in-flight work
+    assert router.replica_restarts == 1   # r1 came back
+    for r in results:
+        assert r.finish_reason in ("eos", "length"), \
+            (r.rid, r.finish_reason)
+        assert r.tokens == oracle[r.rid], \
+            f"rid {r.rid}: fabric {r.tokens} != fault-free {oracle[r.rid]}"
+    assert any(r.failovers > 0 for r in results)
+    # zero recompiles across every living replica (crash/failover/
+    # resume never changed a compiled program's operand signature)
+    assert router.recompile_count() == 0
+    for name, rep in router.replicas.items():
+        if rep.alive:
+            assert rep.recompile_count() == 0, name
+
+    # telemetry: counters + histogram flow through JSONL into the
+    # report's fabric section
+    snap = reg.snapshot()
+    assert snap["counters"]["fabric/replica_crashes"] == 1
+    assert snap["counters"]["fabric/failovers"] >= 1
+    assert snap["counters"]["fabric/retries"] >= 1
+    assert snap["counters"]["fabric/replica_restarts"] == 1
+    assert snap["histograms"]["fabric/failover_latency_ms"]["count"] >= 1
+    import tempfile
+    with tempfile.TemporaryDirectory() as td:
+        path = os.path.join(td, "fabric.jsonl")
+        reg.attach_sink(JsonlSink(path))
+        reg.flush(step=1)
+        reg.sink.close()
+        recs = read_jsonl(path)
+        [snap_rec] = [r for r in recs if r["kind"] == "snapshot"]
+        assert snap_rec["metrics"]["counters"]["fabric/failovers"] >= 1
+        spec = importlib.util.spec_from_file_location(
+            "telemetry_report", os.path.join(
+                os.path.dirname(__file__), "..", "..", "..", "scripts",
+                "telemetry_report.py"))
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        records, n_bad = mod.load_records(path)
+        agg = mod.aggregate(records, n_bad_lines=n_bad)
+        fab = agg["fabric"]
+        assert fab["failovers"] >= 1
+        assert fab["replica_crashes"] == 1
+        assert fab["failover_latency_ms"]["count"] >= 1
+        assert "fabric" in mod.render(agg)
+
+
+def test_chaos_bursty_crash_without_supervisor_survivors_absorb():
+    """No supervisor: the crashed replica stays dead and the survivors
+    absorb its load — still lossless on the bursty flash-crowd trace."""
+    cfg, _ = _inference_engine()
+    trace = bursty_poisson_trace(np.random.RandomState(1), 12,
+                                 burst_size=4, burst_rate=50.0,
+                                 prompt_lens=(4, 6, 8),
+                                 max_new_choices=(6, 8),
+                                 vocab_size=cfg.vocab_size,
+                                 priorities=(0, 1))
+    oracle = _baseline_tokens(trace)
+    clock = FakeClock(auto_dt=0.001)
+    inj = FaultInjector()
+    inj.crash_replica_step("r0", 2)
+    factory = _make_factory(clock, inj, chaos_for=("r0",))
+    router = FabricRouter([factory(n) for n in ("r0", "r1", "r2")],
+                          time_fn=clock.time, telemetry=False)
+    results = router.run(trace)
+    assert len(results) == len(trace)
+    assert router.replica_crashes == 1
+    assert router.replica_restarts == 0
+    for r in results:
+        assert r.finish_reason in ("eos", "length")
+        assert r.tokens == oracle[r.rid]
+        assert r.replica in ("r1", "r2")   # nothing FINISHES on the corpse
+    assert router.recompile_count() == 0
+
+
+def test_failover_streaming_never_duplicates_tokens():
+    """Idempotency: the client's stream across crash + failover is
+    exactly RequestResult.tokens — committed tokens ride in the resumed
+    request's PROMPT, so nothing is re-streamed."""
+    streamed = {}
+
+    def cb(rid):
+        streamed[rid] = []
+        return lambda t: streamed[rid].append(t)
+
+    trace = [Request(rid=i, prompt=[7 + i, 11, 13 + i, 17], max_new_tokens=8,
+                     arrival_time=0.0, on_token=cb(i)) for i in range(6)]
+    oracle = _baseline_tokens(
+        [Request(rid=r.rid, prompt=r.prompt,
+                 max_new_tokens=r.max_new_tokens,
+                 arrival_time=r.arrival_time) for r in trace])
+    clock = FakeClock(auto_dt=0.001)
+    inj = FaultInjector()
+    inj.crash_replica_step("r0", 2)
+    factory = _make_factory(clock, inj, chaos_for=("r0",))
+    router = FabricRouter([factory(n) for n in ("r0", "r1")],
+                          time_fn=clock.time, telemetry=False)
+    results = router.run(trace)
+    assert router.failovers >= 1
+    for r in results:
+        assert streamed[r.rid] == r.tokens == oracle[r.rid]
+
+
+def test_straggler_timeout_redispatches_losslessly():
+    """A slow replica (scripted virtual-time stalls — its steps SUCCEED,
+    so only per-attempt timeouts expose it) eats timeout strikes until
+    the breaker trips; its work is cancelled and finishes on the
+    healthy replica, bit-identically."""
+    trace = [Request(rid=0, prompt=[3, 5, 7], max_new_tokens=6,
+                     arrival_time=0.0),
+             Request(rid=1, prompt=[4, 6, 8], max_new_tokens=6,
+                     arrival_time=8.0)]   # arrives after the quarantine
+    oracle = _baseline_tokens(trace)
+    clock = FakeClock(auto_dt=0.001)
+    inj = FaultInjector()
+    inj.straggle_replica("r0", 2.0)     # every r0 step stalls 2 virtual s
+    factory = _make_factory(clock, inj, chaos_for=("r0",))
+    router = FabricRouter([factory(n) for n in ("r0", "r1")],
+                          time_fn=clock.time, telemetry=False,
+                          request_timeout_s=0.5,
+                          retry_base_delay_s=0.01,
+                          # keep the straggler quarantined once caught
+                          breaker_cooldown_s=1e6, failure_threshold=2)
+    results = router.run(trace)
+    assert router.timeouts >= 2         # two strikes tripped the breaker
+    assert router.breakers["r0"].state == "open"
+    assert len(results) == len(trace)
+    for r in results:
+        assert r.finish_reason in ("eos", "length")
+        assert r.tokens == oracle[r.rid]
+        assert r.replica == "r1"
+
+
+def test_flaky_steps_trip_breaker_and_recover():
+    """Transient step errors: below the threshold nothing happens; a
+    run of them quarantines the replica (in-flight work re-dispatched,
+    not duplicated), and after the cooldown a half-open probe recovers
+    it for new work."""
+    trace = [Request(rid=i, prompt=[2 + i, 9, 4], max_new_tokens=6,
+                     arrival_time=0.0 if i < 3 else 1.2 + 0.1 * i)
+             for i in range(6)]
+    oracle = _baseline_tokens(trace)
+    clock = FakeClock(auto_dt=0.001)
+    inj = FaultInjector()
+    inj.flaky_replica_step("r0", nth=1, count=3)   # 3 consecutive flakes
+    factory = _make_factory(clock, inj, chaos_for=("r0",))
+    router = FabricRouter([factory(n) for n in ("r0", "r1")],
+                          time_fn=clock.time, telemetry=False,
+                          failure_threshold=3, breaker_cooldown_s=0.3,
+                          heartbeat_interval_s=0.05,
+                          retry_base_delay_s=0.01)
+    results = router.run(trace)
+    assert len(results) == len(trace)
+    for r in results:
+        assert r.finish_reason in ("eos", "length")
+        assert r.tokens == oracle[r.rid]
+    assert router.quarantines >= 1
+    assert router.breakers["r0"].state == "closed"     # recovered
+    # the late arrivals could land on the recovered r0 again
+    assert router.recompile_count() == 0
+
+
+# -------------------------------------------------------- graceful degradation
+def test_bounded_queue_sheds_lowest_class_first():
+    clock = FakeClock(auto_dt=0.0)
+    factory = _make_factory(clock)
+    router = FabricRouter([factory("r0")], time_fn=clock.time,
+                          telemetry=False, max_queue=2,
+                          max_dispatch_depth=0)   # nothing dispatches
+    router.submit(Request(rid=0, prompt=[1], max_new_tokens=1, priority=2),
+                  now=0.0)
+    router.submit(Request(rid=1, prompt=[1], max_new_tokens=1, priority=1),
+                  now=0.0)
+    # queue full; an arriving class-0 sheds the WORST class (rid 0)
+    router.submit(Request(rid=2, prompt=[1], max_new_tokens=1, priority=0),
+                  now=0.0)
+    [shed] = router.step(0.0)
+    assert shed.rid == 0 and shed.finish_reason == "shed_overload"
+    # queue full of equal-or-better classes: typed backpressure
+    with pytest.raises(RouterOverloadedError):
+        router.submit(Request(rid=3, prompt=[1], max_new_tokens=1,
+                              priority=1), now=0.0)
+    assert router.shed_overload == 1
+
+
+def test_expired_deadline_shed_before_prefill():
+    clock = FakeClock(auto_dt=0.001)
+    factory = _make_factory(clock)
+    replica = factory("r0")
+    router = FabricRouter([replica], time_fn=clock.time, telemetry=False)
+    trace = [Request(rid=i, prompt=[5, 6, 7], max_new_tokens=4,
+                     arrival_time=0.5, deadline=0.1) for i in range(3)]
+    results = router.run(trace)
+    assert [r.finish_reason for r in results] == ["shed_deadline"] * 3
+    # shed BEFORE wasting prefill: the engine never saw them
+    assert replica.serving.prefill_calls == 0
+    assert router.shed_deadline == 3
+
+
+def test_engine_sheds_expired_deadline_at_admission():
+    """The shed-before-prefill guarantee must hold under EAGER dispatch
+    too: a request whose deadline expires while queued INSIDE a replica
+    (past the router's own queue check) is shed by the ENGINE when it
+    wins its slot, before any prefill compute — and the router accounts
+    it as a shed, not a completion."""
+    clock = FakeClock(auto_dt=0.001)
+    srv = _serving(clock, num_slots=1)
+    # engine-level: blocker occupies the only slot; the deadline-bearing
+    # request expires while waiting in the engine queue
+    srv.submit(Request(rid=0, prompt=[3, 4], max_new_tokens=30,
+                       arrival_time=0.0))
+    srv.submit(Request(rid=1, prompt=[5, 6], max_new_tokens=4,
+                       arrival_time=0.0, deadline=0.01))
+    out = []
+    t = 0.0
+    while srv.pending:
+        t += 0.05
+        out.extend(srv.step(t))
+    shed = [r for r in out if r.rid == 1]
+    assert [r.finish_reason for r in shed] == ["shed_deadline"]
+    assert shed[0].tokens == []          # no prefill, no tokens
+    assert srv.prefill_calls == 1        # only the blocker prefilled
+    # router-level accounting of an engine-side shed
+    clock2 = FakeClock(auto_dt=0.001)
+    factory = _make_factory(clock2, engine_kw={"num_slots": 1})
+    router = FabricRouter([factory("r0")], time_fn=clock2.time,
+                          telemetry=False)
+    results = router.run([
+        Request(rid=0, prompt=[3, 4], max_new_tokens=30, arrival_time=0.0),
+        Request(rid=1, prompt=[5, 6], max_new_tokens=4, arrival_time=0.0,
+                deadline=0.01)])
+    by_rid = {r.rid: r for r in results}
+    assert by_rid[1].finish_reason == "shed_deadline"
+    assert router.shed_deadline == 1 and router.completed == 1
+
+
+def test_router_run_is_reentrant():
+    """A second run() on the same router re-anchors the offset clock:
+    heartbeats fire immediately and breaker/retry state keeps working
+    (regression: stale _last_hb/opened_at offsets from run #1 stalled
+    run #2's health machinery)."""
+    clock = FakeClock(auto_dt=0.001)
+    factory = _make_factory(clock)
+    router = FabricRouter([factory(n) for n in ("r0", "r1")],
+                          time_fn=clock.time, telemetry=False,
+                          heartbeat_interval_s=0.05)
+    trace_a = [Request(rid=i, prompt=[2 + i, 3], max_new_tokens=4,
+                       arrival_time=0.0) for i in range(2)]
+    trace_b = [Request(rid=10 + i, prompt=[4 + i, 5], max_new_tokens=4,
+                       arrival_time=0.0) for i in range(2)]
+    res_a = router.run(trace_a)
+    t_before_b = clock.now
+    res_b = router.run(trace_b)
+    duration_b = clock.now - t_before_b
+    assert {r.rid for r in res_a} == {0, 1}
+    assert {r.rid for r in res_b} == {10, 11}
+    assert all(r.finish_reason in ("eos", "length")
+               for r in res_a + res_b)
+    # _last_hb is a RUN-B offset (small), not run #1's stale larger
+    # offset — i.e. the second run's heartbeats actually fired
+    assert 0.0 <= router._last_hb <= duration_b
+    assert router.completed == 4
+
+
+def test_swap_discard_does_not_count_swap_in():
+    buf = HostSwapBuffer()
+    k = np.zeros(4, np.float32)
+    buf.put(0, k, k)
+    assert buf.discard(0)
+    assert not buf.discard(0)
+    assert buf.total_swaps_in == 0 and buf.bytes_stored == 0
+    assert buf.total_swaps_out == 1
+
+
+def test_all_replicas_dead_fails_backlog():
+    clock = FakeClock(auto_dt=0.001)
+    inj = FaultInjector()
+    inj.crash_replica_step("r0", 1)
+    factory = _make_factory(clock, inj, chaos_for=("r0",))
+    router = FabricRouter([factory("r0")], time_fn=clock.time,
+                          telemetry=False)
+    trace = [Request(rid=i, prompt=[4, 5], max_new_tokens=4,
+                     arrival_time=0.0) for i in range(3)]
+    results = router.run(trace)
+    assert len(results) == 3
+    assert all(r.finish_reason == "failed" for r in results)
+    assert router.replica_crashes == 1
+
+
+# ------------------------------------------------------------ engine hooks
+def test_engine_cancel_frees_slot_and_queue():
+    clock = FakeClock(auto_dt=0.001)
+    srv = _serving(clock, num_slots=1)
+    cfg, _ = _inference_engine()
+    a = Request(rid=0, prompt=[3, 4, 5], max_new_tokens=8, arrival_time=0.0)
+    b = Request(rid=1, prompt=[6, 7, 8], max_new_tokens=4, arrival_time=0.0)
+    srv.submit(a)
+    srv.submit(b)
+    srv.step(0.0)                       # a admitted (1 slot), b queued
+    assert srv.pending == 2
+    assert srv.cancel(0)                # cancel the RUNNING request
+    assert srv.cancel(0) is False       # idempotent: already gone
+    done = []
+    while srv.pending:
+        done.extend(srv.step())
+    [rb] = done
+    assert rb.rid == 1                  # b ran in the freed slot
+    solo = _serving(clock)
+    solo.submit(Request(rid=9, prompt=[6, 7, 8], max_new_tokens=4))
+    out = []
+    while solo.pending:
+        out.extend(solo.step())
+    assert rb.tokens == out[0].tokens   # cancel never corrupted b
+    # cancelling a QUEUED request
+    srv2 = _serving(clock, num_slots=1)
+    srv2.submit(Request(rid=5, prompt=[1, 2], max_new_tokens=2))
+    assert srv2.cancel(5)
+    assert srv2.pending == 0
+
+
+# ---------------------------------------------------------------- satellites
+def test_submit_validation_typed_errors():
+    clock = FakeClock()
+    srv = _serving(clock)
+    with pytest.raises(EmptyPromptError):
+        srv.submit(Request(rid=0, prompt=[], max_new_tokens=4))
+    with pytest.raises(InvalidMaxNewTokensError):
+        srv.submit(Request(rid=1, prompt=[1, 2], max_new_tokens=0))
+    with pytest.raises(PromptTooLongError):
+        srv.submit(Request(rid=2, prompt=[1] * 65, max_new_tokens=4))
+    with pytest.raises(SlotCapacityError):
+        srv.submit(Request(rid=3, prompt=[1] * 60, max_new_tokens=30))
+    # every type is a ValueError: pre-typed call sites keep working
+    for exc in (EmptyPromptError, InvalidMaxNewTokensError,
+                PromptTooLongError, SlotCapacityError):
+        assert issubclass(exc, ValueError)
+    assert srv.pending == 0             # nothing slipped into the queue
+
+
+def test_swap_buffer_max_bytes_cap():
+    buf = HostSwapBuffer(max_bytes=100)
+    k = np.zeros(8, np.float32)          # 32 bytes
+    v = np.zeros(8, np.float32)
+    buf.put(0, k, v)                     # 64 bytes stored
+    assert buf.fits(32) and not buf.fits(64)
+    with pytest.raises(SwapCapacityError):
+        buf.put(1, k, v)                 # would be 128 > 100
+    assert buf.capacity_rejections == 1
+    assert buf.bytes_stored == 64 and len(buf) == 1   # nothing half-stored
+    buf.pop(0)
+    buf.put(1, k, v)                     # space freed -> fits again
+    with pytest.raises(ValueError):
+        HostSwapBuffer(max_bytes=0)
+
+
+def test_engine_swap_cap_degrades_predictably():
+    """Engine-level: with a tiny swap cap, the preemption that wants
+    the space is DECLINED (counter increments), and every request still
+    completes — capped pressure degrades into waiting, not corruption."""
+    clock = FakeClock(auto_dt=0.001)
+    srv = _serving(clock, num_slots=1, preemption="swap", swap_max_bytes=1)
+    low = Request(rid=0, prompt=[2, 3, 4], max_new_tokens=10,
+                  arrival_time=0.0, priority=2)
+    high = Request(rid=1, prompt=[5, 6, 7], max_new_tokens=4,
+                   arrival_time=0.0, priority=0)
+    srv.submit(low)
+    results = srv.step(0.0)              # low admitted into the only slot
+    srv.submit(high)
+    while srv.pending:
+        results.extend(srv.step())
+    assert srv.swap_capacity_rejections >= 1     # preemption was declined
+    assert srv.preemptions == 0
+    assert sorted(r.rid for r in results) == [0, 1]
+    # and with an ample cap the same scenario DOES preempt
+    srv2 = _serving(clock, num_slots=1, preemption="swap",
+                    swap_max_bytes=1 << 30)
+    srv2.submit(Request(rid=0, prompt=[2, 3, 4], max_new_tokens=10,
+                        arrival_time=0.0, priority=2))
+    out2 = srv2.step(0.0)
+    srv2.submit(Request(rid=1, prompt=[5, 6, 7], max_new_tokens=4,
+                        arrival_time=0.0, priority=0))
+    while srv2.pending:
+        out2.extend(srv2.step())
+    assert srv2.preemptions >= 1
+    assert srv2.swap_capacity_rejections == 0
+
+
+def test_fabric_report_section_unit():
+    """telemetry_report._fabric_summary over synthetic metrics (the
+    shape the router emits)."""
+    import importlib.util
+    import os
+
+    spec = importlib.util.spec_from_file_location(
+        "telemetry_report", os.path.join(
+            os.path.dirname(__file__), "..", "..", "..", "scripts",
+            "telemetry_report.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    metrics = {
+        "counters": {"fabric/failovers": 2, "fabric/retries": 3,
+                     "fabric/shed_requests": 1,
+                     "fabric/replica_crashes": 1,
+                     "serving/decode_steps": 99},
+        "gauges": {"fabric/replica_state/r0": 0.0,
+                   "fabric/healthy_replicas": 2.0},
+        "histograms": {"fabric/failover_latency_ms": {
+            "count": 2, "p50": 30.0, "p95": 60.0, "p99": 61.0}},
+    }
+    out = mod._fabric_summary(metrics)
+    assert out["failovers"] == 2 and out["retries"] == 3
+    assert out["replica_crashes"] == 1
+    assert out["healthy_replicas"] == 2.0
+    assert out["failover_latency_ms"]["p95"] == 60.0
+    assert "serving/decode_steps" not in json.dumps(out)
+    assert mod._fabric_summary({"counters": {}, "gauges": {},
+                                "histograms": {}}) == {}

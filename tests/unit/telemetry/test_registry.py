@@ -129,6 +129,62 @@ def test_read_jsonl_skips_torn_lines(tmp_path):
     assert [r["name"] for r in read_jsonl(str(path))] == ["a"]
 
 
+@pytest.mark.fault
+def test_read_jsonl_survives_truncated_write(tmp_path):
+    """ISSUE 9 satellite: a telemetry file torn by a crash mid-write
+    (here: a FaultInjector.truncate_write that cuts the file INSIDE a
+    record — and inside a multi-byte UTF-8 sequence) must still parse:
+    intact records returned, bad lines counted, never a raise. The
+    post-crash report runs on exactly this artifact."""
+    from deepspeed_tpu.testing import FaultInjector, SimulatedCrash
+    from deepspeed_tpu.utils import fs
+
+    path = str(tmp_path / "run.jsonl")
+    recs = [{"kind": "event", "name": "a"},
+            {"kind": "event", "name": "b", "note": "café"},
+            {"kind": "snapshot", "step": 7, "metrics": {}}]
+    payload = ("\n".join(json.dumps(r, ensure_ascii=False) for r in recs)
+               + "\n").encode()
+    # keep_bytes lands mid-way through record "b" — inside the 2-byte
+    # UTF-8 encoding of the é, the nastiest torn-write shape
+    cut = payload.index(b"caf\xc3\xa9") + 4
+    with FaultInjector() as inj:
+        inj.truncate_write(nth=1, keep_bytes=cut)
+        with pytest.raises(SimulatedCrash):
+            fs.write_bytes(path, payload)
+    good, bad = read_jsonl(path, return_bad=True)
+    assert [r["name"] for r in good] == ["a"]
+    assert bad == 1
+    # non-dict and binary-garbage lines are also counted, not raised
+    with open(path, "ab") as f:
+        f.write(b'\n[1, 2]\n\xff\xfe\x00garbage\n')
+    good, bad = read_jsonl(path, return_bad=True)
+    assert [r["name"] for r in good] == ["a"] and bad == 3
+
+
+def test_report_loader_matches_read_jsonl_tolerance(tmp_path):
+    """scripts/telemetry_report.py must tolerate the same crash damage
+    (its load_records is the report's front door)."""
+    import importlib.util
+    import os
+
+    spec = importlib.util.spec_from_file_location(
+        "telemetry_report", os.path.join(
+            os.path.dirname(__file__), "..", "..", "..", "scripts",
+            "telemetry_report.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    path = tmp_path / "torn.jsonl"
+    path.write_bytes(json.dumps({"kind": "event", "name": "a"}).encode()
+                     + b'\n{"kind": "ev\xc3')
+    records, n_bad = mod.load_records(str(path))
+    assert [r["name"] for r in records] == ["a"]
+    assert n_bad == 1
+    agg = mod.aggregate(records, n_bad_lines=n_bad)
+    assert agg["n_bad_lines"] == 1
+    assert "corrupt line(s) skipped" in mod.render(agg)
+
+
 def test_global_registry_and_record_event():
     reset_registry()
     record_event("checkpoint/saves", tag="global_step5")
