@@ -1154,6 +1154,153 @@ def _bench_observability_overhead(on_tpu: bool):
     }
 
 
+def _bench_training_resilience(on_tpu: bool):
+    """ISSUE-10 acceptance: (a) sentinel + finite-grad-guard overhead vs
+    bare training (interleaved best-of windows, 2% budget — the sentinel
+    queues device scalars per step and fetches them in one batch at the
+    check fence, so the hot path gains only list appends); (b) wall-clock
+    recovery latency through one injected loss spike — rewind to the last
+    auto-checkpoint, deterministic dataloader fast-forward past the
+    poisoned window — with the recovered run pinned bit-identical to a
+    clean run that skipped the same batches (CPU smoke of the chaos
+    acceptance)."""
+    import dataclasses
+    import tempfile
+    import time
+
+    import jax
+    import jax.numpy as jnp
+
+    import deepspeed_tpu
+    from deepspeed_tpu.models.gpt2 import GPT2Config, GPT2Model
+    from deepspeed_tpu.testing.fault_injection import PoisonedDataset
+    from deepspeed_tpu.utils import groups
+
+    if on_tpu:
+        cfg = GPT2Config.gpt2_125m()
+        batch, seq, steps, gas, windows = 8, 1024, 6, 2, 4
+    else:
+        cfg = GPT2Config(vocab_size=2048, max_seq_len=256, num_layers=2,
+                         hidden_size=128, num_heads=4)
+        batch, seq, steps, gas, windows = 8, 64, 3, 1, 2
+
+    rng = np.random.RandomState(0)
+
+    def make_batch():
+        ids = rng.randint(0, cfg.vocab_size,
+                          size=(gas, batch, seq + 1)).astype(np.int32)
+        return {"input_ids": ids[:, :, :-1], "labels": ids[:, :, 1:]}
+
+    def build_train(armed: bool):
+        groups.reset()
+        model = GPT2Model(cfg, attn_impl="flash" if on_tpu else "dense")
+        engine, *_ = deepspeed_tpu.initialize(model=model, config={
+            "train_batch_size": batch * gas,
+            "gradient_accumulation_steps": gas,
+            "bf16": {"enabled": on_tpu},
+            "optimizer": {"type": "AdamW", "params": {"lr": 1e-4}},
+            "steps_per_print": 0,
+            # check_interval 5: several sentinel drains per window, so the
+            # fence device_get cost is inside the measurement
+            "resilience": {"enabled": armed, "check_interval": 5,
+                           "min_history": 8, "spike_zscore": 50.0},
+        })
+        for _ in range(2):
+            loss = engine.train_batch_from_stacked(make_batch())
+        float(jax.device_get(loss))
+        return engine
+
+    # interleaved best-of windows (observability_overhead methodology):
+    # co-tenant drift hits both sides symmetrically
+    engines = {"bare": build_train(False), "armed": build_train(True)}
+    best = {"bare": float("inf"), "armed": float("inf")}
+    for _ in range(windows):
+        for name, engine in engines.items():
+            t0 = time.perf_counter()
+            for _ in range(steps):
+                loss = engine.train_batch_from_stacked(make_batch())
+            float(jax.device_get(loss))
+            best[name] = min(best[name], time.perf_counter() - t0)
+    bare_tps = batch * gas * seq * steps / best["bare"]
+    armed_tps = batch * gas * seq * steps / best["armed"]
+    overhead = (bare_tps - armed_tps) / bare_tps * 100.0
+    del engines
+
+    # ---- recovery latency through one injected spike (MLP regression so
+    # the poison has float features to corrupt; LM token ids are ints)
+    @dataclasses.dataclass
+    class _MLP:
+        hidden_dim: int = 16
+
+        def init(self, rng_key):
+            k1, k2 = jax.random.split(rng_key)
+            return {"w": jax.random.normal(
+                        k1, (self.hidden_dim, self.hidden_dim)) * 0.1,
+                    "head": jax.random.normal(k2, (self.hidden_dim, 1)) * 0.1}
+
+        def apply(self, params, b, *, rngs=None, train=False):
+            h = jnp.tanh(b["x"] @ params["w"].astype(b["x"].dtype))
+            pred = (h @ params["head"].astype(h.dtype))[..., 0]
+            loss = jnp.mean(jnp.square(pred.astype(jnp.float32) -
+                                       b["y"].astype(jnp.float32)))
+            return loss, {"loss": loss}
+
+    mlp_rng = np.random.RandomState(1)
+    data = [{"x": mlp_rng.randn(16).astype(np.float32),
+             "y": np.float32(mlp_rng.randn())} for _ in range(256)]
+    spike_idx = 80  # batch 10 (batch size 8) -> fed at step 10
+
+    def run(dataset, skips, resilience):
+        groups.reset()
+        config = {"train_batch_size": 8,
+                  "optimizer": {"type": "Adam", "params": {"lr": 1e-2}},
+                  "steps_per_print": 0}
+        if resilience:
+            config["resilience"] = resilience
+        engine, *_ = deepspeed_tpu.initialize(model=_MLP(), config=config)
+        engine.training_dataloader = engine.deepspeed_io(dataset,
+                                                         shuffle=False)
+        while engine.global_steps < 16:
+            n = skips.pop(engine.global_steps, 0)
+            it = engine._ensure_train_iter()
+            for _ in range(n):
+                next(it)
+            engine.train_batch()
+        return engine
+
+    ckpt_dir = tempfile.mkdtemp(prefix="dstpu_resilience_bench_")
+    chaos = run(PoisonedDataset(data, {spike_idx: "huge"}), {},
+                {"enabled": True, "checkpoint_dir": ckpt_dir,
+                 "checkpoint_interval": 4, "check_interval": 1,
+                 "min_history": 6, "spike_zscore": 50.0})
+    rewinds = list(chaos.rewind_log)
+    clean = run(data, {r["rewound_to"]: r["skipped_batches"]
+                       for r in rewinds}, None)
+    fa = [np.asarray(x) for x in jax.tree_util.tree_leaves(
+        jax.device_get(chaos.state.params))]
+    fb = [np.asarray(x) for x in jax.tree_util.tree_leaves(
+        jax.device_get(clean.state.params))]
+    lossless = bool(fa and all(np.array_equal(a, b)
+                               for a, b in zip(fa, fb)))
+    return {
+        "budget_pct": 2.0,
+        "sentinel_overhead": {
+            "bare_tokens_per_sec": round(bare_tps, 1),
+            "armed_tokens_per_sec": round(armed_tps, 1),
+            "overhead_pct": round(overhead, 2),
+            "within_budget": bool(max(overhead, 0.0) <= 2.0),
+        },
+        "recovery": {
+            "rewinds": len(rewinds),
+            "recovery_latency_ms": (rewinds[0]["recovery_ms"]
+                                    if rewinds else None),
+            "skipped_batches": sum(r["skipped_batches"] for r in rewinds),
+            "anomaly_class": rewinds[0]["class"] if rewinds else None,
+            "lossless_vs_clean_skip": lossless,
+        },
+    }
+
+
 def _bench_774m_isolated(on_tpu: bool):
     """774M needs a FRESH process on the shared chip: in-process after the
     serving engines it RESOURCE_EXHAUSTs (their allocations + fragmentation
@@ -1218,6 +1365,14 @@ def main():
         on_tpu = any(d.platform in ("tpu", "axon")
                      or "TPU" in str(d.device_kind) for d in jax.devices())
         print(json.dumps(_bench_fabric_serving(on_tpu), indent=2))
+        return
+
+    if "training_resilience" in sys.argv[1:]:
+        # standalone ISSUE-10 mode: sentinel/guard overhead vs bare
+        # training + recovery latency through one injected spike
+        on_tpu = any(d.platform in ("tpu", "axon")
+                     or "TPU" in str(d.device_kind) for d in jax.devices())
+        print(json.dumps(_bench_training_resilience(on_tpu), indent=2))
         return
 
     if "--774m" in sys.argv:
@@ -1333,6 +1488,10 @@ def main():
         observability = _bench_observability_overhead(on_tpu)
     except Exception as e:
         observability = {"error": f"{type(e).__name__}: {e}"}
+    try:
+        training_resilience = _bench_training_resilience(on_tpu)
+    except Exception as e:
+        training_resilience = {"error": f"{type(e).__name__}: {e}"}
     train_774m, attainable_774m = _bench_774m_isolated(on_tpu)
     attainable = None
     if on_tpu:
@@ -1386,6 +1545,10 @@ def main():
         # ISSUE-3 acceptance: instrumented vs bare train/decode steps (2%
         # budget) + telemetry-histogram p50/p95 vs direct measurement
         "observability_overhead": observability,
+        # ISSUE-10 acceptance: anomaly-sentinel overhead vs bare training
+        # (2% budget) + rewind-and-skip recovery latency through one
+        # injected spike, lossless vs a clean run skipping the same window
+        "training_resilience": training_resilience,
         # second headline config (the 125M line is a model-shape wall at
         # ~44% MFU — PROFILE_TRAIN.md; MFU-vs-attainable rises with size)
         "train_774m": dict(

@@ -35,6 +35,41 @@ from deepspeed_tpu.elasticity.preemption import PREEMPTION_EXIT_CODE
 from deepspeed_tpu.utils.logging import logger
 
 
+class RollingWindowBudget:
+    """Rolling-window event budget — :class:`ElasticAgent`'s restart-budget
+    semantics factored out for reuse (ISSUE 10: the training engine's
+    anomaly-rewind budget). Only events inside the trailing ``window_s``
+    count against ``max_events``; a job that rewound three times in week
+    one shouldn't be one anomaly from death in week four. ``window_s=None``
+    counts every event forever. ``time_fn`` is injectable for virtual-time
+    tests."""
+
+    def __init__(self, max_events: int, window_s: Optional[float] = None,
+                 time_fn: Callable[[], float] = time.monotonic):
+        self.max_events = max_events
+        self.window_s = window_s
+        self.time_fn = time_fn
+        self._times: List[float] = []
+
+    def spent(self, now: Optional[float] = None) -> int:
+        """Events still inside the rolling window (all of them when no
+        window is configured); prunes aged-out entries."""
+        now = self.time_fn() if now is None else now
+        if self.window_s is not None:
+            cutoff = now - self.window_s
+            self._times = [t for t in self._times if t > cutoff]
+        return len(self._times)
+
+    def record(self, now: Optional[float] = None) -> int:
+        """Record one event; returns the in-window count including it."""
+        now = self.time_fn() if now is None else now
+        self._times.append(now)
+        return self.spent(now)
+
+    def exceeded(self, now: Optional[float] = None) -> bool:
+        return self.spent(now) > self.max_events
+
+
 def backoff_delay(consecutive_failures: int, *, base_s: float,
                   factor: float, cap_s: float, jitter: float = 0.0,
                   rng=random) -> float:
@@ -75,16 +110,14 @@ class ElasticAgent:
         self.sleep_fn = sleep_fn
         self.restart_count = 0        # budget-burning restarts, ever
         self.preemption_restarts = 0  # free restarts (restartable exit codes)
-        self._restart_times: List[float] = []
+        self._budget = RollingWindowBudget(max_restarts, restart_window_s,
+                                           time_fn=time_fn)
         self._last_failure_t: Optional[float] = None
 
     def _budget_spent(self, now: float) -> int:
         """Restarts still inside the rolling window (all of them when no
         window is configured)."""
-        if self.restart_window_s is not None:
-            cutoff = now - self.restart_window_s
-            self._restart_times = [t for t in self._restart_times if t > cutoff]
-        return len(self._restart_times)
+        return self._budget.spent(now)
 
     def _backoff_delay(self, consecutive_failures: int) -> float:
         return backoff_delay(consecutive_failures,
@@ -140,8 +173,7 @@ class ElasticAgent:
             from deepspeed_tpu.telemetry import record_event
 
             record_event("elastic/restarts", exit_code=rc)
-            self._restart_times.append(now)
-            spent = self._budget_spent(now)
+            spent = self._budget.record(now)
             if spent > self.max_restarts:
                 window = (f"in the last {self.restart_window_s}s"
                           if self.restart_window_s is not None else "total")

@@ -78,6 +78,17 @@ def save_engine_checkpoint(engine, save_dir: str, tag: Optional[str] = None,
         hsd = host_opt.state_dict()
         state_dict["host_opt"] = hsd["state"]
         state_dict["__meta__"]["host_opt_step"] = hsd["step"]
+    # deterministic data-pipeline resume (ISSUE 10): the (seed, epoch,
+    # in-epoch offset) triple rides in __meta__ so a rewound or restarted
+    # run replays exactly the batch stream an uninterrupted run would see.
+    # Not persisted for external data_samplers — their order may not
+    # replay across a restart, and a position we can't honor is worse
+    # than none.
+    dataloader = getattr(engine, "training_dataloader", None)
+    if dataloader is not None and hasattr(dataloader, "state_dict") and \
+            getattr(dataloader, "supports_deterministic_resume",
+                    lambda: True)():
+        state_dict["__meta__"]["dataloader"] = dataloader.state_dict()
 
     cs = {
         "global_steps": engine.global_steps,
@@ -336,6 +347,29 @@ def load_engine_checkpoint(engine, load_dir: str, tag: Optional[str] = None,
     # keep host-side counters in sync even if client_state.json is missing,
     # so LR schedule / dropout folding resume from the right step
     engine.global_steps = gstep
+    # restore the data-pipeline position (ISSUE 10): the loader resumes at
+    # the exact batch after the checkpointed step; the engine's live
+    # iterator (if any) is invalidated so the next pull honors it. Only
+    # when the saved state describes THIS pipeline (identity fields
+    # match) — warm-starting a checkpoint's weights onto a different
+    # dataset must start that dataset from the top, not mid-stream.
+    dataloader = getattr(engine, "training_dataloader", None)
+    dl_state = meta.get("dataloader")
+    if dataloader is not None and dl_state and \
+            hasattr(dataloader, "load_state_dict"):
+        matches = getattr(dataloader, "resume_state_matches",
+                          lambda s: True)(dl_state)
+        resumable = getattr(dataloader, "supports_deterministic_resume",
+                            lambda: True)()
+        if matches and resumable:
+            dataloader.load_state_dict(dl_state)
+            engine._train_iter = None
+        else:
+            logger.warning(
+                "checkpoint dataloader state not restored (%s); the data "
+                "pipeline starts from its current position",
+                "external data_sampler" if not resumable
+                else "identity mismatch — different dataset/batching")
 
     client_state = {}
     if cs is not None:

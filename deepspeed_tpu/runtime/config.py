@@ -129,6 +129,51 @@ class HybridEngineConfig(DeepSpeedConfigModel):
     tp_gather_partition_size: int = 8
 
 
+class ResilienceConfig(DeepSpeedConfigModel):
+    """Training anomaly sentinel + auto-recovery (ISSUE 10).
+
+    ``enabled`` turns on the sentinel (rolling robust z-score monitor over
+    loss/grad-norm, read at the telemetry fences) and — when
+    ``checkpoint_dir`` is set and the engine owns its dataloader — the
+    PaLM-style rewind-and-skip recovery protocol. ``check_finite_grads``
+    is independently usable: it adds a device-side skip-and-count guard on
+    nonfinite grads to the bf16/fp32 step, mirroring the fp16
+    dynamic-loss-scale overflow semantics (default: follows ``enabled``).
+    """
+
+    enabled: bool = False
+    # None → follows `enabled`; True/False forces the guard on/off
+    check_finite_grads: Optional[bool] = None
+    # auto-recovery: where the engine saves/rewinds checkpoints; interval
+    # in global steps (0 = caller manages saves; rewind still works off
+    # whatever tags exist under checkpoint_dir)
+    checkpoint_dir: Optional[str] = None
+    checkpoint_interval: int = 0
+    # sentinel read cadence in steps; 0 = ride the telemetry fence
+    # (telemetry.sync_interval) when telemetry is on, else every step
+    check_interval: int = 0
+    # rolling robust z-score monitor
+    window: int = 64
+    min_history: int = 8
+    spike_zscore: float = 8.0
+    divergence_patience: int = 4
+    # PaLM-style skip: batches between the rewind target and the anomaly
+    # are skipped, plus an extra width (in steps) that escalates
+    # base*factor^(k-1) across back-to-back rewinds, capped at max
+    skip_width_base: int = 1
+    skip_width_factor: int = 2
+    skip_width_max: int = 64
+    # rewind budget: ElasticAgent rolling-window semantics — only rewinds
+    # inside the trailing window count; None window counts forever
+    max_rewinds: int = 8
+    rewind_window_s: Optional[float] = None
+    # SDC audits, in global steps (0 = off)
+    sdc_audit_interval: int = 0
+    step_replay_interval: int = 0
+    # "recover" (rewind+skip when possible, else raise) | "raise"
+    on_anomaly: str = "recover"
+
+
 class CheckpointConfig(DeepSpeedConfigModel):
     tag_validation: str = "Warn"
     load_universal: bool = False
@@ -270,6 +315,7 @@ class DeepSpeedConfig:
         self.flops_profiler_config: DeepSpeedFlopsProfilerConfig = get_flops_profiler_config(d)
         self.comms_logger_config = CommsLoggerConfig(**d.get("comms_logger", {}))
         self.checkpoint_config = CheckpointConfig(**d.get(C.CHECKPOINT, {}))
+        self.resilience_config = ResilienceConfig(**d.get(C.RESILIENCE, {}))
         self.aio_config = AIOConfig(**d.get("aio", {}))
         self.hybrid_engine = HybridEngineConfig(**d.get("hybrid_engine", {}))
         self.pld_config = PLDConfig(**d.get("progressive_layer_drop", {}))

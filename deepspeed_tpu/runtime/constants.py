@@ -79,6 +79,9 @@ STEPS_PER_PRINT_DEFAULT = 10
 # legacy behaviour (monitor writes ride steps_per_print)
 MONITOR_INTERVAL = "monitor_interval"
 MONITOR_INTERVAL_DEFAULT = 0
+# training resilience section (ISSUE 10): anomaly sentinel + rewind-and-skip
+# auto-recovery + SDC audits
+RESILIENCE = "resilience"
 WALL_CLOCK_BREAKDOWN = "wall_clock_breakdown"
 WALL_CLOCK_BREAKDOWN_DEFAULT = False
 DUMP_STATE = "dump_state"

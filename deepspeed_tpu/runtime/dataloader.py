@@ -47,6 +47,23 @@ class DeepSpeedDataLoader:
       local replica count — i.e. the per-process slice of the global batch.
     - multi-host: each process reads its own shard (rank-strided, like the
       reference's DistributedSampler).
+
+    Deterministic resume (ISSUE 10): the batch stream is a pure function of
+    ``(seed, epoch, in-epoch offset)``. ``state_dict()/load_state_dict()``
+    capture/restore that triple, and the engine persists it inside every
+    checkpoint's ``__meta__`` — so a crash-restart or an anomaly
+    rewind-and-skip replays *exactly* the batch stream an uninterrupted run
+    would have seen. Each epoch reshuffles with ``seed + epoch`` and the
+    loader auto-advances ``epoch`` on exhaustion, so wrap-around (via
+    :class:`RepeatingLoader`) stays deterministic too.
+
+    NOTE the contract change this implies: the loader is a
+    position-tracking STREAM, not a restartable sequence. Every batch
+    pulled — including via an abandoned partial iteration — advances the
+    position that ``state_dict()`` reports and the next ``__iter__``
+    resumes from; don't iterate the same instance from two places. To
+    re-read from a known point, call ``set_epoch(e)`` (top of epoch
+    ``e``) or ``load_state_dict``.
     """
 
     def __init__(self, dataset, batch_size: int, *, collate_fn: Optional[Callable] = None,
@@ -65,9 +82,51 @@ class DeepSpeedDataLoader:
         self.rank = rank if rank is not None else jax.process_index()
         self.epoch = 0
         self.data_sampler = data_sampler
+        self._offset = 0  # batches already yielded in the current epoch
 
     def set_epoch(self, epoch: int):
         self.epoch = epoch
+        self._offset = 0
+
+    def supports_deterministic_resume(self) -> bool:
+        """The (seed, epoch, offset) triple pins the stream only when this
+        loader generates its own index order; an external ``data_sampler``
+        is re-pulled every epoch and may not replay (stateful/stochastic
+        samplers), so its position cannot be promised across a restart."""
+        return self.data_sampler is None
+
+    def state_dict(self) -> dict:
+        """Resume state: JSON-serializable, a few ints — cheap enough to
+        ride in every checkpoint's ``__meta__``. The identity fields
+        (batch_size/num_samples/replica/shuffle) are not restored; the
+        checkpoint loader compares them against the live loader so a
+        warm-start onto a DIFFERENT dataset never inherits a stale
+        mid-stream position."""
+        return {"seed": int(self.seed), "epoch": int(self.epoch),
+                "offset": int(self._offset),
+                "batch_size": int(self.batch_size),
+                "num_samples": int(len(self.dataset)),
+                "num_replicas": int(self.num_replicas),
+                "rank": int(self.rank),
+                "shuffle": bool(self.shuffle)}
+
+    def load_state_dict(self, state: dict):
+        """Pin the stream position; takes effect at the next ``__iter__``
+        (generators are lazy, so a ``RepeatingLoader`` built before this
+        call still honors it as long as nothing was pulled yet — the
+        engine rebuilds its iterator after a checkpoint load regardless)."""
+        self.seed = int(state["seed"])
+        self.epoch = int(state["epoch"])
+        self._offset = int(state["offset"])
+
+    def resume_state_matches(self, state: dict) -> bool:
+        """Does ``state`` describe THIS data pipeline? Identity fields
+        saved alongside the position must agree (fields absent from older
+        checkpoints are not compared)."""
+        current = self.state_dict()
+        return all(state[k] == current[k]
+                   for k in ("batch_size", "num_samples", "num_replicas",
+                             "rank", "shuffle") if k in state)
 
     def __len__(self):
         n = len(self.dataset) // self.num_replicas
@@ -86,13 +145,19 @@ class DeepSpeedDataLoader:
                 rng.shuffle(indices)
         indices = indices[self.rank::self.num_replicas]
         batch = []
-        for idx in indices:
+        for idx in indices[self._offset * self.batch_size:]:
             batch.append(self.dataset[int(idx)])
             if len(batch) == self.batch_size:
+                self._offset += 1
                 yield self.collate_fn(batch)
                 batch = []
         if batch and not self.drop_last:
+            self._offset += 1
             yield self.collate_fn(batch)
+        # epoch exhausted: advance so the next pass (RepeatingLoader
+        # restart) reshuffles deterministically with seed + epoch
+        self.epoch += 1
+        self._offset = 0
 
 
 def default_collate(samples):

@@ -314,6 +314,39 @@ class DeepSpeedEngine:
                     self.telemetry.attach_sink(self._owned_sink)
                 except Exception as e:
                     logger.warning(f"telemetry jsonl sink disabled: {e}")
+        # ---- training resilience (ISSUE 10): anomaly sentinel + finite-grad
+        # guard + rewind-and-skip auto-recovery + SDC audits. The sentinel
+        # consumes per-step device scalars lazily: they queue as jax arrays
+        # and are fetched in ONE batch at the check fence, so detection adds
+        # no per-step syncs.
+        rcfg = config.resilience_config
+        self.resilience_config = rcfg
+        self._check_finite_grads = (rcfg.check_finite_grads
+                                    if rcfg.check_finite_grads is not None
+                                    else rcfg.enabled)
+        self.sentinel = None
+        self._pending_anomaly_reads: list = []
+        self._rewind_budget = None
+        self._rewinds_since_clean = 0
+        self._resilience_baseline_saved = False
+        self._sdc_quarantine_cb: Optional[Callable] = None
+        self.sdc_suspect_devices: Tuple[int, ...] = ()
+        self.rewind_log: list = []
+        if rcfg.enabled:
+            from deepspeed_tpu.elasticity.elastic_agent import (
+                RollingWindowBudget)
+            from deepspeed_tpu.runtime.sentinel import TrainingSentinel
+
+            self.sentinel = TrainingSentinel(
+                window=rcfg.window, min_history=rcfg.min_history,
+                spike_zscore=rcfg.spike_zscore,
+                divergence_patience=rcfg.divergence_patience,
+                fp16=self.fp16_enabled)
+            self._rewind_budget = RollingWindowBudget(
+                rcfg.max_rewinds, rcfg.rewind_window_s)
+        self._sentinel_interval = rcfg.check_interval or (
+            tcfg.sync_interval if (self.telemetry is not None
+                                   and tcfg.sync_interval) else 1)
         import deepspeed_tpu.comm as dist
 
         dist.configure(comms_config=None, enabled=config.comms_logger_config.enabled,
@@ -472,7 +505,12 @@ class DeepSpeedEngine:
         (_take_model_step analog, engine.py:1886)."""
         inv = 1.0 / state.scaler.cur_scale
         grads = jax.tree_util.tree_map(lambda g: g * inv, grads)
-        if self.fp16_enabled:
+        if self.fp16_enabled or self._check_finite_grads:
+            # fp16: dynamic-loss-scale overflow. bf16/fp32 with the
+            # finite-grad guard (ISSUE 10 satellite): a nonfinite grad —
+            # poisoned batch, numeric blow-up — must not step into the
+            # params; same skip-and-count semantics as the fp16 path
+            # (global_step below advances only on applied updates).
             overflow = has_inf_or_nan(grads)
         else:
             overflow = jnp.zeros((), bool)
@@ -531,7 +569,8 @@ class DeepSpeedEngine:
         inv = 1.0 / (self.gas * scaler.cur_scale)
         grads = jax.tree_util.tree_map(
             lambda g: g.astype(jnp.float32) * inv, grads)
-        overflow = has_inf_or_nan(grads) if self.fp16_enabled \
+        overflow = has_inf_or_nan(grads) \
+            if (self.fp16_enabled or self._check_finite_grads) \
             else jnp.zeros((), bool)
         return grads, overflow, global_grad_norm(grads)
 
@@ -717,23 +756,41 @@ class DeepSpeedEngine:
             lambda x: NamedSharding(self.mesh, self.plan.batch_spec(x.ndim)), batch)
 
     # --------------------------------------------------------------- user API
+    def _ensure_train_iter(self):
+        """Engine-owned repeating iterator over ``training_dataloader``
+        (rebuilt after a checkpoint load / anomaly rewind invalidates it)."""
+        assert self.training_dataloader is not None, \
+            "train_batch needs a data_iter or training_data at init"
+        if not hasattr(self, "_train_iter") or self._train_iter is None:
+            from deepspeed_tpu.runtime.dataloader import RepeatingLoader
+
+            self._train_iter = iter(RepeatingLoader(self.training_dataloader))
+        return self._train_iter
+
     def train_batch(self, data_iter: Optional[Iterator] = None):
         """Pull ``gas`` microbatches, run ONE fused compiled step.
         Microbatch leaves are stacked on a leading [gas] dim."""
+        # anomaly rewind can only fast-forward a stream the ENGINE owns;
+        # track which source fed the step so recovery never rewinds the
+        # engine loader while a caller-supplied iterator keeps advancing
+        self._engine_owned_stream = data_iter is None
         if data_iter is None:
-            assert self.training_dataloader is not None, \
-                "train_batch needs a data_iter or training_data at init"
-            if not hasattr(self, "_train_iter") or self._train_iter is None:
-                from deepspeed_tpu.runtime.dataloader import RepeatingLoader
-
-                self._train_iter = iter(RepeatingLoader(self.training_dataloader))
-            data_iter = self._train_iter
+            # baseline checkpoint BEFORE the first pull: the rewind target
+            # of an anomaly in the first interval must pair step-0 params
+            # with dataloader offset 0, or the resumed stream desyncs
+            if (self.sentinel is not None
+                    and self.resilience_config.checkpoint_dir
+                    and not self._resilience_baseline_saved):
+                self._resilience_baseline_saved = True
+                self.save_checkpoint(self.resilience_config.checkpoint_dir)
+            data_iter = self._ensure_train_iter()
         micro_batches = [next(data_iter) for _ in range(self.gas)]
         batch = jax.tree_util.tree_map(lambda *xs: np.stack(xs), *micro_batches)
         return self._run_fused_step(batch)
 
     def train_batch_from_stacked(self, batch):
         """As train_batch, but the caller supplies the [gas, ...] stacked batch."""
+        self._engine_owned_stream = False  # caller owns the data stream
         return self._run_fused_step(batch)
 
     def _run_fused_step(self, batch):
@@ -790,6 +847,8 @@ class DeepSpeedEngine:
             self._record_step_telemetry(
                 metrics, batch, time.perf_counter() - t_start,
                 ltd_keep=ltd_keep)
+        if self.sentinel is not None:
+            self._resilience_step(metrics, batch)
         if self._sync_each_step:
             jax.block_until_ready(self.state.params)
         return metrics["loss"]
@@ -829,6 +888,8 @@ class DeepSpeedEngine:
             # grads, so wall time here IS device time
             self._record_step_telemetry(
                 metrics, batch, time.perf_counter() - t_start)
+        if self.sentinel is not None:
+            self._resilience_step(metrics, batch)
         if self._sync_each_step:
             jax.block_until_ready(self.state.params)
         return metrics["loss"]
@@ -996,6 +1057,11 @@ class DeepSpeedEngine:
                 device_gs = int(jax.device_get(self.state.global_step))
                 reg.gauge("train/fp16_skipped_steps").set(
                     max(self.global_steps - device_gs, 0))
+            elif self._check_finite_grads:
+                # same accounting for the bf16/fp32 finite-grad guard
+                device_gs = int(jax.device_get(self.state.global_step))
+                reg.gauge("train/nonfinite_skipped_steps").set(
+                    max(self.global_steps - device_gs, 0))
         except Exception:
             pass
         stats = self.accelerator.memory_stats()
@@ -1056,6 +1122,256 @@ class DeepSpeedEngine:
                 flops = 6.0 * n_params * tokens
         self._telemetry_flops = flops
         return flops or None
+
+    # ------------------------------------------------- resilience (ISSUE 10)
+    def _resilience_step(self, metrics, batch):
+        """Per-step sentinel bookkeeping. The scalars queue as device
+        arrays; classification happens at the check fence (one batched
+        device_get — free right after a telemetry fence, which shares the
+        cadence by default). Auto-checkpoints are screened: the sentinel
+        drains BEFORE a save so a detected-late anomaly can never be
+        published as a rewind target."""
+        rcfg = self.resilience_config
+        self._pending_anomaly_reads.append(
+            (self.global_steps, metrics.get("loss"),
+             metrics.get("grad_norm"), metrics.get("overflow")))
+        save_due = (rcfg.checkpoint_dir is not None and rcfg.checkpoint_interval
+                    and self.global_steps % rcfg.checkpoint_interval == 0)
+        # an SDC-armed run audits BEFORE every save too: a bit flipped
+        # between audits must never be published into a rewind target,
+        # where the recovery reload would re-replicate it to every device
+        # and the corruption would pass all future audits
+        audit_due = bool(rcfg.sdc_audit_interval) and (
+            save_due or self.global_steps % rcfg.sdc_audit_interval == 0)
+        replay_due = (rcfg.step_replay_interval
+                      and self.global_steps % rcfg.step_replay_interval == 0)
+        if not (save_due or audit_due or replay_due
+                or self.global_steps % self._sentinel_interval == 0):
+            return
+        anomaly = self._sentinel_drain()
+        if anomaly is None and audit_due:
+            anomaly = self._sdc_audit_check()
+        if anomaly is None and replay_due:
+            anomaly = self._sdc_step_replay_check(batch)
+        if anomaly is not None:
+            self._recover_or_raise(anomaly)
+            return
+        # de-escalate the skip width only once training has cleanly passed
+        # the last anomaly's region — a clean check while still replaying
+        # toward it must not shrink the next escalation
+        if self.global_steps > getattr(self, "_last_anomaly_step", -1):
+            self._rewinds_since_clean = 0
+        if save_due:
+            self.save_checkpoint(rcfg.checkpoint_dir)
+
+    def _sentinel_drain(self):
+        """Classify every queued step; returns the first *actionable*
+        anomaly (overflows are counted but the loss scaler already handled
+        them). Entries after an actionable anomaly are dropped — they ran
+        on suspect params and the rewind re-executes them anyway."""
+        from deepspeed_tpu.runtime.sentinel import AnomalyClass
+
+        if not self._pending_anomaly_reads:
+            return None
+        pending, self._pending_anomaly_reads = \
+            self._pending_anomaly_reads, []
+        vals = jax.device_get([(l, n, o) for _, l, n, o in pending])
+        reg = self.telemetry
+        for (step, *_), (loss, norm, ovf) in zip(pending, vals):
+            a = self.sentinel.observe(
+                step,
+                float(loss) if loss is not None else 0.0,
+                float(norm) if norm is not None else 0.0,
+                bool(ovf) if ovf is not None else False)
+            if a is None:
+                continue
+            if reg is not None:
+                reg.counter(f"resilience/anomalies_{a.cls}").inc()
+            if a.cls != AnomalyClass.OVERFLOW:
+                return a
+        return None
+
+    def _sdc_audit_check(self):
+        """Cross-data-parallel-replica checksum agreement over params +
+        optimizer state (replicas are bit-identical by construction; see
+        sentinel.sdc_audit). A mismatch quarantines the suspect device —
+        counted, evented, and surfaced to the elastic agent via
+        ``set_sdc_quarantine_callback`` — and returns an SDC anomaly so
+        recovery rewinds (the reload re-replicates clean bytes)."""
+        from deepspeed_tpu import telemetry as _tele
+        from deepspeed_tpu.runtime.sentinel import (
+            AnomalyClass, TrainingAnomaly, sdc_audit)
+
+        res = sdc_audit({"params": self.state.params,
+                         "opt_state": self.state.opt_state})
+        reg = self.telemetry
+        if reg is not None:
+            reg.counter("resilience/sdc_audits").inc()
+        if res.ok:
+            self.sdc_suspect_devices = ()  # healed / transient: un-flag
+            return None
+        self.sdc_suspect_devices = res.suspects
+        if reg is not None:
+            reg.counter("resilience/sdc_mismatches").inc()
+        _tele.record_event("resilience/sdc_quarantine",
+                           step=self.global_steps,
+                           suspect_devices=list(res.suspects),
+                           mismatched_groups=res.mismatched_groups)
+        logger.error(
+            "SDC audit: %d/%d replica groups disagree; suspect device(s) "
+            "%s quarantined", res.mismatched_groups, res.n_groups,
+            list(res.suspects))
+        if self._sdc_quarantine_cb is not None:
+            try:
+                self._sdc_quarantine_cb(res)
+            except Exception as e:
+                logger.warning("sdc quarantine callback failed: %s", e)
+        detail = (f"{res.mismatched_groups}/{res.n_groups} replica groups "
+                  f"disagree; suspects {list(res.suspects)}")
+        return TrainingAnomaly(AnomalyClass.SDC, self.global_steps,
+                               float(res.mismatched_groups), 0.0, detail)
+
+    def set_sdc_quarantine_callback(self, cb):
+        """Hook for the elastic agent / launcher: called with the
+        :class:`~deepspeed_tpu.runtime.sentinel.SDCAuditResult` when an
+        audit finds a deviating replica, so the supervisor can exclude the
+        host from the next worker group."""
+        self._sdc_quarantine_cb = cb
+
+    def _sdc_step_replay_check(self, batch):
+        """Single-host determinism probe: the compiled step run twice from
+        bit-identical state copies must agree bit-exactly; a mismatch is
+        flaky hardware (counted + evented, recovered like SDC)."""
+        from deepspeed_tpu import telemetry as _tele
+        from deepspeed_tpu.runtime.sentinel import (
+            AnomalyClass, TrainingAnomaly, step_replay_probe)
+
+        if (self._compiled_train_step is None or self._host_opt is not None
+                or not getattr(self, "_step_takes_extra_args", False)
+                or self._use_pld or self._use_random_ltd):
+            return None
+        lr = jnp.asarray(self.get_lr()[0], jnp.float32)
+        rng = jax.random.fold_in(self._dropout_rng, self.global_steps)
+        ok, detail = step_replay_probe(
+            self._compiled_train_step, self.state, self.state_shardings,
+            args=(batch, lr, rng, None, None))
+        reg = self.telemetry
+        if reg is not None:
+            reg.counter("resilience/step_replays").inc()
+        if ok:
+            return None
+        if reg is not None:
+            reg.counter("resilience/step_replay_mismatches").inc()
+        _tele.record_event("resilience/step_replay_mismatch",
+                           step=self.global_steps, detail=detail)
+        logger.error("step-replay probe: %s", detail)
+        return TrainingAnomaly(AnomalyClass.REPLAY, self.global_steps,
+                               0.0, 0.0, detail)
+
+    def _recover_or_raise(self, anomaly):
+        """PaLM-style rewind-and-skip: reload the newest *valid* checkpoint
+        (PR 1's walk-back survives a tag corrupted mid-recovery), restore
+        the dataloader position from its ``__meta__``, then fast-forward
+        past the offending batch window — the batches between the rewind
+        target and the anomaly, plus an extra width that escalates across
+        back-to-back rewinds. SDC/replay anomalies skip nothing (the data
+        was fine): they rewind and deterministically replay. Bounded by
+        the rolling rewind budget so a poisoned shard cannot livelock."""
+        from deepspeed_tpu import telemetry as _tele
+        from deepspeed_tpu.runtime.sentinel import (
+            AnomalyClass, RewindBudgetExceededError, TrainingAnomalyError)
+
+        rcfg = self.resilience_config
+        _tele.record_event("resilience/anomaly", cls=anomaly.cls,
+                           step=anomaly.step, value=anomaly.value,
+                           zscore=round(anomaly.zscore, 2),
+                           detail=anomaly.detail)
+        logger.warning("training anomaly: %s at step %d (%s)",
+                       anomaly.cls, anomaly.step, anomaly.detail)
+        dl = self.training_dataloader
+        recoverable = (rcfg.on_anomaly == "recover"
+                       and rcfg.checkpoint_dir is not None
+                       # the engine-owned loader must be the LIVE source:
+                       # rewinding it while a caller-supplied iterator
+                       # keeps advancing would silently desync data from
+                       # params — raise instead
+                       and getattr(self, "_engine_owned_stream", False)
+                       and dl is not None
+                       and hasattr(dl, "load_state_dict")
+                       and getattr(dl, "supports_deterministic_resume",
+                                   lambda: True)())
+        if not recoverable:
+            raise TrainingAnomalyError(anomaly)
+        t0 = time.perf_counter()
+        spent = self._rewind_budget.record()
+        if spent > rcfg.max_rewinds:
+            _tele.record_event("resilience/rewind_budget_exhausted",
+                               spent=spent, budget=rcfg.max_rewinds)
+            raise RewindBudgetExceededError(
+                anomaly, f"rewind budget exhausted: {spent} rewinds "
+                         f"(budget {rcfg.max_rewinds}"
+                         + (f" in {rcfg.rewind_window_s}s"
+                            if rcfg.rewind_window_s else "")
+                         + f"); last anomaly: {anomaly.cls} at step "
+                           f"{anomaly.step}")
+        # rewind: auto-resume walk-back to the newest valid tag; raises the
+        # typed CheckpointCorruptionError loudly if every tag is invalid
+        it_before = getattr(self, "_train_iter", None)
+        path, _ = self.load_checkpoint(rcfg.checkpoint_dir)
+        if path is None:
+            raise TrainingAnomalyError(
+                anomaly, f"anomaly at step {anomaly.step} but no checkpoint "
+                         f"under {rcfg.checkpoint_dir} to rewind to")
+        if it_before is not None and \
+                getattr(self, "_train_iter", None) is it_before:
+            # the loaded tag carried no restorable dataloader state (saved
+            # pre-ISSUE-10, or before the loader was attached): params are
+            # rewound but the data stream is NOT — fast-forwarding the
+            # stale iterator would silently desync data from params
+            raise TrainingAnomalyError(
+                anomaly, f"rewound params to {path}, but that checkpoint "
+                         f"has no dataloader state — cannot rewind the "
+                         f"data stream deterministically; re-save "
+                         f"checkpoints with this engine to enable "
+                         f"auto-recovery")
+        rewound_to = self.global_steps
+        self._rewinds_since_clean += 1
+        self._last_anomaly_step = anomaly.step
+        if anomaly.cls in AnomalyClass.DATA_CLASSES:
+            extra = min(rcfg.skip_width_base * rcfg.skip_width_factor
+                        ** (self._rewinds_since_clean - 1),
+                        rcfg.skip_width_max)
+            skip_steps = max(anomaly.step - rewound_to, 0) + extra
+        else:  # sdc/replay: the data was fine — replay it
+            skip_steps = 0
+        n_batches = skip_steps * self.gas
+        it = self._ensure_train_iter()  # load invalidated the old iterator
+        for _ in range(n_batches):
+            next(it)
+        # sentinel history is kept: the rewind RESTORES the pre-anomaly
+        # regime, so that history is the correct baseline for the replayed
+        # steps — resetting would open a min_history blind spot right
+        # where a widened second skip may be needed. (The anomalous value
+        # itself was never pushed.)
+        self._pending_anomaly_reads.clear()
+        dt_ms = (time.perf_counter() - t0) * 1e3
+        rec = {"class": anomaly.cls, "anomaly_step": anomaly.step,
+               "rewound_to": rewound_to, "skipped_steps": skip_steps,
+               "skipped_batches": n_batches, "checkpoint": path,
+               "recovery_ms": round(dt_ms, 2)}
+        self.rewind_log.append(rec)
+        reg = self.telemetry
+        if reg is not None:
+            reg.counter("resilience/rewinds").inc()
+            if n_batches:
+                reg.counter("resilience/skipped_batches").inc(n_batches)
+            reg.histogram("resilience/recovery_latency_ms").observe(dt_ms)
+        _tele.record_event("resilience/rewind", **rec)
+        log_dist(
+            f"anomaly recovery: {anomaly.cls} at step {anomaly.step} -> "
+            f"rewound to step {rewound_to} ({path}), skipping "
+            f"{n_batches} batch(es) ({skip_steps} step(s)), "
+            f"{dt_ms:.0f} ms", ranks=[0])
 
     def destroy(self):
         """Engine shutdown (reference engine.destroy): emit the comms

@@ -1,0 +1,297 @@
+"""Training anomaly sentinel (ISSUE 10).
+
+Long pretraining runs are dominated not by crashes (PR 1's territory) but by
+*soft* failures: loss spikes, nonfinite gradients outside the fp16
+loss-scaler path, and silent data corruption. PaLM (Chowdhery et al., 2022)
+recovered from spikes by rewinding to a checkpoint and skipping the
+offending batches; MegaScale (Jiang et al., 2024) showed SDC detection plus
+automated recovery is what keeps goodput high at scale. This module is the
+host-side half of that machinery:
+
+  * :class:`RollingRobustStats` — fixed-window robust (median/MAD) z-score
+    over a scalar series. Median/MAD instead of mean/std so a spike cannot
+    inflate its own detection threshold.
+  * :class:`TrainingSentinel` — classifies each step's (loss, grad-norm,
+    overflow-flag) observation into the anomaly taxonomy: ``overflow``
+    (fp16 loss-scaler handled it), ``nonfinite`` (NaN/Inf loss or grads —
+    on bf16/fp32 the engine's ``check_finite_grads`` guard skipped the
+    update), ``spike`` (finite but a robust-z outlier), ``divergence``
+    (``divergence_patience`` consecutive spikes).
+  * :func:`sdc_audit` — cross-data-parallel-replica checksum agreement:
+    devices holding the same logical shard of a replicated/sharded array
+    are bit-identical by construction, so any checksum disagreement is
+    silent data corruption; majority vote localizes the deviating device.
+  * :func:`step_replay_probe` — single-host determinism probe: the same
+    compiled step from the same state must produce bit-identical results;
+    a mismatch is flaky hardware.
+
+Everything here is host logic over already-fetched scalars — the engine
+feeds the sentinel at its existing telemetry fences so detection costs no
+extra device syncs; the device-side half (nonfinite flags inside the
+compiled step) lives in ``runtime/engine.py`` / ``runtime/precision.py``.
+"""
+
+from __future__ import annotations
+
+import math
+import zlib
+from collections import deque
+from typing import Any, Callable, Dict, List, NamedTuple, Optional, Tuple
+
+import numpy as np
+
+
+class AnomalyClass:
+    """Anomaly taxonomy (see module docstring)."""
+
+    OVERFLOW = "overflow"      # fp16 dynamic-loss-scale overflow (handled)
+    NONFINITE = "nonfinite"    # NaN/Inf loss or grads outside the scaler
+    SPIKE = "spike"            # finite robust-z outlier in loss/grad-norm
+    DIVERGENCE = "divergence"  # sustained spikes (patience exceeded)
+    SDC = "sdc"                # cross-replica checksum disagreement
+    REPLAY = "replay"          # step-replay determinism mismatch
+
+    # classes where the data window is suspect: recovery skips the batches
+    # between the rewind target and the anomaly (PaLM-style). SDC/replay
+    # are hardware faults — the data is fine, so recovery replays it.
+    DATA_CLASSES = (NONFINITE, SPIKE, DIVERGENCE)
+
+
+class TrainingAnomaly(NamedTuple):
+    cls: str
+    step: int
+    value: float
+    zscore: float
+    detail: str
+
+
+class TrainingAnomalyError(RuntimeError):
+    """A confirmed training anomaly the engine could not auto-recover from
+    (no engine-owned dataloader / checkpoint dir, or ``on_anomaly='raise'``)."""
+
+    def __init__(self, anomaly: TrainingAnomaly, msg: Optional[str] = None):
+        self.anomaly = anomaly
+        super().__init__(
+            msg or f"training anomaly: {anomaly.cls} at step {anomaly.step} "
+                   f"(value={anomaly.value:.6g}, z={anomaly.zscore:.2f}): "
+                   f"{anomaly.detail}")
+
+
+class RewindBudgetExceededError(TrainingAnomalyError):
+    """The rewind budget (rolling window, ElasticAgent semantics) is spent —
+    a persistently poisoned shard or failing host must not livelock the job
+    in a rewind loop; fail loudly for the operator / elastic agent."""
+
+
+class RollingRobustStats:
+    """Fixed-window series with robust z-scores: z = 0.6745·(v−median)/MAD.
+
+    The 0.6745 factor makes the MAD a consistent σ estimator under
+    normality, so thresholds read in 'sigmas'. The MAD is floored
+    (relative to |median|) so a near-constant history cannot turn noise
+    into infinite z-scores."""
+
+    def __init__(self, window: int = 64):
+        self.values: deque = deque(maxlen=max(int(window), 2))
+
+    def __len__(self) -> int:
+        return len(self.values)
+
+    def push(self, v: float) -> None:
+        self.values.append(float(v))
+
+    def median_mad(self) -> Tuple[float, float]:
+        arr = np.asarray(self.values, dtype=np.float64)
+        med = float(np.median(arr))
+        mad = float(np.median(np.abs(arr - med)))
+        return med, max(mad, 1e-3 * abs(med), 1e-12)
+
+    def zscore(self, v: float) -> float:
+        if not self.values:
+            return 0.0
+        med, mad = self.median_mad()
+        return 0.6745 * (float(v) - med) / mad
+
+    def reset(self) -> None:
+        self.values.clear()
+
+
+class TrainingSentinel:
+    """Per-step anomaly classifier over (loss, grad-norm, overflow) reads.
+
+    ``observe`` returns a :class:`TrainingAnomaly` for anomalous steps and
+    ``None`` for clean ones. Anomalous values are NOT pushed into the
+    rolling history (a spike must not raise its own baseline); clean
+    values are. ``counts`` accumulates per-class totals for telemetry.
+    Host-only: no jax imports, usable from any thread."""
+
+    def __init__(self, *, window: int = 64, min_history: int = 8,
+                 spike_zscore: float = 8.0, divergence_patience: int = 4,
+                 fp16: bool = False):
+        self.loss_stats = RollingRobustStats(window)
+        self.norm_stats = RollingRobustStats(window)
+        self.min_history = max(int(min_history), 2)
+        self.spike_zscore = float(spike_zscore)
+        self.divergence_patience = max(int(divergence_patience), 2)
+        self.fp16 = fp16
+        self.consecutive_spikes = 0
+        self.counts: Dict[str, int] = {}
+
+    def _anomaly(self, cls: str, step: int, value: float, z: float,
+                 detail: str) -> TrainingAnomaly:
+        self.counts[cls] = self.counts.get(cls, 0) + 1
+        return TrainingAnomaly(cls, step, float(value), float(z), detail)
+
+    def observe(self, step: int, loss: float, grad_norm: float,
+                overflow: bool = False) -> Optional[TrainingAnomaly]:
+        loss = float(loss)
+        grad_norm = float(grad_norm)
+        if overflow:
+            if self.fp16:
+                # the dynamic loss scaler already skipped the update and
+                # halved the scale — classified + counted, not actionable
+                return self._anomaly(AnomalyClass.OVERFLOW, step, loss, 0.0,
+                                     "fp16 loss-scale overflow")
+            return self._anomaly(AnomalyClass.NONFINITE, step, loss, 0.0,
+                                 "nonfinite grads (finite-grad guard)")
+        if not math.isfinite(loss) or not math.isfinite(grad_norm):
+            return self._anomaly(AnomalyClass.NONFINITE, step, loss, 0.0,
+                                 f"loss={loss} grad_norm={grad_norm}")
+        z_loss = self.loss_stats.zscore(loss)
+        z_norm = self.norm_stats.zscore(grad_norm)
+        warmed = (len(self.loss_stats) >= self.min_history)
+        if warmed and max(z_loss, z_norm) > self.spike_zscore:
+            self.consecutive_spikes += 1
+            z = max(z_loss, z_norm)
+            which = "loss" if z_loss >= z_norm else "grad_norm"
+            if self.consecutive_spikes >= self.divergence_patience:
+                return self._anomaly(
+                    AnomalyClass.DIVERGENCE, step, loss, z,
+                    f"{self.consecutive_spikes} consecutive {which} spikes")
+            return self._anomaly(AnomalyClass.SPIKE, step, loss, z,
+                                 f"{which} robust-z {z:.1f} > "
+                                 f"{self.spike_zscore}")
+        self.consecutive_spikes = 0
+        self.loss_stats.push(loss)
+        self.norm_stats.push(grad_norm)
+        return None
+
+    def reset(self) -> None:
+        """Discard all history — for the CALLER's intentional regime
+        changes only (e.g. a scheduled LR jump that legitimately shifts
+        the loss distribution). The engine deliberately does NOT call
+        this on anomaly rewind: a rewind restores the pre-anomaly regime,
+        so the existing history is the correct baseline, and resetting
+        would open a min_history blind spot exactly where a widened
+        second skip may be needed."""
+        self.loss_stats.reset()
+        self.norm_stats.reset()
+        self.consecutive_spikes = 0
+
+
+# --------------------------------------------------------------- SDC audits
+class SDCAuditResult(NamedTuple):
+    ok: bool
+    suspects: Tuple[int, ...]        # device ids, worst offender first
+    mismatched_groups: int           # (leaf, shard-index) groups disagreeing
+    n_groups: int                    # replica groups compared (>1 copy each)
+
+
+def _path_str(path) -> str:
+    import jax
+
+    parts = []
+    for p in path:
+        if isinstance(p, jax.tree_util.DictKey):
+            parts.append(str(p.key))
+        elif isinstance(p, jax.tree_util.SequenceKey):
+            parts.append(str(p.idx))
+        elif isinstance(p, jax.tree_util.GetAttrKey):
+            parts.append(str(p.name))
+        else:
+            parts.append(str(p))
+    return "/".join(parts)
+
+
+def replica_checksums(tree) -> Dict[Tuple[str, Tuple], Dict[int, int]]:
+    """Per-replica crc32s: ``(leaf path, shard index) -> {device_id: crc}``.
+
+    Devices whose shards cover the same global index range of the same
+    array hold replicas of that range (fully replicated arrays are the
+    all-devices special case) — their bytes must agree bit-exactly."""
+    import jax
+
+    out: Dict[Tuple[str, Tuple], Dict[int, int]] = {}
+    for path, leaf in jax.tree_util.tree_flatten_with_path(tree)[0]:
+        if not hasattr(leaf, "addressable_shards"):
+            continue
+        key0 = _path_str(path)
+        for sh in leaf.addressable_shards:
+            idx = tuple((s.start, s.stop, s.step) for s in sh.index)
+            crc = zlib.crc32(np.ascontiguousarray(
+                np.asarray(sh.data)).tobytes()) & 0xFFFFFFFF
+            out.setdefault((key0, idx), {})[sh.device.id] = crc
+    return out
+
+
+def sdc_audit(tree) -> SDCAuditResult:
+    """Cross-replica checksum agreement over ``tree`` (params and/or
+    optimizer state). Majority vote per disagreeing group names the
+    deviating device(s); a device deviating in the most groups is the
+    prime suspect (a real bit-flip corrupts one replica's copy of one
+    array — it shows up as exactly that device disagreeing)."""
+    groups = replica_checksums(tree)
+    suspect_hits: Dict[int, int] = {}
+    mismatched = 0
+    compared = 0
+    for _, per_dev in groups.items():
+        if len(per_dev) < 2:
+            continue
+        compared += 1
+        crcs = list(per_dev.values())
+        if len(set(crcs)) == 1:
+            continue
+        mismatched += 1
+        counts: Dict[int, int] = {}
+        for c in crcs:
+            counts[c] = counts.get(c, 0) + 1
+        majority = max(counts, key=lambda c: counts[c])
+        for dev, c in per_dev.items():
+            if c != majority:
+                suspect_hits[dev] = suspect_hits.get(dev, 0) + 1
+    suspects = tuple(sorted(suspect_hits, key=lambda d: -suspect_hits[d]))
+    return SDCAuditResult(ok=mismatched == 0, suspects=suspects,
+                          mismatched_groups=mismatched, n_groups=compared)
+
+
+def _tree_digest(tree) -> int:
+    """crc32 over every leaf's device_get bytes — bit-exact equality probe."""
+    import jax
+
+    crc = 0
+    for leaf in jax.tree_util.tree_leaves(jax.device_get(tree)):
+        crc = zlib.crc32(np.ascontiguousarray(np.asarray(leaf)).tobytes(),
+                         crc)
+    return crc & 0xFFFFFFFF
+
+
+def step_replay_probe(step_fn: Callable, state, state_shardings,
+                      args: Tuple = ()) -> Tuple[bool, str]:
+    """Run ``step_fn(state, *args)`` twice from bit-identical copies of
+    ``state`` and compare the outputs bit-exactly. A compiled XLA program
+    is deterministic, so any disagreement is hardware silent data
+    corruption (flaky ALU / HBM). Copies go through a host round-trip so
+    a ``donate_argnums`` step consumes the copy, never the live state.
+    Returns ``(ok, detail)``."""
+    import jax
+
+    host = jax.device_get(state)
+    digests: List[int] = []
+    for _ in range(2):
+        replica = jax.device_put(host, state_shardings)
+        out = step_fn(replica, *args)
+        digests.append(_tree_digest(out))
+    ok = digests[0] == digests[1]
+    return ok, ("ok" if ok else
+                f"replay digests differ: {digests[0]:#010x} vs "
+                f"{digests[1]:#010x}")

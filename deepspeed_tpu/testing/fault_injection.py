@@ -226,6 +226,97 @@ class FaultInjector:
         self._patch("replace", replace)
 
 
+# ------------------------------------------------ training seams (ISSUE 10)
+def poison_sample(sample, mode: str):
+    """Corrupt one dataset sample: ``"nan"`` fills float leaves with NaN
+    (nonfinite loss/grads — what the finite-grad guard must catch);
+    ``"huge"`` scales float leaves by 1e6 (finite but enormous loss — the
+    robust z-score spike shape). Integer leaves (token ids) pass through."""
+    import numpy as np
+
+    def corrupt(node):
+        if isinstance(node, dict):
+            return {k: corrupt(v) for k, v in node.items()}
+        if isinstance(node, (tuple, list)):
+            return type(node)(corrupt(v) for v in node)
+        arr = np.asarray(node)
+        if arr.dtype.kind != "f":
+            return node
+        if mode == "nan":
+            return np.full_like(arr, np.nan)
+        if mode == "huge":
+            return arr * np.asarray(1e6, arr.dtype)
+        raise ValueError(f"unknown poison mode {mode!r}")
+
+    return corrupt(sample)
+
+
+class PoisonedDataset:
+    """Indexable-dataset wrapper with per-index poison: models a corrupt
+    data shard. ``poison`` maps dataset index -> mode ("nan" | "huge").
+    The wrapped dataset is untouched, so the SAME underlying data drives
+    the clean-run side of a bit-identity comparison."""
+
+    def __init__(self, dataset, poison: Dict[int, str]):
+        self.dataset = dataset
+        self.poison = dict(poison)
+
+    def __len__(self):
+        return len(self.dataset)
+
+    def __getitem__(self, i):
+        sample = self.dataset[i]
+        mode = self.poison.get(int(i))
+        if mode is None:
+            return sample
+        return poison_sample(sample, mode)
+
+
+def flip_param_bit(engine, device_index: int = 0, leaf_index: int = 0,
+                   byte: int = 0, bit: int = 0):
+    """Flip one bit in ONE device's copy of one parameter — the silent
+    data corruption model (a host's HBM/SRAM bit-flip on a single
+    data-parallel replica). Only the targeted device's shard changes;
+    the cross-replica checksum audit must localize it to exactly
+    ``device_index``. Returns the flipped leaf's flat index."""
+    import jax
+    import numpy as np
+
+    leaves, treedef = jax.tree_util.tree_flatten(engine.state.params)
+    leaf = leaves[leaf_index % len(leaves)]
+    target = jax.devices()[device_index]
+    singles = []
+    for sh in leaf.addressable_shards:
+        arr = np.array(sh.data, copy=True)
+        if sh.device == target:
+            flat = arr.view(np.uint8).reshape(-1)
+            flat[byte % flat.size] ^= np.uint8(1 << (bit % 8))
+        singles.append(jax.device_put(arr, sh.device))
+    flipped = jax.make_array_from_single_device_arrays(
+        leaf.shape, leaf.sharding, singles)
+    leaves[leaf_index % len(leaves)] = flipped
+    engine.state = engine.state._replace(
+        params=jax.tree_util.tree_unflatten(treedef, leaves))
+    return leaf_index % len(leaves)
+
+
+def corrupt_file(path, keep_bytes: int = 64):
+    """Truncate a file in place — bit-rot / torn-write damage to an
+    already-published artifact (e.g. a checkpoint tag corrupted AFTER its
+    save succeeded, the mid-recovery chaos case: the rewind walk-back
+    must skip it and fall to an older valid tag). Fails loudly when the
+    file is already smaller than ``keep_bytes`` — a chaos seam that
+    injects nothing makes its test pass vacuously."""
+    with open(path, "rb") as f:
+        raw = f.read()
+    if len(raw) <= keep_bytes:
+        raise ValueError(
+            f"corrupt_file({path!r}): file is {len(raw)} bytes <= "
+            f"keep_bytes={keep_bytes}; truncation would be a no-op")
+    with open(path, "wb") as f:
+        f.write(raw[:keep_bytes])
+
+
 class ReplicaFaultPlan:
     """Scripted fault schedule for ONE serving replica (ISSUE 9).
 

@@ -30,4 +30,9 @@ grep -qs "def test_" tests/unit/serving/test_slo.py || { echo "tier-1: slo tests
 # shedding / supervisor invariants ride `-m 'not slow'` through
 # tests/unit/serving/test_fabric.py
 grep -qs "def test_" tests/unit/serving/test_fabric.py || { echo "tier-1: fabric tests missing"; exit 1; }
+# likewise the training-resilience suite (marker `resilience`): anomaly
+# classification, finite-grad guard, rewind-and-skip bit-identity,
+# deterministic dataloader resume and SDC-audit invariants ride
+# `-m 'not slow'` through tests/unit/runtime/test_resilience.py
+grep -qs "def test_" tests/unit/runtime/test_resilience.py || { echo "tier-1: resilience tests missing"; exit 1; }
 exit $rc

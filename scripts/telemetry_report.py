@@ -98,6 +98,7 @@ def aggregate(records, n_bad_lines=0):
         "prefix_cache": _prefix_cache_summary(metrics),
         "slo": _slo_summary(metrics),
         "fabric": _fabric_summary(metrics),
+        "resilience": _resilience_summary(metrics),
         "n_records": len(records),
         "n_bad_lines": n_bad_lines,
     }
@@ -229,6 +230,39 @@ def _fabric_summary(metrics):
     return out
 
 
+def _resilience_summary(metrics):
+    """Derived training-resilience view (ISSUE 10) over the engine's raw
+    counters/histograms: anomalies by class (nonfinite/overflow/spike/
+    divergence/sdc/replay), rewinds and skipped batches, SDC audit and
+    step-replay outcomes, and the recovery-latency tail. Empty dict when
+    the run never armed the sentinel."""
+    counters = {k: v for k, v in metrics.get("counters", {}).items()
+                if k.startswith("resilience/")}
+    gauges = {k: v for k, v in metrics.get("gauges", {}).items()
+              if k.startswith("resilience/")
+              or k == "train/nonfinite_skipped_steps"}
+    hists = {k: h for k, h in metrics.get("histograms", {}).items()
+             if k.startswith("resilience/") and h.get("count")}
+    if not counters and not gauges and not hists:
+        return {}
+    out = {}
+    anomalies = {k.split("anomalies_", 1)[1]: v
+                 for k, v in counters.items()
+                 if k.startswith("resilience/anomalies_")}
+    if anomalies:
+        out["anomalies_total"] = sum(anomalies.values())
+    for k, v in sorted(counters.items()):
+        out[k.split("/", 1)[1]] = v
+    for k, v in sorted(gauges.items()):
+        out[k.split("/", 1)[1]] = v
+    for k, h in sorted(hists.items()):
+        out[k.split("/", 1)[1]] = {
+            "count": h.get("count"), "p50": h.get("p50"),
+            "p95": h.get("p95"), "p99": h.get("p99"),
+            "max": h.get("max")}
+    return out
+
+
 def _fmt(v):
     if v is None:
         return "-"
@@ -290,6 +324,10 @@ def render(agg):
            [(k, _fmt(v) if not isinstance(v, dict) else
              " ".join(f"{kk}={_fmt(vv)}" for kk, vv in v.items()))
             for k, v in agg.get("fabric", {}).items()], out)
+    _table("resilience", ("metric", "value"),
+           [(k, _fmt(v) if not isinstance(v, dict) else
+             " ".join(f"{kk}={_fmt(vv)}" for kk, vv in v.items()))
+            for k, v in agg.get("resilience", {}).items()], out)
     erows = [(k, e["count"],
               json.dumps(e["last"], default=str)[:60])
              for k, e in agg["events"].items()]

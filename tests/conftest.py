@@ -298,7 +298,8 @@ _LATE_MODULES = _OBSERVABILITY_MODULES + (
     "unit/serving/test_speculative",
     "unit/serving/test_prefix_cache",
     "unit/serving/test_slo",
-    "unit/serving/test_fabric",)
+    "unit/serving/test_fabric",
+    "unit/runtime/test_resilience",)
 
 
 def pytest_collection_modifyitems(config, items):

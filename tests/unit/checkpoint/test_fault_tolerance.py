@@ -290,6 +290,65 @@ class TestAutoResume:
         assert path.endswith("newer")
         assert params_equal(newer, e2.state.params)
 
+    def test_walkback_across_two_consecutive_corrupt_tags(self, tmp_path):
+        """ISSUE 10 satellite: the walk-back must survive >=2 consecutive
+        corrupt tags (t3 AND t2) plus a 'latest' that points at the worst
+        one, landing on the oldest still-valid checkpoint."""
+        ckpt = str(tmp_path / "ck")
+        e1 = make_engine()
+        train_steps(e1, 1)
+        e1.save_checkpoint(ckpt, tag="t1")
+        good = jax.device_get(e1.state.params)
+        for i, tag in enumerate(("t2", "t3"), start=1):
+            train_steps(e1, 1, seed0=i)
+            e1.save_checkpoint(ckpt, tag=tag)
+        os.utime(tmp_path / "ck" / "t1" / "state.npz", (1, 1))
+        os.utime(tmp_path / "ck" / "t2" / "state.npz", (2, 2))
+        os.utime(tmp_path / "ck" / "t3" / "state.npz", (3, 3))
+        truncate_file(tmp_path / "ck" / "t3" / "state.npz")
+        truncate_file(tmp_path / "ck" / "t2" / "state.npz")
+        assert (tmp_path / "ck" / "latest").read_text() == "t3"
+        e2 = make_engine()
+        path, _ = e2.load_checkpoint(ckpt)
+        assert path is not None and path.endswith("t1")
+        assert params_equal(good, e2.state.params)
+        assert e2.global_steps == 1
+
+    def test_binary_garbage_latest_falls_back_to_scan(self, tmp_path):
+        """A bit-rotted 'latest' (undecodable bytes, not just a stale tag)
+        must not kill auto-resume — the candidate scan still wins."""
+        ckpt = str(tmp_path / "ck")
+        e1 = make_engine()
+        train_steps(e1, 1)
+        e1.save_checkpoint(ckpt, tag="t1")
+        good = jax.device_get(e1.state.params)
+        (tmp_path / "ck" / "latest").write_bytes(b"\xff\xfe\x00\x9c\x80garbage")
+        e2 = make_engine()
+        path, _ = e2.load_checkpoint(ckpt)
+        assert path is not None and path.endswith("t1")
+        assert params_equal(good, e2.state.params)
+
+    def test_every_tag_invalid_surfaces_typed_error(self, tmp_path):
+        """When candidates exist but NONE is loadable (all corrupt + a
+        corrupt 'latest'), load must surface the typed
+        CheckpointCorruptionError naming each rejection — not crash with
+        an incidental exception, and not silently restart from scratch."""
+        ckpt = str(tmp_path / "ck")
+        e1 = make_engine()
+        train_steps(e1, 1)
+        e1.save_checkpoint(ckpt, tag="t1")
+        train_steps(e1, 1, seed0=1)
+        e1.save_checkpoint(ckpt, tag="t2")
+        truncate_file(tmp_path / "ck" / "t1" / "state.npz")
+        truncate_file(tmp_path / "ck" / "t2" / "state.npz")
+        (tmp_path / "ck" / "latest").write_bytes(b"\xff\xfe\x00corrupt")
+        e2 = make_engine()
+        with pytest.raises(CheckpointCorruptionError) as ei:
+            e2.load_checkpoint(ckpt)
+        msg = str(ei.value)
+        assert "no valid checkpoint" in msg
+        assert "t1" in msg and "t2" in msg
+
     def test_all_candidates_corrupt_raises_loudly(self, tmp_path):
         ckpt = str(tmp_path / "ck")
         e1 = make_engine()
