@@ -142,6 +142,22 @@ class DeepSpeedAccelerator(abc.ABC):
                 pass
         return None
 
+    def peak_hbm_gbps(self) -> Optional[float]:
+        """Peak HBM bandwidth per chip in GB/s — the memory roof of the
+        per-program roofline attribution (telemetry/attribution.py).
+        Concrete accelerators consult their device-kind table;
+        ``DSTPU_PEAK_HBM_GBPS`` overrides everywhere. None = unknown,
+        and attainable-vs-achieved is simply not reported."""
+        import os
+
+        env = os.environ.get("DSTPU_PEAK_HBM_GBPS")
+        if env:
+            try:
+                return float(env)
+            except ValueError:
+                pass
+        return None
+
     # ------------------------------------------------------------ profiler hooks
     def range_push(self, msg: str):
         """NVTX analog: jax profiler trace annotation (used by instrument_w_scope)."""

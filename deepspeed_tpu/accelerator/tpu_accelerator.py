@@ -31,6 +31,21 @@ TPU_PEAK_TFLOPS = {
     "v6e": 918.0,
 }
 
+# HBM bandwidth per CHIP in GB/s, same published specs + lookup rules —
+# the memory roof of the per-program roofline attribution
+# (telemetry/attribution.py); DSTPU_PEAK_HBM_GBPS overrides.
+TPU_PEAK_HBM_GBPS = {
+    "v2": 700.0,
+    "v3": 900.0,
+    "v4": 1228.0,
+    "v5 lite": 819.0,
+    "v5litepod": 819.0,
+    "v5e": 819.0,
+    "v5p": 2765.0,
+    "v6 lite": 1640.0,
+    "v6e": 1640.0,
+}
+
 
 class TPU_Accelerator(DeepSpeedAccelerator):
     _name = "tpu"
@@ -77,11 +92,20 @@ class TPU_Accelerator(DeepSpeedAccelerator):
         env = super().peak_tflops()
         if env is not None:
             return env
+        return self._kind_lookup(TPU_PEAK_TFLOPS)
+
+    def peak_hbm_gbps(self):
+        env = super().peak_hbm_gbps()
+        if env is not None:
+            return env
+        return self._kind_lookup(TPU_PEAK_HBM_GBPS)
+
+    def _kind_lookup(self, table):
         kind = self.device_kind().lower()
         best = None
-        for sub, tf in TPU_PEAK_TFLOPS.items():
+        for sub, v in table.items():
             if sub in kind and (best is None or len(sub) > best[0]):
-                best = (len(sub), tf)
+                best = (len(sub), v)
         return best[1] if best else None
 
 

@@ -299,10 +299,17 @@ class DeepSpeedEngine:
         tcfg = config.telemetry_config
         self.telemetry = None
         self._telemetry_flops: Optional[float] = None  # None=unprobed, 0=n/a
+        self._telemetry_bytes: Optional[float] = None  # cost_analysis bytes
         self._fence_t: Optional[float] = None
         self._fence_step = 0
         self._fence_tokens = 0
         self._owned_sink = None
+        # span-graph tracer (ISSUE 11): step windows, sentinel-check
+        # fences, rewind recovery and checkpoint save/load — all stamped
+        # host-side at fences that already exist (default off)
+        self.tracer = None
+        self._train_trace = None
+        self._spans_sink = None
         if tcfg.enabled:
             from deepspeed_tpu import telemetry as _tele
 
@@ -314,6 +321,18 @@ class DeepSpeedEngine:
                     self.telemetry.attach_sink(self._owned_sink)
                 except Exception as e:
                     logger.warning(f"telemetry jsonl sink disabled: {e}")
+            if tcfg.spans:
+                span_sink = None
+                if tcfg.spans_path and jax.process_index() == 0:
+                    try:
+                        self._spans_sink = _tele.JsonlSink(tcfg.spans_path)
+                        span_sink = self._spans_sink
+                    except Exception as e:
+                        logger.warning(f"telemetry spans sink disabled: {e}")
+                if span_sink is None:
+                    span_sink = self.telemetry.sink  # interleave, if any
+                self.tracer = _tele.SpanTracer(sink=span_sink)
+                self._train_trace = self.tracer.new_trace()
         # ---- training resilience (ISSUE 10): anomaly sentinel + finite-grad
         # guard + rewind-and-skip auto-recovery + SDC audits. The sentinel
         # consumes per-step device scalars lazily: they queue as jax arrays
@@ -1023,6 +1042,14 @@ class DeepSpeedEngine:
         now = time.perf_counter()
         steps = self.global_steps - self._fence_step
         if self._fence_t is not None and steps > 0:
+            if self.tracer is not None:
+                # fence-to-fence window as one span: both instants were
+                # observed at fences the untraced engine already paid
+                self.tracer.record(
+                    "step_window", self._fence_t, now,
+                    trace_id=self._train_trace, steps=steps,
+                    tokens=self._fence_tokens,
+                    end_step=self.global_steps)
             dev_step_s = (now - self._fence_t) / steps
             reg.gauge("train/device_step_time_ms").set(dev_step_s * 1e3)
             if self._fence_tokens:
@@ -1110,6 +1137,11 @@ class DeepSpeedEngine:
                 # agree. Replicated compute makes this a slight
                 # overcount — acceptable for an MFU estimate.
                 flops *= jax.device_count()
+                # bytes accessed ride the same probe — the memory axis
+                # of the train step's roofline row (ISSUE 11)
+                self._telemetry_bytes = float(
+                    (ca or {}).get("bytes accessed", 0.0)
+                    or 0.0) * jax.device_count()
             except Exception as e:
                 logger.warning("telemetry: cost_analysis of the train step "
                                "failed (%s: %s); using analytic flops",
@@ -1122,6 +1154,47 @@ class DeepSpeedEngine:
                 flops = 6.0 * n_params * tokens
         self._telemetry_flops = flops
         return flops or None
+
+    def train_step_attribution(self) -> dict:
+        """Roofline row for the fused train step (ISSUE 11): XLA
+        cost-analysis flops/bytes (probed at the telemetry fence; the
+        analytic-flops fallback leaves the memory axis empty) joined
+        with the fence-measured device step time and the accelerator's
+        compute/bandwidth roofs. When a telemetry sink is attached, the
+        row is also streamed as an ``{"kind": "attribution", "scope":
+        "train"}`` record for scripts/telemetry_report.py."""
+        from deepspeed_tpu.telemetry.attribution import (accelerator_peaks,
+                                                         roofline_row)
+
+        flops = self._telemetry_flops
+        if not flops:
+            return {}
+        wall_s = None
+        if self.telemetry is not None:
+            ms = self.telemetry.gauge("train/device_step_time_ms").value
+            if ms:
+                wall_s = ms / 1e3
+        peak_flops, peak_bw = accelerator_peaks()
+        # _telemetry_flops/_telemetry_bytes are CLUSTER totals (the MFU
+        # probe scales cost_analysis by device_count; the analytic
+        # fallback counts global-batch tokens) while the accelerator
+        # roofs are PER CHIP — normalize to per-chip so achieved vs
+        # attainable compares like with like on multi-chip meshes
+        n_dev = max(jax.device_count(), 1)
+        row = roofline_row(flops / n_dev,
+                           (self._telemetry_bytes or 0.0) / n_dev,
+                           wall_s=wall_s, calls=self.global_steps,
+                           peak_flops=peak_flops,
+                           peak_bytes_per_sec=peak_bw)
+        table = {"train_step": row}
+        if self.telemetry is not None and self.telemetry.sink is not None:
+            try:
+                self.telemetry.sink.write({
+                    "kind": "attribution", "scope": "train",
+                    "programs": table})
+            except Exception:
+                pass
+        return table
 
     # ------------------------------------------------- resilience (ISSUE 10)
     def _resilience_step(self, metrics, batch):
@@ -1173,10 +1246,12 @@ class DeepSpeedEngine:
 
         if not self._pending_anomaly_reads:
             return None
+        t0 = time.perf_counter() if self.tracer is not None else 0.0
         pending, self._pending_anomaly_reads = \
             self._pending_anomaly_reads, []
         vals = jax.device_get([(l, n, o) for _, l, n, o in pending])
         reg = self.telemetry
+        found = None
         for (step, *_), (loss, norm, ovf) in zip(pending, vals):
             a = self.sentinel.observe(
                 step,
@@ -1188,8 +1263,17 @@ class DeepSpeedEngine:
             if reg is not None:
                 reg.counter(f"resilience/anomalies_{a.cls}").inc()
             if a.cls != AnomalyClass.OVERFLOW:
-                return a
-        return None
+                found = a
+                break
+        if self.tracer is not None:
+            # the batched fetch above is the sentinel's existing fence —
+            # the span just names it
+            self.tracer.record(
+                "sentinel_check", t0, time.perf_counter(),
+                trace_id=self._train_trace, observations=len(pending),
+                step=self.global_steps,
+                anomaly=(found.cls if found is not None else None))
+        return found
 
     def _sdc_audit_check(self):
         """Cross-data-parallel-replica checksum agreement over params +
@@ -1366,6 +1450,12 @@ class DeepSpeedEngine:
             if n_batches:
                 reg.counter("resilience/skipped_batches").inc(n_batches)
             reg.histogram("resilience/recovery_latency_ms").observe(dt_ms)
+        if self.tracer is not None:
+            self.tracer.record(
+                "recovery", t0, time.perf_counter(),
+                trace_id=self._train_trace, anomaly=anomaly.cls,
+                anomaly_step=anomaly.step, rewound_to=rewound_to,
+                skipped_batches=n_batches)
         _tele.record_event("resilience/rewind", **rec)
         log_dist(
             f"anomaly recovery: {anomaly.cls} at step {anomaly.step} -> "
@@ -1383,6 +1473,9 @@ class DeepSpeedEngine:
             dist.log_summary()
         if self.telemetry is not None:
             self.telemetry.flush(step=self.global_steps)
+        if self._spans_sink is not None:
+            self._spans_sink.close()
+            self._spans_sink = None
         if self._owned_sink is not None:
             self._owned_sink.close()
             if self.telemetry is not None and \
@@ -1550,12 +1643,18 @@ class DeepSpeedEngine:
     def save_checkpoint(self, save_dir, tag=None, client_state=None, save_latest=True):
         from deepspeed_tpu.runtime.checkpoint_engine.engine import save_engine_checkpoint
 
+        t0 = time.perf_counter()
         try:
             return save_engine_checkpoint(self, save_dir, tag=tag,
                                           client_state=client_state,
                                           save_latest=save_latest,
                                           checkpoint_engine=self._checkpoint_engine())
         finally:
+            if self.tracer is not None:
+                self.tracer.record("checkpoint_save", t0,
+                                   time.perf_counter(),
+                                   trace_id=self._train_trace,
+                                   step=self.global_steps)
             if self.telemetry is not None:
                 self._reset_telemetry_window()
 
@@ -1563,6 +1662,7 @@ class DeepSpeedEngine:
                         load_lr_scheduler_states=True, load_module_only=False):
         from deepspeed_tpu.runtime.checkpoint_engine.engine import load_engine_checkpoint
 
+        t0 = time.perf_counter()
         try:
             return load_engine_checkpoint(self, load_dir, tag=tag,
                                           load_optimizer_states=load_optimizer_states,
@@ -1570,6 +1670,11 @@ class DeepSpeedEngine:
                                           load_module_only=load_module_only,
                                           checkpoint_engine=self._checkpoint_engine())
         finally:
+            if self.tracer is not None:
+                self.tracer.record("checkpoint_load", t0,
+                                   time.perf_counter(),
+                                   trace_id=self._train_trace,
+                                   step=self.global_steps)
             if self.telemetry is not None:
                 self._reset_telemetry_window()
 

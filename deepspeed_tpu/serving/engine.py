@@ -104,6 +104,35 @@ class _Preempted:
         self.since = since
 
 
+class _ReqTrace:
+    """Engine-side span bookkeeping for one request (ISSUE 11): the
+    trace id, the root span to hang lifecycle spans under (engine-owned
+    when the request arrived without trace context; the fabric router's
+    otherwise), and the currently-open decode-segment / swapped-out
+    interval spans."""
+
+    __slots__ = ("trace_id", "root", "root_span", "decode_span",
+                 "swap_span", "submitted_t")
+
+    def __init__(self, trace_id: str, root: Optional[str],
+                 root_span=None, submitted_t: Optional[float] = None):
+        self.trace_id = trace_id
+        self.root = root               # parent span id for child spans
+        self.root_span = root_span     # open root Span iff engine-owned
+        self.decode_span = None
+        self.swap_span = None
+        # queue_wait start for CONTEXT-CARRYING requests (fabric
+        # dispatch): the router's router_queue span already covers
+        # [arrival, dispatch], so the engine-side wait must start at
+        # the dispatch-time submit — starting at the original arrival
+        # would double-count the router interval into the queue phase
+        # (and, after a failover, swallow the whole first attempt).
+        # Stamped by the engine's FIRST step() after submit (the same
+        # clock instant the router dispatched at); None on an
+        # engine-owned root, where arrival_time is correct.
+        self.submitted_t = submitted_t
+
+
 class ServingEngine:
     """Drives an :class:`InferenceEngine`'s slot programs with an
     iteration-level scheduler.
@@ -191,6 +220,17 @@ class ServingEngine:
         drops to 0 (decode runs first, prefill defers) — for at most
         ``slo_max_defer`` consecutive iterations, so prefill always
         makes progress. Requires ``prefill_token_budget``.
+    tracer: span-graph tracer (ISSUE 11), or None (default) to run
+        untraced. When armed, every request's lifecycle is stamped
+        host-side at fences that already exist — queue wait, each
+        prefill chunk, decode segments, speculative draft/verify,
+        preemption swap-out/swapped/swap-in, shed/cancel — under a root
+        span the engine owns (or the fabric router's, when the request
+        arrives with trace context), and per-program wall time is
+        accumulated for :meth:`attribution_table`'s roofline. Arming
+        adds no device work: greedy output stays bit-identical and the
+        armed-vs-bare overhead is pinned <= 2% by bench.py
+        ``tracing_overhead``.
     """
 
     def __init__(self, engine, *, num_slots: int = 8, max_len: int = 1024,
@@ -207,7 +247,7 @@ class ServingEngine:
                  swap_max_bytes: Optional[int] = None,
                  priority_aging_sec: Optional[float] = None,
                  tpot_slo_ms: Optional[float] = None,
-                 slo_max_defer: int = 4):
+                 slo_max_defer: int = 4, tracer=None):
         self.engine = engine
         model = engine.module
         mcfg = getattr(model, "config", None)
@@ -383,6 +423,22 @@ class ServingEngine:
             self.telemetry = get_registry()
         else:
             self.telemetry = telemetry or None
+        # ---- span-graph tracing + roofline attribution (ISSUE 11)
+        self.tracer = tracer
+        self._rtraces: Dict[int, _ReqTrace] = {}
+        self._engine_trace: Optional[str] = None  # iteration-span trace
+        self._last_step_now = 0.0     # cancel() has no `now` argument
+        # context-carrying records awaiting their submit-time stamp
+        # (resolved by the next step(); see _ReqTrace.submitted_t)
+        self._pending_submit_stamps: List[_ReqTrace] = []
+        # program name -> abstract operand shapes, captured at warmup
+        # (ShapeDtypeStructs — no live buffers retained); the lazy
+        # cost_analysis probe in attribution_table() lowers with these
+        self._program_shapes: Dict[str, tuple] = {}
+        # program name -> [total host wall s, calls] (armed runs only —
+        # the bare path must stay byte-identical to pre-tracing code)
+        self._prog_wall: Dict[str, list] = {}
+        self._attr_cache: Dict[str, dict] = {}
         # radix prefix index over the block pool (ISSUE 6) — created
         # after telemetry so its hit/miss/COW/eviction counters land in
         # the same registry as the serving histograms
@@ -489,6 +545,80 @@ class ServingEngine:
             out.update(self._drafter.program_cache_sizes())
         return out
 
+    # ------------------------------------------------- attribution (ISSUE 11)
+    def _cap(self, name: str, *args):
+        """Capture a program's operand shapes (once, at warmup) for the
+        lazy roofline cost probe; passes the args through unchanged."""
+        if name not in self._program_shapes:
+            from deepspeed_tpu.telemetry.attribution import abstract_args
+
+            self._program_shapes[name] = abstract_args(args)
+        return args
+
+    def _prog_note(self, name: str, dt: float) -> None:
+        """Accumulate host wall for one program call (armed runs)."""
+        w = self._prog_wall.get(name)
+        if w is None:
+            self._prog_wall[name] = [dt, 1]
+        else:
+            w[0] += dt
+            w[1] += 1
+
+    def _program_map(self) -> Dict[str, Callable]:
+        """name -> jitted program, names matching program_cache_sizes
+        (the registry the attribution table covers)."""
+        progs: Dict[str, Callable] = {"decode": self._decode}
+        for b, fn in self._prefill.items():
+            progs[f"prefill_{b}"] = fn
+        for b, fn in self._chunk_prefill.items():
+            progs[f"chunk_prefill_{b}"] = fn
+        for kb, fn in self._verify.items():
+            progs[f"verify_{kb}"] = fn
+        if self._swap_out_fn is not None:
+            progs["swap_out"] = self._swap_out_fn
+            progs["swap_in"] = self._swap_in_fn
+        if self._copy_fn is not None:
+            progs["block_copy"] = self._copy_fn
+        if isinstance(self._drafter, DraftModelDrafter):
+            # the draft model's programs ride program_cache_sizes and
+            # must ride the roofline table too (coverage is pinned by
+            # bench.py's all_programs_covered)
+            for kb, fn in self._drafter._programs.items():
+                progs[f"draft_{kb}"] = fn
+        return progs
+
+    def attribution_table(self) -> Dict[str, dict]:
+        """Per-program roofline attribution (ISSUE 11): XLA
+        cost-analysis flops/bytes for every compiled serving program,
+        joined with host-observed per-call wall (tracer-armed runs)
+        and the accelerator's compute/bandwidth roofs —
+        achieved-vs-attainable per program, and which roof binds it.
+        Cost probes are one extra lower+compile each, memoized; never
+        called from the serving hot path."""
+        from deepspeed_tpu.telemetry.attribution import attribution_table
+
+        progs = {n: (fn, self._program_shapes[n])
+                 for n, fn in self._program_map().items()
+                 if n in self._program_shapes}
+        walls = {n: (w[0], w[1]) for n, w in self._prog_wall.items()}
+        return attribution_table(progs, walls=walls,
+                                 cache=self._attr_cache)
+
+    def record_attribution(self) -> Dict[str, dict]:
+        """Compute :meth:`attribution_table` and stream it to the
+        telemetry JSONL sink as an ``{"kind": "attribution"}`` record
+        (rendered by scripts/telemetry_report.py's ``attribution``
+        section). Returns the table."""
+        table = self.attribution_table()
+        if self.telemetry is not None and self.telemetry.sink is not None:
+            try:
+                self.telemetry.sink.write({
+                    "kind": "attribution", "scope": "serving",
+                    "programs": table})
+            except Exception:
+                pass
+        return table
+
     def warmup(self) -> None:
         """Compile every serving program (each bucket's prefill + the
         decode step + with speculation each k-bucket's verify and draft
@@ -507,23 +637,26 @@ class ServingEngine:
                 if paged:
                     # sentinel table row: the dummy prefill's writes land
                     # in the pool's garbage block, never a real one
-                    out = self._prefill_fn(b)(
+                    out = self._prefill_fn(b)(*self._cap(
+                        f"prefill_{b}",
                         eng.params, *self.cache.carry(), ids,
                         self.cache.table_row(0), np.int32(0), np.int32(0),
-                        np.int32(1), self._temp, self._zero_key)
+                        np.int32(1), self._temp, self._zero_key))
                 else:
-                    out = self._prefill_fn(b)(
+                    out = self._prefill_fn(b)(*self._cap(
+                        f"prefill_{b}",
                         eng.params, *self.cache.carry(), ids, np.int32(0),
-                        np.int32(1), self._temp, self._zero_key)
+                        np.int32(1), self._temp, self._zero_key))
                 self.cache.update(*out[:3])
                 if (self._chunk_max is not None and not paged
                         and b <= self._chunk_max):
                     # slot-paged chunk programs: chunks never exceed
                     # _chunk_max, so only buckets up to it can run one
-                    out = self._chunk_fn(b)(
+                    out = self._chunk_fn(b)(*self._cap(
+                        f"chunk_prefill_{b}",
                         eng.params, *self.cache.carry(), ids, np.int32(0),
                         np.int32(0), np.int32(1), self._temp,
-                        self._zero_key)
+                        self._zero_key))
                     self.cache.update(*out[:3])
             if self.preemption is not None:
                 # swap round trip through slot/garbage rows, with the
@@ -535,49 +668,55 @@ class ServingEngine:
                     sent = jnp.asarray(np.full(
                         (self.cache.max_blocks_per_slot,),
                         self.cache.sentinel, np.int32))
-                    ko, vo = self._swap_out_fn(self.cache.k, self.cache.v,
-                                               sent)
+                    ko, vo = self._swap_out_fn(*self._cap(
+                        "swap_out", self.cache.k, self.cache.v, sent))
                     args_in = (jnp.asarray(np.asarray(jax.device_get(ko))),
                                jnp.asarray(np.asarray(jax.device_get(vo))),
                                sent)
                 else:
-                    ko, vo = self._swap_out_fn(self.cache.k, self.cache.v,
-                                               np.int32(0))
+                    ko, vo = self._swap_out_fn(*self._cap(
+                        "swap_out", self.cache.k, self.cache.v,
+                        np.int32(0)))
                     args_in = (jnp.asarray(np.asarray(jax.device_get(ko))),
                                jnp.asarray(np.asarray(jax.device_get(vo))))
-                out = self._swap_in_fn(self.cache.k, self.cache.v,
-                                       *args_in, self.cache.lengths,
-                                       np.int32(0), np.int32(0))
+                out = self._swap_in_fn(*self._cap(
+                    "swap_in", self.cache.k, self.cache.v,
+                    *args_in, self.cache.lengths,
+                    np.int32(0), np.int32(0)))
                 self.cache.update(*out)
             toks = np.zeros((self.num_slots,), np.int32)
             active = np.zeros((self.num_slots,), bool)
-            out = self._decode(eng.params, *self.cache.carry(),
-                               *self._table_args(),
-                               jnp.asarray(toks), jnp.asarray(active),
-                               self._temp, self._zero_key)
+            out = self._decode(*self._cap(
+                "decode", eng.params, *self.cache.carry(),
+                *self._table_args(),
+                jnp.asarray(toks), jnp.asarray(active),
+                self._temp, self._zero_key))
             self.cache.update(*out[:3])
             if paged:
                 # COW copy program: garbage row onto itself is a no-op
-                k, v = self._copy_fn(self.cache.k, self.cache.v,
-                                     np.int32(self.cache.sentinel),
-                                     np.int32(self.cache.sentinel))
+                k, v = self._copy_fn(*self._cap(
+                    "block_copy", self.cache.k, self.cache.v,
+                    np.int32(self.cache.sentinel),
+                    np.int32(self.cache.sentinel)))
                 self.cache.update_kv(k, v)
             if self.spec is not None:
                 zeros = jnp.zeros((self.num_slots,), jnp.int32)
                 for kb in self.spec.k_buckets:
                     blk = jnp.zeros((self.num_slots, kb + 1), jnp.int32)
-                    out = self._verify_fn(kb)(
+                    out = self._verify_fn(kb)(*self._cap(
+                        f"verify_{kb}",
                         eng.params, *self.cache.carry(),
                         *self._table_args(), blk, zeros,
-                        jnp.asarray(active), self._temp, self._zero_key)
+                        jnp.asarray(active), self._temp, self._zero_key))
                     self.cache.update(*out[:3])
                     if isinstance(self._drafter, DraftModelDrafter):
                         window = jnp.zeros(
                             (self.num_slots, self._drafter.window),
                             jnp.int32)
-                        self._drafter._program(kb)(
+                        self._drafter._program(kb)(*self._cap(
+                            f"draft_{kb}",
                             self._drafter.engine.params, window,
-                            jnp.ones((self.num_slots,), jnp.int32))
+                            jnp.ones((self.num_slots,), jnp.int32)))
             self.cache.lengths = self._canon(
                 jnp.zeros((self.num_slots,), jnp.int32))
         self._warm = True
@@ -626,6 +765,24 @@ class ServingEngine:
                 f"request {request.rid}: prompt {plen} + max_new "
                 f"{request.max_new_tokens} exceeds slot capacity "
                 f"{self.max_len}{extra}")
+        if self.tracer is not None:
+            # trace context (ISSUE 11): a request arriving WITH context
+            # (the fabric's re-dispatch, or any upstream caller) keeps
+            # its trace — the engine's spans link under the caller's
+            # root. Otherwise the engine owns the root span. The
+            # incoming Request is never mutated: context lives in the
+            # engine-side record, so replaying the same trace objects
+            # (benches, tests) yields fresh traces per run.
+            if request.trace_id is not None:
+                rt = _ReqTrace(request.trace_id, request.parent_span)
+                self._rtraces[request.rid] = rt
+                self._pending_submit_stamps.append(rt)
+            else:
+                root = self.tracer.begin(
+                    "request", t=request.arrival_time, rid=request.rid,
+                    priority=request.priority, prompt_len=plen)
+                self._rtraces[request.rid] = _ReqTrace(
+                    root.trace_id, root.span_id, root_span=root)
         self.scheduler.submit(request)
 
     def cancel(self, rid: int) -> bool:
@@ -645,6 +802,7 @@ class ServingEngine:
                 # discard, not pop: nothing returns to the device, so
                 # this must not count as a swap-in
                 self.swap.discard(rid)
+            self._trace_cancel(rid, "queued")
             return True
         for i, st in enumerate(self._slots):
             if st is not None and st.request.rid == rid:
@@ -653,8 +811,27 @@ class ServingEngine:
                 if self.prefix is not None:
                     length = int(jax.device_get(self.cache.lengths[i]))
                     self.prefix.finish(i, donate_upto=length)
+                self._trace_cancel(rid, "slot")
                 return True
         return False
+
+    def _trace_cancel(self, rid: int, where: str) -> None:
+        """Close a cancelled request's open spans (ISSUE 11) — the
+        fabric's failover/timeout path cancels here and re-dispatches
+        the SAME trace to a survivor, so the cancelled attempt's spans
+        must not dangle open. cancel() carries no clock argument; the
+        last step() instant is the best engine-base stamp available."""
+        if self.tracer is None:
+            return
+        rt = self._rtraces.pop(rid, None)
+        if rt is None:
+            return
+        t = self._last_step_now
+        self.tracer.end(rt.decode_span, t=t, reason="cancelled")
+        self.tracer.end(rt.swap_span, t=t, reason="cancelled")
+        self.tracer.record("cancel", t, t, trace_id=rt.trace_id,
+                           parent_id=rt.root, where=where)
+        self.tracer.end(rt.root_span, t=t, finish_reason="cancelled")
 
     @property
     def pending(self) -> int:
@@ -689,6 +866,18 @@ class ServingEngine:
             # radix index (one cached prefill serves every future match),
             # free the rest, park the table row at the sentinel
             self.prefix.finish(slot)
+        if self.tracer is not None:
+            rt = self._rtraces.pop(st.request.rid, None)
+            if rt is not None:
+                t_fin = st.result.finish_time
+                self.tracer.end(rt.decode_span, t=t_fin,
+                                tokens=len(st.result.tokens),
+                                decode_calls=st.result.decode_calls)
+                self.tracer.end(rt.swap_span, t=t_fin)
+                self.tracer.end(rt.root_span, t=t_fin,
+                                finish_reason=reason,
+                                tokens=len(st.result.tokens),
+                                preemptions=st.result.preemptions)
         if self.telemetry is not None:
             res = st.result
             reg = self.telemetry
@@ -902,15 +1091,30 @@ class ServingEngine:
             finished.append(res)
             if self.telemetry is not None:
                 self.telemetry.counter("serving/shed_deadline").inc()
+            if self.tracer is not None:
+                rt = self._rtraces.pop(req.rid, None)
+                if rt is not None:
+                    start = req.arrival_time if rt.submitted_t is None \
+                        else rt.submitted_t
+                    self.tracer.record(
+                        "queue_wait", min(start, now), now,
+                        trace_id=rt.trace_id, parent_id=rt.root, slot=slot)
+                    self.tracer.end(rt.root_span, t=res.finish_time,
+                                    finish_reason="shed_deadline")
             return 0
         start = 0
         if self.prefix is not None:
             total = plen + req.max_new_tokens + self._lookahead
             start, copies = self.prefix.admit(slot, req.prompt, total)
             for src, dst in copies:
+                w0 = time.perf_counter() if self.tracer is not None \
+                    else 0.0
                 k, v = self._copy_fn(self.cache.k, self.cache.v,
                                      np.int32(src), np.int32(dst))
                 self.cache.update_kv(k, v)
+                if self.tracer is not None:
+                    self._prog_note("block_copy",
+                                    time.perf_counter() - w0)
         res = RequestResult(rid=req.rid, prompt_len=plen,
                             arrival_time=req.arrival_time,
                             admitted_time=now, priority=req.priority)
@@ -924,6 +1128,15 @@ class ServingEngine:
             reg.counter("serving/prefills").inc()
             reg.histogram("serving/queue_wait_ms").observe(
                 max(now - req.arrival_time, 0.0) * 1e3)
+        if self.tracer is not None:
+            rt = self._rtraces.get(req.rid)
+            if rt is not None:
+                t_q0 = req.arrival_time if rt.submitted_t is None \
+                    else rt.submitted_t
+                self.tracer.record(
+                    "queue_wait", min(t_q0, now), now,
+                    trace_id=rt.trace_id, parent_id=rt.root, slot=slot,
+                    priority=req.priority, radix_matched_tokens=start)
         if self._adaptive is not None:
             self._adaptive.reset_slot(slot)
         return self._run_prefill_chunks(slot, now, budget_left, finished)
@@ -952,8 +1165,13 @@ class ServingEngine:
             ids = np.full((1, bucket), self.pad_token_id, np.int32)
             ids[0, :chunk] = np.asarray(
                 req.prompt[st.prefill_pos:st.prefill_pos + chunk], np.int32)
+            armed = self.tracer is not None
+            if armed:
+                t_span0 = self._now(now)
+                t_wall0 = time.perf_counter()
             with jax.profiler.TraceAnnotation("dstpu/serving_prefill"):
                 if self.prefix is not None:
+                    pname = f"prefill_{bucket}"
                     out = self._prefill_fn(bucket)(
                         eng.params, *self.cache.carry(), jnp.asarray(ids),
                         self.cache.table_row(slot), np.int32(slot),
@@ -962,16 +1180,31 @@ class ServingEngine:
                 elif st.prefill_pos == 0 and last:
                     # whole prompt in one chunk: the monolithic bucket
                     # program (fresh bucket-sized cache + slot insert)
+                    pname = f"prefill_{bucket}"
                     out = self._prefill_fn(bucket)(
                         eng.params, *self.cache.carry(), jnp.asarray(ids),
                         np.int32(slot), np.int32(chunk), self._temp,
                         self._next_rng())
                 else:
+                    pname = f"chunk_prefill_{bucket}"
                     out = self._chunk_fn(bucket)(
                         eng.params, *self.cache.carry(), jnp.asarray(ids),
                         np.int32(slot), np.int32(st.prefill_pos),
                         np.int32(chunk), self._temp, self._next_rng())
                 self.cache.update(*out[:3])
+            if armed:
+                # host-stamped at the instants the loop already holds:
+                # no fence added (under async dispatch this brackets the
+                # dispatch; the LAST chunk's token fetch below is the
+                # same fence the untraced engine always paid)
+                self._prog_note(pname, time.perf_counter() - t_wall0)
+                rt = self._rtraces.get(req.rid)
+                if rt is not None:
+                    self.tracer.record(
+                        "prefill_chunk", t_span0, self._now(now),
+                        trace_id=rt.trace_id, parent_id=rt.root,
+                        program=pname, bucket=bucket, tokens=chunk,
+                        slot=slot)
             st.prefill_pos += chunk
             # the budget is charged in BUCKET-PADDED tokens — the
             # compute actually dispatched — so one iteration's prefill
@@ -1000,6 +1233,14 @@ class ServingEngine:
                     self.telemetry.histogram("serving/ttft_ms").observe(ttft)
                     self.telemetry.histogram(
                         f"serving/ttft_ms/p{req.priority}").observe(ttft)
+                if armed:
+                    # decode-phase residency starts at the first-token
+                    # commit; closed at finish/preemption/cancel
+                    rt = self._rtraces.get(req.rid)
+                    if rt is not None:
+                        rt.decode_span = self.tracer.begin(
+                            "decode_segment", trace_id=rt.trace_id,
+                            parent_id=rt.root, t=t_emit, slot=slot)
                 done = self._maybe_finish(slot, now)
                 if done is not None:
                     finished.append(done)
@@ -1061,6 +1302,11 @@ class ServingEngine:
         (pinned by tests)."""
         st = self._slots[slot]
         self._build_swap_programs()
+        armed = self.tracer is not None
+        rt = self._rtraces.get(st.request.rid) if armed else None
+        if armed:
+            t_sw0 = self._now(now)
+            w0 = time.perf_counter()
         length = int(jax.device_get(self.cache.lengths[slot]))
         if self.prefix is not None:
             n_used = self.cache.blocks_for(length)
@@ -1084,13 +1330,30 @@ class ServingEngine:
             self.swap.put(st.request.rid,
                           np.asarray(jax.device_get(ko)),
                           np.asarray(jax.device_get(vo)))
+            n_used = 1
             self.swapped_blocks_out += 1      # the slot page
         self._slots[slot] = None
         self.scheduler.release(slot)
         self.scheduler.resubmit(st.request)
         st.result.preemptions += 1
-        self._preempted[st.request.rid] = _Preempted(
-            st, length, self._now(now))
+        since = self._now(now)
+        self._preempted[st.request.rid] = _Preempted(st, length, since)
+        if armed:
+            self._prog_note("swap_out", time.perf_counter() - w0)
+            if rt is not None:
+                # the decode segment ends where the swap began; the
+                # swapped interval opens at the park instant and closes
+                # on resume — preempted time lands in its own phase
+                self.tracer.end(rt.decode_span, t=t_sw0,
+                                reason="preempted")
+                rt.decode_span = None
+                self.tracer.record("swap_out", t_sw0, since,
+                                   trace_id=rt.trace_id,
+                                   parent_id=rt.root, program="swap_out",
+                                   blocks=n_used, slot=slot)
+                rt.swap_span = self.tracer.begin(
+                    "swapped", trace_id=rt.trace_id, parent_id=rt.root,
+                    t=since, blocks=n_used)
         self.preemptions += 1
         if self.telemetry is not None:
             reg = self.telemetry
@@ -1108,6 +1371,11 @@ class ServingEngine:
         Decode continues exactly where it left off."""
         rec = self._preempted.pop(req.rid)
         st = rec.state
+        armed = self.tracer is not None
+        rt = self._rtraces.get(req.rid) if armed else None
+        if armed:
+            t_in0 = self._now(now)
+            w0 = time.perf_counter()
         host_k, host_v = self.swap.pop(req.rid)
         length = rec.length
         if self.prefix is not None:
@@ -1142,7 +1410,22 @@ class ServingEngine:
                                    np.int32(length))
             swapped_in = 1
         self.cache.update(*out)
-        gap = max(self._now(now) - rec.since, 0.0)
+        t_res = self._now(now)
+        if armed:
+            self._prog_note("swap_in", time.perf_counter() - w0)
+            if rt is not None:
+                self.tracer.end(rt.swap_span, t=t_in0)
+                rt.swap_span = None
+                self.tracer.record("swap_in", t_in0, t_res,
+                                   trace_id=rt.trace_id,
+                                   parent_id=rt.root, program="swap_in",
+                                   blocks=swapped_in, slot=slot)
+                if st.result.tokens:
+                    rt.decode_span = self.tracer.begin(
+                        "decode_segment", trace_id=rt.trace_id,
+                        parent_id=rt.root, t=t_res, slot=slot,
+                        resumed=True)
+        gap = max(t_res - rec.since, 0.0)
         st.result.preempted_wall += gap
         if st.result.tokens:
             # decode-phase preemption (first token already out): this
@@ -1174,6 +1457,15 @@ class ServingEngine:
             self.warmup()
         if now is None:
             now = self._time()
+        self._last_step_now = now
+        if self._pending_submit_stamps:
+            # first step after a context-carrying submit: this instant
+            # is where the dispatcher's router_queue span ends, so the
+            # engine-side queue_wait begins exactly here (the phases
+            # tile; stamping a since-cancelled record is harmless)
+            for rt in self._pending_submit_stamps:
+                rt.submitted_t = now
+            self._pending_submit_stamps.clear()
         finished: List[RequestResult] = []
         with jax.profiler.TraceAnnotation("dstpu/serving_admit"):
             self._schedule(now, finished)
@@ -1200,6 +1492,14 @@ class ServingEngine:
             return self._spec_step(now, active_slots, finished)
         return self._plain_step(now, active_slots, finished)
 
+    def _iter_trace(self) -> str:
+        """Lazy engine-scope trace for iteration-level spans (decode
+        steps, speculative draft/verify) — structural context that is
+        not any single request's lifecycle."""
+        if self._engine_trace is None:
+            self._engine_trace = self.tracer.new_trace()
+        return self._engine_trace
+
     def _note_decode_gap(self) -> None:
         """EMA of wall time between consecutive decode invocations —
         the signal the ``tpot_slo_ms`` admission guard watches. Host
@@ -1222,6 +1522,9 @@ class ServingEngine:
             toks[i] = self._slots[i].last_token
         active = np.zeros((self.num_slots,), bool)
         active[active_slots] = True
+        armed = self.tracer is not None
+        if armed:
+            t_dec0 = self._now(now)
         t0 = time.perf_counter()
         with jax.profiler.TraceAnnotation("dstpu/serving_decode"):
             out = self._decode(self.engine.params, *self.cache.carry(),
@@ -1230,7 +1533,16 @@ class ServingEngine:
                                self._temp, self._next_rng())
             self.cache.update(*out[:3])
             nxt = np.asarray(jax.device_get(out[3]))
-        self.decode_wall += time.perf_counter() - t0
+        dt = time.perf_counter() - t0
+        self.decode_wall += dt
+        if armed:
+            # the token fetch above IS a fence, so this wall is honest
+            # device-inclusive time — the attribution 'achieved' clock
+            self._prog_note("decode", dt)
+            self.tracer.record("decode_step", t_dec0, self._now(now),
+                               trace_id=self._iter_trace(),
+                               program="decode",
+                               n_slots=len(active_slots))
         self.decode_steps += 1
         self._active_slot_iterations += len(active_slots)
         if self.telemetry is not None:
@@ -1287,6 +1599,9 @@ class ServingEngine:
         histories = [list(s.request.prompt) + s.result.tokens
                      if s is not None and not s.prefilling else None
                      for s in self._slots]
+        armed = self.tracer is not None
+        if armed:
+            t_sp0 = self._now(now)
         t0 = time.perf_counter()
         with jax.profiler.TraceAnnotation("dstpu/serving_draft"):
             drafts, lens = self._drafter.propose(histories, want, kb)
@@ -1294,6 +1609,10 @@ class ServingEngine:
         dt = time.perf_counter() - t0
         self._draft_wall += dt
         self.decode_wall += dt
+        if armed:
+            self.tracer.record("spec_draft", t_sp0, self._now(now),
+                               trace_id=self._iter_trace(), k_bucket=kb,
+                               n_slots=len(active_slots))
         longest = int(lens.max())
         if longest == 0:
             # nothing proposed anywhere (e.g. prompt-lookup on novel
@@ -1310,6 +1629,8 @@ class ServingEngine:
             n = int(lens[i])
             tokens[i, 1:1 + n] = drafts[i, :n]
             active[i] = True
+        if armed:
+            t_vf0 = self._now(now)
         t0 = time.perf_counter()
         with jax.profiler.TraceAnnotation("dstpu/serving_verify"):
             out = self._verify_fn(kb)(
@@ -1323,6 +1644,12 @@ class ServingEngine:
         dt = time.perf_counter() - t0
         self._verify_wall += dt
         self.decode_wall += dt
+        if armed:
+            self._prog_note(f"verify_{kb}", dt)
+            self.tracer.record("spec_verify", t_vf0, self._now(now),
+                               trace_id=self._iter_trace(),
+                               program=f"verify_{kb}",
+                               n_slots=len(active_slots))
         self.decode_steps += 1
         self._active_slot_iterations += len(active_slots)
         reg = self.telemetry

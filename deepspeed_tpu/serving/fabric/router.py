@@ -77,7 +77,8 @@ class _Tracked:
 
     __slots__ = ("request", "user_cb", "committed", "committed_times",
                  "first_token_time", "retries", "failovers", "not_before",
-                 "crash_t", "replica", "dispatch_t", "seq")
+                 "crash_t", "replica", "dispatch_t", "seq", "trace_id",
+                 "root_span", "queued_t", "failover_span")
 
     def __init__(self, request: Request, seq: int):
         self.request = request
@@ -92,6 +93,15 @@ class _Tracked:
         self.replica: Optional[str] = None     # current assignment
         self.dispatch_t: Optional[float] = None
         self.seq = seq
+        # span-graph context (ISSUE 11): the router owns the ROOT span
+        # of every request it tracks; replica engines' spans link under
+        # it via the trace fields _wrap() stamps on the engine-level
+        # Request — so a failover's survivor spans land in the SAME
+        # trace as the original attempt's
+        self.trace_id: Optional[str] = None
+        self.root_span = None            # open Span when tracing armed
+        self.queued_t: float = 0.0       # router_queue span start
+        self.failover_span = None        # open crash -> re-dispatch span
 
 
 class FabricRouter:
@@ -130,6 +140,14 @@ class FabricRouter:
     time_fn: clock (virtual in tests); defaults to time.monotonic.
     telemetry: like ServingEngine — True = global registry, a
         MetricsRegistry = private, False/None = bare.
+    tracer: span-graph tracer (ISSUE 11), or None (default) for
+        untraced routing. Arm the REPLICA engines with the same tracer:
+        the router owns each request's root span and stamps
+        router-side spans (router_queue waits, per-replica dispatch
+        attempts, failover gaps), while trace context propagated on the
+        dispatched Request makes the engines' lifecycle spans — on
+        whichever replica, across failovers — children of that same
+        trace.
     """
 
     def __init__(self, replicas: Sequence[Replica], *,
@@ -147,7 +165,7 @@ class FabricRouter:
                  retry_jitter: float = 0.0,
                  request_timeout_s: Optional[float] = None,
                  time_fn: Optional[Callable[[], float]] = None,
-                 telemetry=True, seed: int = 0):
+                 telemetry=True, seed: int = 0, tracer=None):
         if not replicas:
             raise ValueError("fabric needs at least one replica")
         names = [r.name for r in replicas]
@@ -207,6 +225,7 @@ class FabricRouter:
             self.telemetry = get_registry()
         else:
             self.telemetry = telemetry or None
+        self.tracer = tracer
         log_dist(f"FabricRouter: replicas={names} max_queue={max_queue} "
                  f"hb={heartbeat_interval_s}s timeout={request_timeout_s}",
                  ranks=[0])
@@ -273,6 +292,16 @@ class FabricRouter:
             self._finish_shed(victim, now, "shed_overload")
         tr = _Tracked(request, self._seq)
         self._seq += 1
+        if self.tracer is not None:
+            # the router owns the root span: one trace per request for
+            # its WHOLE fabric lifetime, failovers included
+            root = self.tracer.begin(
+                "request", t=request.arrival_time, rid=request.rid,
+                priority=request.priority,
+                prompt_len=len(request.prompt))
+            tr.trace_id = root.trace_id
+            tr.root_span = root
+            tr.queued_t = max(request.arrival_time, 0.0)
         self._queue.append(tr)
         self._gauge("fabric/queue_depth", len(self._queue))
 
@@ -297,6 +326,18 @@ class FabricRouter:
             self._count("fabric/rejected_requests")
         else:
             self._count("fabric/failed_requests")
+        if self.tracer is not None and tr.root_span is not None:
+            if tr.failover_span is None:
+                # (same double-count guard as _dispatch: an open
+                # failover span already covers this wait)
+                self.tracer.record("router_queue", tr.queued_t, now,
+                                   trace_id=tr.trace_id,
+                                   parent_id=tr.root_span.span_id,
+                                   outcome=reason)
+            self.tracer.end(tr.failover_span, t=now, outcome=reason)
+            tr.failover_span = None
+            self.tracer.end(tr.root_span, t=now, finish_reason=reason,
+                            failovers=tr.failovers)
         self._done.append(res)
         return res
 
@@ -438,6 +479,7 @@ class FabricRouter:
         attempt: committed tokens ride along (the resume context), the
         retry budget is charged, and backoff gates the re-dispatch."""
         self._inflight.pop(tr.request.rid, None)
+        from_replica = tr.replica
         tr.replica = None
         tr.dispatch_t = None
         tr.retries += 1
@@ -446,6 +488,17 @@ class FabricRouter:
             tr.crash_t = now
             self.failovers += 1
             self._count("fabric/failovers")
+        if self.tracer is not None and tr.root_span is not None:
+            tr.queued_t = now
+            if crashed and tr.failover_span is None:
+                # replica death -> re-dispatched on a survivor: its own
+                # phase in the request's critical path (closed by the
+                # next successful dispatch). The survivor's engine spans
+                # join this SAME trace via _wrap's context fields.
+                tr.failover_span = self.tracer.begin(
+                    "failover", trace_id=tr.trace_id,
+                    parent_id=tr.root_span.span_id, t=now,
+                    from_replica=from_replica)
         if tr.retries > self.retry_max:
             self._finish_shed(tr, now, "failed")
             return
@@ -557,6 +610,27 @@ class FabricRouter:
             tr.dispatch_t = now
             self.dispatches += 1
             self._count("fabric/dispatches")
+            if self.tracer is not None and tr.root_span is not None:
+                if tr.failover_span is None:
+                    self.tracer.record(
+                        "router_queue", tr.queued_t, now,
+                        trace_id=tr.trace_id,
+                        parent_id=tr.root_span.span_id,
+                        replica=name, attempt=tr.retries + 1)
+                else:
+                    # a crash-requeued attempt's wait IS the failover
+                    # span (crash -> re-dispatch): a router_queue span
+                    # over the same interval would double-count the
+                    # queue phase. Keep the replica/attempt attrs on a
+                    # zero-length marker at the dispatch instant so the
+                    # attempt sequence stays reconstructable.
+                    self.tracer.record(
+                        "router_queue", now, now,
+                        trace_id=tr.trace_id,
+                        parent_id=tr.root_span.span_id,
+                        replica=name, attempt=tr.retries + 1)
+                self.tracer.end(tr.failover_span, t=now, to_replica=name)
+                tr.failover_span = None
             if tr.crash_t is not None:
                 # failover latency: replica death -> work back on a
                 # healthy replica (detection + backoff + placement)
@@ -587,7 +661,14 @@ class FabricRouter:
             prompt=list(base.prompt) + list(tr.committed),
             max_new_tokens=base.max_new_tokens - len(tr.committed),
             arrival_time=base.arrival_time, priority=base.priority,
-            on_token=on_token, deadline=base.deadline)
+            on_token=on_token, deadline=base.deadline,
+            # trace context: every attempt — original or failover
+            # re-dispatch — carries the SAME trace id, parented under
+            # the router's root span, so the whole multi-replica
+            # lifecycle reconstructs as one graph
+            trace_id=tr.trace_id,
+            parent_span=(tr.root_span.span_id
+                         if tr.root_span is not None else None))
 
     def _commit(self, tr: _Tracked, tok: int) -> None:
         now = self._now()
@@ -649,6 +730,11 @@ class FabricRouter:
         else:
             self.completed += 1
             self._count("fabric/completed_requests")
+        if self.tracer is not None and tr.root_span is not None:
+            self.tracer.end(tr.root_span, t=now,
+                            finish_reason=res.finish_reason,
+                            replica=res.replica, failovers=tr.failovers,
+                            tokens=len(res.tokens))
         self._done.append(res)
 
     def _rebase_clock(self) -> None:
@@ -670,11 +756,16 @@ class FabricRouter:
                                 for n, at in self._restarting.items()}
             for tr in self._queue:
                 tr.not_before -= shift
-            for tr in self._inflight.values():
+            for tr in list(self._queue) + list(self._inflight.values()):
                 if tr.dispatch_t is not None:
                     tr.dispatch_t -= shift
                 if tr.crash_t is not None:
                     tr.crash_t -= shift
+                tr.queued_t -= shift
+                if tr.root_span is not None:
+                    tr.root_span.start -= shift
+                if tr.failover_span is not None:
+                    tr.failover_span.start -= shift
             if self.supervisor is not None:
                 self.supervisor.rebase(shift)
         self._last_hb = float("-inf")

@@ -66,7 +66,7 @@ class ReplicaSupervisor:
                  max_restart_delay_s: float = 30.0,
                  backoff_factor: float = 2.0, jitter: float = 0.0,
                  max_preemption_restarts: int = 100,
-                 rng: Optional[random.Random] = None):
+                 rng: Optional[random.Random] = None, tracer=None):
         self.max_restarts = max_restarts
         self.restart_window_s = restart_window_s
         self.restart_delay_s = restart_delay_s
@@ -76,6 +76,20 @@ class ReplicaSupervisor:
         self.max_preemption_restarts = max_preemption_restarts
         self._rng = rng or random.Random(0)
         self._records: Dict[str, _ReplicaRecord] = {}
+        # span-graph tracer (ISSUE 11): each restart decision is one
+        # closed span (failure instant -> earliest respawn instant) on a
+        # supervisor-scope trace, so fabric downtime windows line up
+        # next to the request traces in the Chrome-trace export
+        self.tracer = tracer
+        self._trace: Optional[str] = None
+
+    def _span(self, name: str, start: float, end: float, **attrs) -> None:
+        if self.tracer is None:
+            return
+        if self._trace is None:
+            self._trace = self.tracer.new_trace()
+        self.tracer.record(name, start, end, trace_id=self._trace,
+                           **attrs)
 
     def _rec(self, name: str) -> _ReplicaRecord:
         return self._records.setdefault(name, _ReplicaRecord())
@@ -138,10 +152,15 @@ class ReplicaSupervisor:
                 rec.abandoned = True
                 record_event("fabric/replica_abandoned", replica=name,
                              reason="persistent_preemption")
+                self._span("replica_abandoned", now, now, replica=name,
+                           reason="persistent_preemption")
                 return None
             rec.preemption_restarts += 1
             record_event("fabric/replica_preemption_restart", replica=name)
-            return now + self._backoff_delay(rec.consecutive_preemptions)
+            at = now + self._backoff_delay(rec.consecutive_preemptions)
+            self._span("replica_restart_backoff", now, at, replica=name,
+                       restartable=True)
+            return at
         rec.consecutive_preemptions = 0
         if (self.restart_window_s is not None
                 and rec.last_failure_t is not None
@@ -162,12 +181,16 @@ class ReplicaSupervisor:
             rec.abandoned = True
             record_event("fabric/replica_abandoned", replica=name,
                          reason="restart_budget")
+            self._span("replica_abandoned", now, now, replica=name,
+                       reason="restart_budget")
             return None
         rec.consecutive += 1
         rec.restarts += 1
         delay = self._backoff_delay(rec.consecutive)
         record_event("fabric/replica_restart", replica=name,
                      restart=spent, delay_s=delay)
+        self._span("replica_restart_backoff", now, now + delay,
+                   replica=name, restart=spent)
         logger.warning(
             f"fabric supervisor: replica {name} crashed; restart "
             f"{spent}/{self.max_restarts} in window, backoff {delay:.2f}s "

@@ -55,6 +55,17 @@ class Request:
     # sheds a request whose deadline expired while still queued —
     # before it wastes prefill compute it can no longer make use of.
     deadline: Optional[float] = None
+    # distributed trace context (ISSUE 11): set by whoever OWNS the
+    # request's root span (the fabric router, or the engine at submit
+    # when standalone). A failover re-dispatch carries the SAME
+    # trace_id, so the survivor replica's spans link under the original
+    # trace — these two fields are exactly what a cross-process wire
+    # protocol would propagate. None + an armed tracer = the engine
+    # allocates a fresh trace (and owns the root span).
+    trace_id: Optional[str] = dataclasses.field(
+        default=None, repr=False, compare=False)
+    parent_span: Optional[str] = dataclasses.field(
+        default=None, repr=False, compare=False)
 
 
 @dataclasses.dataclass

@@ -23,6 +23,9 @@ recompile counter, finished-requests/sec). Overhead is budgeted at 2% and
 measured by ``bench.py``'s ``observability_overhead`` section.
 """
 
+from deepspeed_tpu.telemetry.attribution import (abstract_args,
+                                                 attribution_table,
+                                                 program_cost, roofline_row)
 from deepspeed_tpu.telemetry.config import TelemetryConfig, get_telemetry_config
 from deepspeed_tpu.telemetry.mfu import mfu, peak_flops_per_sec
 from deepspeed_tpu.telemetry.registry import (
@@ -36,6 +39,9 @@ from deepspeed_tpu.telemetry.registry import (
     reset_registry,
 )
 from deepspeed_tpu.telemetry.sink import JsonlSink, read_jsonl
+from deepspeed_tpu.telemetry.spans import (PHASE_OF_SPAN, PHASES, Span,
+                                           SpanTracer, aggregate_phase_stats,
+                                           phase_breakdown, trace_summaries)
 from deepspeed_tpu.telemetry.trace import annotate, trace
 
 __all__ = [
@@ -45,14 +51,25 @@ __all__ = [
     "Histogram",
     "JsonlSink",
     "MetricsRegistry",
+    "PHASES",
+    "PHASE_OF_SPAN",
+    "Span",
+    "SpanTracer",
     "TelemetryConfig",
+    "abstract_args",
+    "aggregate_phase_stats",
     "annotate",
+    "attribution_table",
     "get_registry",
     "get_telemetry_config",
     "mfu",
     "peak_flops_per_sec",
+    "phase_breakdown",
+    "program_cost",
     "read_jsonl",
     "record_event",
     "reset_registry",
+    "roofline_row",
     "trace",
+    "trace_summaries",
 ]

@@ -1,0 +1,150 @@
+"""Per-program roofline attribution (ISSUE 11).
+
+The PR 3 MFU gauge says "46.6% of peak"; this module says WHICH compiled
+program is responsible and whether it is compute- or memory-bound. For
+every jitted program a serving engine registers (prefill buckets,
+decode, speculative verify, swap, block-copy) plus the fused train
+step, it extracts XLA's own post-fusion cost model — flops and bytes
+accessed — via ``lower().compile().cost_analysis()`` (the PR 3 MFU
+numerator, generalized), joins it with host-observed per-program wall
+time, and places each program on the classic roofline
+(Williams et al., 2009):
+
+    attainable_flops/s = min(peak_flops/s, intensity * peak_bytes/s)
+
+so ``achieved_vs_attainable`` is per-program MFU against the bound that
+actually binds it — a decode step at intensity 2 flops/byte is judged
+against the HBM roof, not the matmul peak.
+
+Cost probing reuses the PR 3 discipline: one extra lower+compile per
+program, shapes captured as ``jax.ShapeDtypeStruct`` abstractions at
+warmup (no live buffers retained), probed lazily and cached — never on
+the serving hot path. Peaks come from the accelerator layer
+(``peak_tflops()`` / ``peak_hbm_gbps()``, ``DSTPU_PEAK_TFLOPS`` /
+``DSTPU_PEAK_HBM_GBPS`` overrides); where a peak is unknown (CPU test
+runs) the table still reports flops/bytes/intensity/achieved and leaves
+the attainable columns None.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Tuple
+
+
+def abstract_args(args) -> Tuple:
+    """Shape/dtype abstraction of a program's runtime operands —
+    retainable without keeping device buffers alive, and accepted by
+    ``jit_fn.lower`` for AOT cost probing."""
+    import jax
+    import numpy as np
+
+    def absify(x):
+        a = np.asarray(x) if not hasattr(x, "dtype") or not hasattr(
+            x, "shape") else x
+        return jax.ShapeDtypeStruct(tuple(a.shape), a.dtype)
+
+    return tuple(jax.tree_util.tree_map(absify, a) for a in args)
+
+
+def program_cost(fn, args) -> Optional[Dict[str, float]]:
+    """XLA cost_analysis of ``fn`` lowered at ``args`` (ShapeDtypeStructs
+    or concrete arrays): ``{"flops": ..., "bytes_accessed": ...}``.
+    None when the backend cannot answer (stripped builds) — attribution
+    is diagnostics and must never take down the run."""
+    try:
+        lowered = fn.lower(*args)
+        ca = lowered.compile().cost_analysis()
+        if isinstance(ca, list):
+            ca = ca[0] if ca else {}
+        ca = ca or {}
+        return {"flops": float(ca.get("flops", 0.0) or 0.0),
+                "bytes_accessed": float(ca.get("bytes accessed", 0.0)
+                                        or 0.0)}
+    except Exception:
+        return None
+
+
+def roofline_row(flops: float, bytes_accessed: float, *,
+                 wall_s: Optional[float] = None, calls: int = 0,
+                 peak_flops: Optional[float] = None,
+                 peak_bytes_per_sec: Optional[float] = None) -> dict:
+    """One attribution-table row. ``wall_s`` is the mean host-observed
+    wall per call (None = program never timed); peaks in flops/s and
+    bytes/s. ``bound`` names the binding roof at this intensity."""
+    intensity = (flops / bytes_accessed) if bytes_accessed else None
+    row = {
+        "flops": flops,
+        "bytes_accessed": bytes_accessed,
+        "intensity_flops_per_byte": (round(intensity, 3)
+                                     if intensity is not None else None),
+        "calls": int(calls),
+        "mean_wall_ms": (round(wall_s * 1e3, 4)
+                         if wall_s is not None else None),
+        "achieved_tflops": (round(flops / wall_s / 1e12, 4)
+                            if wall_s else None),
+        "achieved_gbps": (round(bytes_accessed / wall_s / 1e9, 3)
+                          if wall_s else None),
+        "attainable_tflops": None,
+        "achieved_vs_attainable": None,
+        "bound": None,
+    }
+    if intensity is not None and peak_flops and peak_bytes_per_sec:
+        attainable = min(peak_flops, intensity * peak_bytes_per_sec)
+        row["attainable_tflops"] = round(attainable / 1e12, 4)
+        row["bound"] = ("compute" if attainable >= peak_flops
+                        else "memory")
+        if wall_s:
+            row["achieved_vs_attainable"] = round(
+                (flops / wall_s) / attainable, 4)
+    elif intensity is not None and peak_flops and wall_s:
+        # no bandwidth table (e.g. override-only setups): fall back to
+        # plain MFU against the compute roof
+        row["attainable_tflops"] = round(peak_flops / 1e12, 4)
+        row["bound"] = "compute"
+        row["achieved_vs_attainable"] = round(
+            (flops / wall_s) / peak_flops, 4)
+    return row
+
+
+def accelerator_peaks() -> Tuple[Optional[float], Optional[float]]:
+    """(peak flops/s, peak bytes/s) of the current accelerator, either
+    None when unknown."""
+    from deepspeed_tpu.accelerator import get_accelerator
+
+    acc = get_accelerator()
+    tf = acc.peak_tflops()
+    bw = acc.peak_hbm_gbps()
+    return (tf * 1e12 if tf else None), (bw * 1e9 if bw else None)
+
+
+def attribution_table(programs: Dict[str, Tuple], *,
+                      walls: Optional[Dict[str, Tuple[float, int]]] = None,
+                      cache: Optional[Dict[str, dict]] = None) -> dict:
+    """Roofline table over named programs.
+
+    ``programs``: name -> (jit_fn, abstract_arg_tuple) — the registry a
+    serving engine captured at warmup. ``walls``: name -> (total wall
+    seconds, calls) host-observed. ``cache``: optional dict the caller
+    owns; cost probes (one lower+compile each) are memoized into it so
+    repeated reports are free."""
+    peak_flops, peak_bw = accelerator_peaks()
+    walls = walls or {}
+    out: Dict[str, dict] = {}
+    for name in sorted(programs):
+        fn, args = programs[name]
+        cost = None
+        if cache is not None and name in cache:
+            cost = cache[name]
+        if cost is None:
+            cost = program_cost(fn, args)
+            if cache is not None and cost is not None:
+                cache[name] = cost
+        if cost is None:
+            out[name] = {"error": "cost_analysis unavailable"}
+            continue
+        total_s, calls = walls.get(name, (0.0, 0))
+        out[name] = roofline_row(
+            cost["flops"], cost["bytes_accessed"],
+            wall_s=(total_s / calls) if calls else None, calls=calls,
+            peak_flops=peak_flops, peak_bytes_per_sec=peak_bw)
+    return out

@@ -15,6 +15,13 @@ Keys:
   cost_analysis  — allow a one-time XLA cost_analysis of the compiled
                    train step for MFU flops (an extra lower+compile at the
                    first fence; analytic model flops are the fallback).
+  spans          — arm the span-graph tracer (ISSUE 11): step-window,
+                   sentinel-check, recovery and checkpoint spans stamped
+                   host-side at the fences that already exist (zero extra
+                   device syncs; default off).
+  spans_path     — JSONL file for span records; empty reuses jsonl_path's
+                   sink (spans interleave with snapshots/events in one
+                   file — telemetry_report.py renders both).
 """
 
 from __future__ import annotations
@@ -27,6 +34,8 @@ class TelemetryConfig(DeepSpeedConfigModel):
     jsonl_path: str = ""
     sync_interval: int = 50
     cost_analysis: bool = True
+    spans: bool = False
+    spans_path: str = ""
 
 
 def get_telemetry_config(param_dict: dict) -> TelemetryConfig:

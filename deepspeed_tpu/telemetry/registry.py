@@ -230,6 +230,49 @@ class MetricsRegistry:
         except Exception:
             pass
 
+    # ---------------------------------------------------------- prometheus
+    def to_prometheus(self, prefix: str = "dstpu") -> str:
+        """Render the live registry in the Prometheus text exposition
+        format (ISSUE 11 satellite) — the seam the cross-process
+        fabric's scrape endpoint will serve. Metric names are sanitized
+        (``serving/ttft_ms`` -> ``dstpu_serving_ttft_ms``); counters
+        gain the conventional ``_total`` suffix; histograms emit the
+        full CUMULATIVE bucket series (+Inf included) plus ``_sum`` and
+        ``_count``, so Prometheus-side ``histogram_quantile`` sees the
+        same fixed buckets the in-process percentiles use."""
+        import re
+
+        def san(name: str) -> str:
+            out = re.sub(r"[^a-zA-Z0-9_:]", "_", name)
+            return f"{prefix}_{out}" if prefix else out
+
+        lines: List[str] = []
+        with self._lock:
+            counters = list(self._counters.values())
+            gauges = list(self._gauges.values())
+            hists = list(self._histograms.values())
+        for c in sorted(counters, key=lambda m: m.name):
+            n = san(c.name) + "_total"
+            lines.append(f"# TYPE {n} counter")
+            lines.append(f"{n} {c.value}")
+        for g in sorted(gauges, key=lambda m: m.name):
+            if g.value is None:
+                continue
+            n = san(g.name)
+            lines.append(f"# TYPE {n} gauge")
+            lines.append(f"{n} {g.value}")
+        for h in sorted(hists, key=lambda m: m.name):
+            n = san(h.name)
+            lines.append(f"# TYPE {n} histogram")
+            cum = 0
+            for bound, cnt in zip(h.buckets, h.counts):
+                cum += cnt
+                lines.append(f'{n}_bucket{{le="{bound}"}} {cum}')
+            lines.append(f'{n}_bucket{{le="+Inf"}} {h.count}')
+            lines.append(f"{n}_sum {h.sum}")
+            lines.append(f"{n}_count {h.count}")
+        return "\n".join(lines) + ("\n" if lines else "")
+
     def reset(self) -> None:
         with self._lock:
             self._counters.clear()

@@ -1,0 +1,164 @@
+#!/usr/bin/env python
+"""Metric-name drift lint: README docs vs telemetry call sites.
+
+Usage:
+    python scripts/check_metric_names.py [--list]
+
+PR 3's contract is that every counter/gauge/histogram/event the code
+emits is documented in the README (operators grep the README, not the
+source), and PRs 4-11 each grew the namespace — by hand, in both
+places. This lint (ISSUE 11 satellite) makes the contract mechanical:
+
+  * CODE side: an AST walk over ``deepspeed_tpu/`` collects the first
+    string argument of every ``counter(...)``, ``gauge(...)``,
+    ``histogram(...)``, ``event(...)``, ``record_event(...)`` and the
+    router's ``_count/_gauge/_observe`` wrappers. f-strings become
+    wildcard patterns (``f"serving/ttft_ms/p{c}"`` ->
+    ``serving/ttft_ms/p*``).
+  * DOC side: every backticked token in README.md that looks like a
+    metric name (``<prefix>/...`` for the known prefixes), with
+    ``<placeholder>`` segments normalized to ``*``.
+
+Failure modes (exit 1, both listed):
+  * UNDOCUMENTED — emitted by code, absent from the README;
+  * STALE       — documented in the README, emitted by nothing.
+
+Wired into tier-1 via tests/unit/telemetry/test_spans.py and
+scripts/run_tier1.sh. Stdlib only.
+"""
+
+from __future__ import annotations
+
+import argparse
+import ast
+import fnmatch
+import os
+import re
+import sys
+
+PREFIXES = ("train", "serving", "fabric", "resilience", "device",
+            "checkpoint", "elastic")
+_NAME_RE = re.compile(
+    r"^(?:%s)/[A-Za-z0-9_][A-Za-z0-9_/<>*-]*$" % "|".join(PREFIXES))
+# methods whose first string argument is a metric/event name
+_METHODS = {"counter", "gauge", "histogram", "event", "record_event",
+            "_count", "_gauge", "_observe"}
+
+
+def _pattern_of(node) -> str | None:
+    """Metric-name pattern of a str/f-string AST node (formatted pieces
+    become '*'), or None for non-strings."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    if isinstance(node, ast.JoinedStr):
+        parts = []
+        for v in node.values:
+            if isinstance(v, ast.Constant) and isinstance(v.value, str):
+                parts.append(v.value)
+            else:
+                parts.append("*")
+        return "".join(parts)
+    return None
+
+
+def code_names(root: str) -> dict:
+    """{pattern: [file:line, ...]} over every telemetry call site."""
+    out: dict = {}
+    for dirpath, dirnames, filenames in os.walk(root):
+        dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+        for fn in filenames:
+            if not fn.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, fn)
+            try:
+                with open(path, "r", encoding="utf-8") as f:
+                    tree = ast.parse(f.read(), filename=path)
+            except SyntaxError:
+                continue
+            for node in ast.walk(tree):
+                if not isinstance(node, ast.Call) or not node.args:
+                    continue
+                func = node.func
+                name = (func.attr if isinstance(func, ast.Attribute)
+                        else func.id if isinstance(func, ast.Name)
+                        else None)
+                if name not in _METHODS:
+                    continue
+                pat = _pattern_of(node.args[0])
+                if pat is None or not _NAME_RE.match(pat):
+                    continue
+                out.setdefault(pat, []).append(
+                    f"{os.path.relpath(path, os.path.dirname(root))}:"
+                    f"{node.lineno}")
+    return out
+
+
+def readme_names(readme_path: str) -> dict:
+    """{pattern: [line_no, ...]} over backticked metric-like tokens,
+    ``<placeholder>`` segments normalized to ``*``."""
+    out: dict = {}
+    with open(readme_path, "r", encoding="utf-8") as f:
+        for i, line in enumerate(f, 1):
+            for tok in re.findall(r"`([^`]+)`", line):
+                if not _NAME_RE.match(tok):
+                    continue
+                pat = re.sub(r"<[^>]*>", "*", tok)
+                out.setdefault(pat, []).append(i)
+    return out
+
+
+def _covered(name: str, patterns) -> bool:
+    """A name (possibly itself a wildcard pattern) is covered when any
+    pattern on the other side matches it — either direction, so
+    ``serving/ttft_ms/p*`` (code f-string) pairs with
+    ``serving/ttft_ms/p<class>`` (doc placeholder)."""
+    for p in patterns:
+        if p == name or fnmatch.fnmatchcase(name, p) \
+                or fnmatch.fnmatchcase(p, name):
+            return True
+    return False
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--root", default=None,
+                    help="repo root (default: this script's parent)")
+    ap.add_argument("--list", action="store_true",
+                    help="print every name on both sides")
+    args = ap.parse_args(argv)
+    root = args.root or os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__)))
+    code = code_names(os.path.join(root, "deepspeed_tpu"))
+    docs = readme_names(os.path.join(root, "README.md"))
+    if args.list:
+        print("== code ==")
+        for n in sorted(code):
+            print(f"  {n}  ({code[n][0]})")
+        print("== README ==")
+        for n in sorted(docs):
+            print(f"  {n}  (line {docs[n][0]})")
+    undocumented = {n: sites for n, sites in code.items()
+                    if not _covered(n, docs)}
+    stale = {n: lines for n, lines in docs.items()
+             if not _covered(n, code)}
+    rc = 0
+    if undocumented:
+        rc = 1
+        print("UNDOCUMENTED metric names (emitted by code, missing from "
+              "README.md):", file=sys.stderr)
+        for n in sorted(undocumented):
+            print(f"  {n}  ({undocumented[n][0]})", file=sys.stderr)
+    if stale:
+        rc = 1
+        print("STALE metric names (documented in README.md, emitted by "
+              "nothing):", file=sys.stderr)
+        for n in sorted(stale):
+            print(f"  {n}  (README line {stale[n][0]})", file=sys.stderr)
+    if rc == 0:
+        print(f"metric names OK: {len(code)} code name(s) <-> "
+              f"{len(docs)} documented name(s)")
+    return rc
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -35,4 +35,15 @@ grep -qs "def test_" tests/unit/serving/test_fabric.py || { echo "tier-1: fabric
 # deterministic dataloader resume and SDC-audit invariants ride
 # `-m 'not slow'` through tests/unit/runtime/test_resilience.py
 grep -qs "def test_" tests/unit/runtime/test_resilience.py || { echo "tier-1: resilience tests missing"; exit 1; }
+# likewise the tracing suite (marker `tracing`): span-graph lifecycle
+# reconstruction incl. failover trace linking, armed-run greedy
+# bit-identity, Chrome-trace validity and roofline attribution ride
+# `-m 'not slow'` through tests/unit/serving/test_tracing.py and
+# tests/unit/telemetry/test_spans.py
+grep -qs "def test_" tests/unit/serving/test_tracing.py || { echo "tier-1: tracing tests missing"; exit 1; }
+grep -qs "def test_" tests/unit/telemetry/test_spans.py || { echo "tier-1: span tests missing"; exit 1; }
+# metric-name drift lint (ISSUE 11 satellite): README metric/event
+# names must exactly cover the counter/gauge/histogram/record_event
+# call sites — fails on undocumented or stale names
+python scripts/check_metric_names.py || { echo "tier-1: metric-name drift"; exit 1; }
 exit $rc

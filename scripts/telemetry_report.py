@@ -16,6 +16,12 @@ Sections:
   histograms  — count/mean/p50/p95/p99/max per latency histogram
   scalars     — per-tag last/min/max/mean over the monitor scalar stream
   events      — occurrence counts per event name
+  spans       — span-graph critical paths (ISSUE 11): per-request
+                p50/p95 time + fraction in queue/prefill/decode/
+                swapped/failover, reconstructed from "span" records
+  attribution — per-program roofline (ISSUE 11): flops/bytes/intensity,
+                achieved vs attainable TFLOPs and the binding roof, from
+                "attribution" records
 
 ``--json`` emits the aggregate as one JSON object instead of tables
 (machine-readable; the smoke test uses it). Stdlib only — runs anywhere.
@@ -59,10 +65,16 @@ def aggregate(records, n_bad_lines=0):
     last_snapshot = None
     scalars = OrderedDict()   # tag -> stats dict
     events = OrderedDict()    # name -> {count, last_fields}
+    spans = []                # raw span records, arrival order
+    attributions = OrderedDict()   # scope -> last program table
     for rec in records:
         kind = rec.get("kind")
         if kind == "snapshot":
             last_snapshot = rec
+        elif kind == "span":
+            spans.append(rec)
+        elif kind == "attribution":
+            attributions[rec.get("scope", "?")] = rec.get("programs", {})
         elif kind == "scalar":
             tag = rec.get("tag", "?")
             try:
@@ -99,9 +111,80 @@ def aggregate(records, n_bad_lines=0):
         "slo": _slo_summary(metrics),
         "fabric": _fabric_summary(metrics),
         "resilience": _resilience_summary(metrics),
+        "spans": _spans_summary(spans),
+        "attribution": _attribution_summary(attributions),
         "n_records": len(records),
         "n_bad_lines": n_bad_lines,
     }
+
+
+def _spans_summary(spans):
+    """Derived span-graph view (ISSUE 11): per-request critical-path
+    breakdown — p50/p95 of absolute time and of the FRACTION of each
+    request's life spent in queue/prefill/decode/swapped/failover —
+    plus per-span-name counts. Stdlib reimplementation of
+    telemetry.spans.trace_summaries/aggregate_phase_stats so the report
+    stays runnable anywhere. Empty dict when the run recorded no
+    spans."""
+    if not spans:
+        return {}
+    phase_of = {"queue_wait": "queue", "router_queue": "queue",
+                "prefill_chunk": "prefill", "decode_segment": "decode",
+                "swap_out": "swapped", "swapped": "swapped",
+                "swap_in": "swapped", "failover": "failover"}
+    phases = ("queue", "prefill", "decode", "swapped", "failover")
+    by_name = OrderedDict()
+    by_trace = OrderedDict()
+    for s in spans:
+        name = s.get("name", "?")
+        by_name[name] = by_name.get(name, 0) + 1
+        by_trace.setdefault(s.get("trace"), []).append(s)
+    requests = []
+    for group in by_trace.values():
+        roots = [s for s in group if s.get("name") == "request"
+                 and s.get("end") is not None]
+        if not roots:
+            continue
+        root = roots[0]
+        total = max(root["end"] - root.get("start", 0.0), 0.0)
+        ph = {p: 0.0 for p in phases}
+        for s in group:
+            p = phase_of.get(s.get("name"))
+            if p is None or s.get("end") is None:
+                continue
+            ph[p] += max(s["end"] - s.get("start", 0.0), 0.0)
+        requests.append((total, ph))
+    out = {"n_spans": len(spans), "span_counts": dict(by_name),
+           "n_requests": len(requests)}
+    if not requests:
+        return out
+
+    def pct(xs, p):
+        xs = sorted(xs)
+        return xs[min(int(len(xs) * p), len(xs) - 1)]
+
+    totals = [t for t, _ in requests]
+    out["total_ms"] = {"p50": round(pct(totals, 0.5) * 1e3, 3),
+                       "p95": round(pct(totals, 0.95) * 1e3, 3)}
+    for p in phases:
+        ab = [ph[p] for _, ph in requests]
+        if not any(ab):
+            continue
+        fr = [(ph[p] / t if t > 0 else 0.0) for t, ph in requests]
+        out[p] = {"frac_p50": round(pct(fr, 0.5), 4),
+                  "frac_p95": round(pct(fr, 0.95), 4),
+                  "ms_p50": round(pct(ab, 0.5) * 1e3, 3),
+                  "ms_p95": round(pct(ab, 0.95) * 1e3, 3)}
+    return out
+
+
+def _attribution_summary(attributions):
+    """Per-program roofline tables (ISSUE 11), keyed by scope (serving
+    / train): the last "attribution" record per scope wins — it carries
+    the most wall-time context. Empty dict when the run recorded
+    none."""
+    return {scope: table for scope, table in attributions.items()
+            if table}
 
 
 def _speculation_summary(metrics):
@@ -328,6 +411,28 @@ def render(agg):
            [(k, _fmt(v) if not isinstance(v, dict) else
              " ".join(f"{kk}={_fmt(vv)}" for kk, vv in v.items()))
             for k, v in agg.get("resilience", {}).items()], out)
+    _table("spans", ("metric", "value"),
+           [(k, _fmt(v) if not isinstance(v, dict) else
+             " ".join(f"{kk}={_fmt(vv)}" for kk, vv in v.items()))
+            for k, v in agg.get("spans", {}).items()], out)
+    for scope, table in agg.get("attribution", {}).items():
+        arows = []
+        for name, row in sorted(table.items()):
+            if not isinstance(row, dict):
+                continue
+            arows.append((name, _fmt(row.get("flops")),
+                          _fmt(row.get("bytes_accessed")),
+                          _fmt(row.get("intensity_flops_per_byte")),
+                          _fmt(row.get("calls")),
+                          _fmt(row.get("mean_wall_ms")),
+                          _fmt(row.get("achieved_tflops")),
+                          _fmt(row.get("attainable_tflops")),
+                          _fmt(row.get("achieved_vs_attainable")),
+                          _fmt(row.get("bound"))))
+        _table(f"attribution ({scope})",
+               ("program", "flops", "bytes", "flops/byte", "calls",
+                "wall_ms", "achieved_tf", "attainable_tf",
+                "ach/att", "bound"), arows, out)
     erows = [(k, e["count"],
               json.dumps(e["last"], default=str)[:60])
              for k, e in agg["events"].items()]

@@ -299,7 +299,8 @@ _LATE_MODULES = _OBSERVABILITY_MODULES + (
     "unit/serving/test_prefix_cache",
     "unit/serving/test_slo",
     "unit/serving/test_fabric",
-    "unit/runtime/test_resilience",)
+    "unit/runtime/test_resilience",
+    "unit/serving/test_tracing",)
 
 
 def pytest_collection_modifyitems(config, items):

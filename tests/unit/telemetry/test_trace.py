@@ -1,0 +1,120 @@
+"""telemetry/trace.py failure-path tests (ISSUE 11 satellite).
+
+The profiler capture wrapper promises to DEGRADE, never crash: a
+jax/backend that cannot start a trace yields a warning and the traced
+block still runs; ``host_tracer_level`` silently falls back on older
+jax builds without per-trace ProfileOptions. Neither path was covered —
+these tests pin both with a monkeypatched ``jax.profiler``.
+"""
+
+import contextlib
+import logging
+
+import pytest
+
+from deepspeed_tpu.telemetry import trace as trace_ctx
+
+pytestmark = [pytest.mark.tracing, pytest.mark.observability,
+              pytest.mark.quick]
+
+
+@contextlib.contextmanager
+def _capture_warnings():
+    """The framework logger does not propagate to root, so caplog never
+    sees it — attach a handler directly."""
+    from deepspeed_tpu.utils.logging import logger
+
+    records = []
+    handler = logging.Handler()
+    handler.emit = records.append
+    logger.addHandler(handler)
+    try:
+        yield records
+    finally:
+        logger.removeHandler(handler)
+
+
+def test_trace_degrades_to_noop_when_profiler_unavailable(
+        monkeypatch, tmp_path):
+    """start_trace raising (stripped jaxlib, busy profiler port) must
+    not take down the run being traced: warn once, run untraced, and
+    never call stop_trace for a trace that never started."""
+    import jax
+
+    calls = {"stop": 0}
+
+    def boom(path, **kw):
+        raise RuntimeError("profiler backend unavailable")
+
+    def stop():
+        calls["stop"] += 1
+
+    monkeypatch.setattr(jax.profiler, "start_trace", boom)
+    monkeypatch.setattr(jax.profiler, "stop_trace", stop)
+    ran = {}
+    with _capture_warnings() as records:
+        with trace_ctx(str(tmp_path / "t")) as p:
+            ran["body"] = True
+            ran["path"] = p
+    assert ran["body"] and ran["path"] == str(tmp_path / "t")
+    assert calls["stop"] == 0          # nothing started -> nothing stopped
+    assert any("running untraced" in r.getMessage() for r in records)
+
+
+def test_trace_stop_failure_warns_not_raises(monkeypatch, tmp_path):
+    import jax
+
+    started = {}
+    monkeypatch.setattr(jax.profiler, "start_trace",
+                        lambda path, **kw: started.setdefault(
+                            "path", path))
+    monkeypatch.setattr(
+        jax.profiler, "stop_trace",
+        lambda: (_ for _ in ()).throw(RuntimeError("flush failed")))
+    with _capture_warnings() as records:
+        with trace_ctx(str(tmp_path / "t")):
+            pass
+    assert started["path"] == str(tmp_path / "t")
+    assert any("stop_trace failed" in r.getMessage() for r in records)
+
+
+def test_host_tracer_level_forwarded_when_supported(monkeypatch,
+                                                    tmp_path):
+    import jax
+
+    seen = {}
+
+    def start(path, **kw):
+        seen["path"] = path
+        seen["kwargs"] = kw
+
+    monkeypatch.setattr(jax.profiler, "start_trace", start)
+    monkeypatch.setattr(jax.profiler, "stop_trace", lambda: None)
+    if not hasattr(jax.profiler, "ProfileOptions"):
+        pytest.skip("this jax has no ProfileOptions (fallback test "
+                    "covers it)")
+    with trace_ctx(str(tmp_path / "t"), host_tracer_level=3):
+        pass
+    opts = seen["kwargs"].get("profiler_options")
+    assert opts is not None and opts.host_tracer_level == 3
+
+
+def test_host_tracer_level_fallback_on_older_jax(monkeypatch, tmp_path):
+    """Older jax (< 0.4.31) has no jax.profiler.ProfileOptions: the
+    wrapper must start the trace WITHOUT profiler_options instead of
+    raising — the level is best-effort."""
+    import jax
+
+    seen = {}
+
+    def start(path, **kw):
+        seen["path"] = path
+        seen["kwargs"] = kw
+
+    monkeypatch.setattr(jax.profiler, "start_trace", start)
+    monkeypatch.setattr(jax.profiler, "stop_trace", lambda: None)
+    monkeypatch.delattr(jax.profiler, "ProfileOptions", raising=False)
+    with trace_ctx(str(tmp_path / "t"), host_tracer_level=2) as p:
+        assert p == str(tmp_path / "t")
+    assert seen["path"] == str(tmp_path / "t")
+    assert "profiler_options" not in seen["kwargs"]
