@@ -46,6 +46,12 @@ class QuantizationConfig(DeepSpeedConfigModel):
     enabled: bool = False
     bits: int = 8
     group_size: int = 64
+    # ISSUE 12 satellite: also quantize the TIED embedding / lm-head
+    # (per-vocab-row scales) — at 125M the tied table is ~77 MB of the
+    # 249 MB weight stream and was deliberately left unquantized until
+    # the logit-parity gate existed (models/base.embed_tokens /
+    # tied_logits; test_kv_quant pins argmax parity + logit error)
+    quantize_embedding: bool = False
 
 
 class DeepSpeedInferenceConfig(DeepSpeedConfigModel):

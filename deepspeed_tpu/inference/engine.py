@@ -80,6 +80,15 @@ class InferenceEngine:
                     f"(got {config.quant.bits})")
             log_dist("weight quantization uses per-layer per-output-column "
                      "scales; quant.group_size is ignored", ranks=[0])
+        elif getattr(config.quant, "quantize_embedding", False):
+            # same fail-loudly contract as the int8 weight check below:
+            # silently leaving the ~77 MB tied table full-precision when
+            # its quantization was explicitly requested would defeat the
+            # sizing the flag exists for
+            raise ValueError(
+                "quant.quantize_embedding requires weight quantization "
+                "(quant.enabled=true or dtype='int8'): the tied-embedding "
+                "int8 path rides the weight-quant initialization")
 
         # HF torch module → (ModelSpec, params) via policy (module_inject analog)
         if _is_torch_module(model):
@@ -132,6 +141,7 @@ class InferenceEngine:
             log_dist(f"weight-only int8: stream-initialized {n_q} block "
                      "weight tensors (per-layer, per-output-column scales)",
                      ranks=[0])
+            self.params = self._maybe_quantize_embedding(self.params)
         else:
             if params is None:
                 # cast fused INTO the jitted init: XLA folds the astype into
@@ -149,6 +159,7 @@ class InferenceEngine:
                 log_dist(f"weight-only int8: quantized {n_q} block weight "
                          "tensors (per-layer, per-output-column scales)",
                          ranks=[0])
+                self.params = self._maybe_quantize_embedding(self.params)
 
         self._compiled: Dict[Tuple, Any] = {}
         self._gen_rng = jax.random.PRNGKey(config.seed)
@@ -314,6 +325,44 @@ class InferenceEngine:
             return tree
 
         return walk(params), count
+
+    def _maybe_quantize_embedding(self, params):
+        """int8 tied-embedding quantization (ISSUE 12 satellite,
+        ``quant.quantize_embedding``): ONE per-vocab-row scale serves
+        both consumers of the tied table — the embedding gather (exact
+        per-row dequant, models/base.embed_tokens) and the lm-head
+        matmul (scale on the output logit column, base.tied_logits).
+        At 125M the tied table is ~77 MB of the 249 MB int8 weight
+        stream (PROFILE_DECODE.md) — the last unquantized resident.
+        Requires the model to route wte through the quant-aware helpers
+        (``supports_embedding_quant``); fails loudly otherwise, exactly
+        like the block-weight support check."""
+        if not getattr(self._config.quant, "quantize_embedding", False):
+            return params
+        if not getattr(self.module, "supports_embedding_quant", False):
+            raise ValueError(
+                f"quant.quantize_embedding requested but "
+                f"{type(self.module).__name__} does not route its tied "
+                "embedding through models/base.embed_tokens/tied_logits "
+                "(set supports_embedding_quant = True once it does)")
+        mcfg = getattr(self.module, "config", None)
+        if not getattr(mcfg, "tie_embeddings", True):
+            raise ValueError(
+                "quant.quantize_embedding targets the TIED embedding; "
+                "this model unties wte from its lm_head")
+        from deepspeed_tpu.compression.quantize import quantize_int8
+
+        @jax.jit
+        def q(leaf):
+            qv, scale = quantize_int8(leaf, per_channel_axis=0)  # [V, 1]
+            return {"__q__": qv, "__scale__": scale}
+
+        leaf = params.pop("wte")
+        params["wte"] = jax.block_until_ready(q(leaf))
+        del leaf
+        log_dist("weight-only int8: quantized tied embedding/lm-head "
+                 "(per-vocab-row scales)", ranks=[0])
+        return params
 
     def _load_checkpoint_params(self, checkpoint):
         """Load from this framework's sharding-agnostic engine checkpoint
@@ -728,7 +777,8 @@ class InferenceEngine:
 
     def block_prefill_program(self, bucket_len: int, num_slots: int,
                               max_blocks: int, *, do_sample: bool = False,
-                              top_k: int = 0, top_p: float = 1.0):
+                              top_k: int = 0, top_p: float = 1.0,
+                              kv_dtype: str = "compute"):
         """Jitted SUFFIX prefill against the block pool: run ONE
         request's bucket-padded UNMATCHED suffix through the pool with
         the slot's [1, MB] table row — the suffix tokens attend over the
@@ -745,7 +795,7 @@ class InferenceEngine:
         on TPU). ``start`` is the matched prefix length; the slot's
         length becomes ``start + suffix_len``."""
         key = ("blk_pf", bucket_len, num_slots, max_blocks, do_sample,
-               top_k, float(top_p))
+               top_k, float(top_p), kv_dtype)
         if key not in self._compiled:
             model = self.module
             pick = self._make_pick(do_sample, top_k, float(top_p))
@@ -769,7 +819,8 @@ class InferenceEngine:
 
     def block_decode_program(self, num_slots: int, max_blocks: int, *,
                              do_sample: bool = False, top_k: int = 0,
-                             top_p: float = 1.0, pad_token_id: int = 0):
+                             top_p: float = 1.0, pad_token_id: int = 0,
+                             kv_dtype: str = "compute"):
         """Jitted block-paged decode step: one token for every slot,
         KV addressed through the full [B, MB] block table (single-token
         decode on TPU routes to the fused Pallas block kernel,
@@ -780,7 +831,7 @@ class InferenceEngine:
         tokens[B], active[B] bool, temp, rng) -> (k_pool, v_pool,
         lengths, next_tokens[B])`` (pool operands donated on TPU)."""
         key = ("blk_dec", num_slots, max_blocks, do_sample, top_k,
-               float(top_p), pad_token_id)
+               float(top_p), pad_token_id, kv_dtype)
         if key not in self._compiled:
             model = self.module
             pick = self._make_pick(do_sample, top_k, float(top_p))
@@ -802,7 +853,8 @@ class InferenceEngine:
 
     def block_verify_program(self, num_slots: int, max_blocks: int, k: int,
                              *, do_sample: bool = False, top_k: int = 0,
-                             top_p: float = 1.0, pad_token_id: int = 0):
+                             top_p: float = 1.0, pad_token_id: int = 0,
+                             kv_dtype: str = "compute"):
         """Jitted speculative verify step over the block pool — the
         block-table analog of :meth:`slot_verify_program`. Rollback
         stays free: rejected candidates' K/V stay dead behind the
@@ -818,7 +870,7 @@ class InferenceEngine:
         from deepspeed_tpu.serving.speculative import speculative_acceptance
 
         key = ("blk_ver", num_slots, max_blocks, k, do_sample, top_k,
-               float(top_p), pad_token_id)
+               float(top_p), pad_token_id, kv_dtype)
         if key not in self._compiled:
             model = self.module
 
@@ -843,26 +895,32 @@ class InferenceEngine:
             self._compiled[key] = jax.jit(verify, donate_argnums=donate)
         return self._compiled[key]
 
-    def block_copy_program(self, num_blocks: int, block_size: int):
+    def block_copy_program(self, num_blocks: int, block_size: int, *,
+                           kv_dtype: str = "compute"):
         """Jitted one-block COW copy: duplicate pool block ``src`` into
         ``dst`` across both pools and every layer (the device half of a
         radix copy-on-write fork, serving/radix.PrefixCache.admit —
         issued BEFORE the suffix prefill that partially overwrites the
         fork). ``src``/``dst`` are traced scalars: one compiled program
-        serves every fork.
+        serves every fork. Quantized ``{"q", "s"}`` pools (ISSUE 12)
+        copy leaf-wise — a fork carries the source block's payload AND
+        its per-token scales, so the forked block dequantizes
+        bit-identically to the shared original (pinned by tests).
 
         Signature: ``(k_pool, v_pool, src, dst) -> (k_pool, v_pool)``
         (pool operands donated on TPU)."""
-        key = ("blk_copy", num_blocks, block_size)
+        key = ("blk_copy", num_blocks, block_size, kv_dtype)
         if key not in self._compiled:
             def copy(k_pool, v_pool, src, dst):
-                kb = jax.lax.dynamic_slice_in_dim(k_pool, src, 1, 1)
-                vb = jax.lax.dynamic_slice_in_dim(v_pool, src, 1, 1)
-                k_pool = jax.lax.dynamic_update_slice_in_dim(
-                    k_pool, kb, dst, 1)
-                v_pool = jax.lax.dynamic_update_slice_in_dim(
-                    v_pool, vb, dst, 1)
-                return k_pool, v_pool
+                def copy_one(pool):
+                    def f(leaf):
+                        blk = jax.lax.dynamic_slice_in_dim(leaf, src, 1, 1)
+                        return jax.lax.dynamic_update_slice_in_dim(
+                            leaf, blk, dst, 1)
+
+                    return jax.tree_util.tree_map(f, pool)
+
+                return copy_one(k_pool), copy_one(v_pool)
 
             donate = (0, 1) if jax.default_backend() == "tpu" else ()
             self._compiled[key] = jax.jit(copy, donate_argnums=donate)
@@ -972,7 +1030,8 @@ class InferenceEngine:
             self._compiled[key] = jax.jit(swap_in, donate_argnums=donate)
         return self._compiled[key]
 
-    def block_swap_out_program(self, num_blocks: int, max_blocks: int):
+    def block_swap_out_program(self, num_blocks: int, max_blocks: int, *,
+                               kv_dtype: str = "compute"):
         """Jitted preemption swap-OUT for the block pool: gather the
         contents of one slot's table-named blocks (sentinel entries
         gather the garbage row — the engine trims to the blocks the
@@ -982,13 +1041,14 @@ class InferenceEngine:
         with blocks ``[L, MB, Hkv, bs(/pair), Dh(*pair)]``."""
         from deepspeed_tpu.ops.attention import gather_pool_blocks
 
-        key = ("blk_swap_out", num_blocks, max_blocks)
+        key = ("blk_swap_out", num_blocks, max_blocks, kv_dtype)
         if key not in self._compiled:
             self._compiled[key] = jax.jit(
                 lambda k, v, table: gather_pool_blocks(k, v, table))
         return self._compiled[key]
 
-    def block_swap_in_program(self, num_blocks: int, max_blocks: int):
+    def block_swap_in_program(self, num_blocks: int, max_blocks: int, *,
+                              kv_dtype: str = "compute"):
         """Jitted preemption swap-IN for the block pool: scatter
         host-uploaded block contents into the pool rows named by
         ``dst`` and restore the slot's valid length. Entries the
@@ -1001,7 +1061,7 @@ class InferenceEngine:
         operands donated on TPU)."""
         from deepspeed_tpu.ops.attention import scatter_pool_blocks
 
-        key = ("blk_swap_in", num_blocks, max_blocks)
+        key = ("blk_swap_in", num_blocks, max_blocks, kv_dtype)
         if key not in self._compiled:
             def swap_in(k_pool, v_pool, k_blocks, v_blocks, dst, lengths,
                         slot, length):

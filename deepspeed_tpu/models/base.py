@@ -131,6 +131,37 @@ def qdot(eq, x, w):
     return jnp.einsum(eq, x, w.astype(x.dtype))
 
 
+def embed_tokens(wte, input_ids, dtype):
+    """Token-embedding gather whose table may be weight-only-int8
+    ``{"__q__", "__scale__"}`` with PER-VOCAB-ROW scales (ISSUE 12
+    satellite — the tied embedding was the deliberately-unquantized 77
+    MB of the 125M int8 stream, PROFILE_DECODE.md). The row gather
+    stays int8 (1 byte/element of HBM traffic) and each row's single
+    scale multiplies after the gather — an EXACT dequantization per
+    row, so embedding lookups carry no extra error beyond the row's
+    quantization itself."""
+    if isinstance(wte, dict) and "__q__" in wte:
+        q, s = wte["__q__"], wte["__scale__"]
+        return (q[input_ids].astype(dtype)
+                * s.reshape(-1)[input_ids][..., None].astype(dtype))
+    return wte.astype(dtype)[input_ids]
+
+
+def tied_logits(hidden, wte):
+    """Tied LM-head matmul ``[.., D] @ [V, D]^T -> [.., V]`` whose
+    weight may be int8 with per-vocab-row scales: the scale is
+    per OUTPUT column of the logits, so it multiplies the matmul
+    result (``sum_d h_d q_vd * s_v == s_v * sum_d h_d q_vd``) — the
+    same scale-on-output contract as :func:`qdot`. Logit parity vs the
+    unquantized head is pinned by tests (argmax agreement + bounded
+    max logit error)."""
+    if isinstance(wte, dict) and "__q__" in wte:
+        q, s = wte["__q__"], wte["__scale__"]
+        out = jnp.einsum("btd,vd->btv", hidden, q.astype(hidden.dtype))
+        return out * s.reshape(-1).astype(hidden.dtype)
+    return jnp.einsum("btd,vd->btv", hidden, wte.astype(hidden.dtype))
+
+
 def cache_positions(index, t: int):
     """Query positions for a KV-cache step — the cache carry API's single
     point of index polymorphism. ``index`` is the cache dict's ``"index"``

@@ -20,7 +20,7 @@ from typing import Any, Dict, Optional
 import jax
 import jax.numpy as jnp
 
-from deepspeed_tpu.models.base import ATTN_IMPLS, cache_positions, cross_entropy_loss, gelu, layer_norm, layer_view, qdot, sp_attention
+from deepspeed_tpu.models.base import ATTN_IMPLS, cache_positions, cross_entropy_loss, embed_tokens, gelu, layer_norm, layer_view, qdot, sp_attention, tied_logits
 from deepspeed_tpu.ops.attention import alloc_kv_cache, cached_attention, multihead_attention
 
 
@@ -75,6 +75,10 @@ class GPT2Model:
     """Causal-LM ModelSpec. batch = {"input_ids": [B,T] int32, "labels": [B,T]}."""
 
     supports_weight_quant = True   # weight matmuls go through base.qdot
+    # the tied embedding/lm-head may ALSO quantize (per-vocab-row scales,
+    # quant.quantize_embedding): embed gathers + tied logits route
+    # through base.embed_tokens / base.tied_logits
+    supports_embedding_quant = True
 
     def __init__(self, config: GPT2Config, compute_dtype=jnp.bfloat16,
                  remat: bool = False, remat_policy: Optional[str] = None,
@@ -202,7 +206,7 @@ class GPT2Model:
                        pld_theta=None, ltd_keep=None):
         c = self.config
         b, t = input_ids.shape
-        x = params["wte"].astype(self.compute_dtype)[input_ids]
+        x = embed_tokens(params["wte"], input_ids, self.compute_dtype)
         x = x + params["wpe"].astype(self.compute_dtype)[:t][None]
 
         block_fn = self._block
@@ -280,8 +284,7 @@ class GPT2Model:
 
     def logits(self, params, hidden):
         if self.config.tie_embeddings:
-            w = params["wte"].astype(hidden.dtype)
-            return jnp.einsum("btd,vd->btv", hidden, w)
+            return tied_logits(hidden, params["wte"])
         return jnp.einsum("btd,dv->btv", hidden, params["lm_head"].astype(hidden.dtype))
 
     def apply(self, params, batch, *, rngs=None, train: bool = False,
@@ -336,7 +339,7 @@ class GPT2Model:
         b, t = input_ids.shape
         idx = cache["index"]
         bt = cache.get("block_table")
-        x = params["wte"].astype(self.compute_dtype)[input_ids]
+        x = embed_tokens(params["wte"], input_ids, self.compute_dtype)
         pos = cache_positions(idx, t)
         pe = params["wpe"].astype(self.compute_dtype)[pos]
         x = x + (pe if pos.ndim == 2 else pe[None])

@@ -132,12 +132,23 @@ def cached_attention(q, k_full, v_full, k_new, v_new, layer, idx, *,
     table is TRACED DATA (int32 [B, max_blocks]), never a shape: one
     compiled program serves every block assignment, which is what lets
     the radix prefix cache remap blocks between steps without a single
-    recompile."""
+    recompile.
+
+    A QUANTIZED pool (ISSUE 12, serving/kv_quant.py) arrives as a
+    ``{"q": payload, "s": scales}`` pytree in place of each cache
+    array — block-paged only (the write path quantizes on store, the
+    read paths dequantize in-register; the models carry the tree
+    opaquely, so one code path serves every kv_dtype)."""
     if block_table is not None:
         return _block_cached_attention(q, k_full, v_full, k_new, v_new,
                                        layer, idx, block_table,
                                        scale=scale, bias=bias,
                                        window=window)
+    if isinstance(k_full, dict):
+        raise ValueError(
+            "quantized KV pools are block-paged only: cached_attention "
+            "got a {'q','s'} cache without a block_table (serving must "
+            "run with prefix_cache=True to use kv_dtype)")
     b, t = q.shape[0], q.shape[1]
     dh = q.shape[3]
     pair = k_full.shape[4] // dh
@@ -279,9 +290,16 @@ def gather_pool_blocks(k_pool, v_pool, table):
     device_gets the result into the host swap buffer before freeing the
     blocks. Sentinel table entries gather the pool's garbage row
     (finite junk the restore never uploads). ``table`` is traced int32
-    ``[MB]`` — one compiled program serves every block assignment."""
-    return (jnp.take(k_pool, table, axis=1, mode="clip"),
-            jnp.take(v_pool, table, axis=1, mode="clip"))
+    ``[MB]`` — one compiled program serves every block assignment.
+    Quantized ``{"q", "s"}`` pools gather payloads AND scales (both are
+    block-major on axis 1), so the host copy round-trips the exact
+    stored bytes — which is also why quantized swap halves the host
+    transfer."""
+    def g(leaf):
+        return jnp.take(leaf, table, axis=1, mode="clip")
+
+    return (jax.tree_util.tree_map(g, k_pool),
+            jax.tree_util.tree_map(g, v_pool))
 
 
 def scatter_pool_blocks(k_pool, v_pool, k_blocks, v_blocks, dst):
@@ -291,17 +309,24 @@ def scatter_pool_blocks(k_pool, v_pool, k_blocks, v_blocks, dst):
     point at the pool's garbage row: their writes land where nobody
     reads, so the program's shapes never vary with how much actually
     needs uploading (duplicate garbage-row writes race only against
-    each other)."""
-    return (k_pool.at[:, dst].set(k_blocks.astype(k_pool.dtype),
-                                  mode="drop"),
-            v_pool.at[:, dst].set(v_blocks.astype(v_pool.dtype),
-                                  mode="drop"))
+    each other). Quantized pools scatter payloads and scales leaf-wise
+    — host bytes land back bit-identically (no requantization on a
+    swap round trip; pinned by tests)."""
+    def s(pool_leaf, blk_leaf):
+        return pool_leaf.at[:, dst].set(blk_leaf.astype(pool_leaf.dtype),
+                                        mode="drop")
+
+    return (jax.tree_util.tree_map(s, k_pool, k_blocks),
+            jax.tree_util.tree_map(s, v_pool, v_blocks))
 
 
 def pool_block_size(k_pool, head_dim: int) -> int:
-    """Tokens per block of a (possibly token-pair packed) KV block pool
-    ``[L, N, Hkv, bs/pair, Dh*pair]``."""
-    return k_pool.shape[3] * (k_pool.shape[4] // head_dim)
+    """Tokens per block of a (possibly token-pair packed, possibly
+    quantized) KV block pool ``[L, N, Hkv, bs/pair, Dh*pair]``."""
+    from deepspeed_tpu.serving.kv_quant import pool_payload
+
+    p = pool_payload(k_pool)
+    return p.shape[3] * (p.shape[4] // head_dim)
 
 
 def write_kv_blocks(k_pool, v_pool, k_new, v_new, layer, idx, block_table):
@@ -319,7 +344,41 @@ def write_kv_blocks(k_pool, v_pool, k_new, v_new, layer, idx, block_table):
     entry may meanwhile be pinned by another request, so the garbage
     row is a correctness requirement, not a nicety (and it lets the
     fused Pallas block kernel skip per-row write predication
-    entirely)."""
+    entirely).
+
+    Quantized pools (ISSUE 12): ``k_pool``/``v_pool`` may be the
+    ``{"q", "s"}`` pytree with an UNPACKED payload view
+    ``[L, N+1, Hkv, bs, Dh]`` — this is the quantize-on-store seam:
+    each new token's symmetric per-head scale is computed HERE
+    (serving/kv_quant.kv_quantize), its payload scatters exactly like
+    the unquantized write, and the scale scatters into the pair-grouped
+    scale array at ``[layer, block, :, pos % pair, (pos % bs) // pair]``."""
+    if isinstance(k_pool, dict):
+        from deepspeed_tpu.serving.kv_quant import kv_quantize
+
+        kq_pool, ks_pool = k_pool["q"], k_pool["s"]
+        vq_pool, vs_pool = v_pool["q"], v_pool["s"]
+        kv_dtype = "int8" if kq_pool.dtype == jnp.int8 else "fp8"
+        n_phys, bs = kq_pool.shape[1], kq_pool.shape[3]
+        pair = ks_pool.shape[3]
+        b, t = k_new.shape[0], k_new.shape[1]
+        mb = block_table.shape[1]
+        pos = idx[:, None] + jnp.arange(t)[None, :]              # [B, T]
+        jb = pos // bs
+        pb = jnp.take_along_axis(block_table, jnp.clip(jb, 0, mb - 1),
+                                 axis=1)
+        pb = jnp.where(jb < mb, pb, n_phys - 1)
+        wi = pos % bs
+        half, row = wi % pair, wi // pair        # pair-grouped scale idx
+        kq, ks = kv_quantize(k_new, kv_dtype)    # [B,T,Hkv,Dh], [B,T,Hkv]
+        vq, vs = kv_quantize(v_new, kv_dtype)
+        k_pool = {"q": kq_pool.at[layer, pb, :, wi, :].set(kq, mode="drop"),
+                  "s": ks_pool.at[layer, pb, :, half, row].set(
+                      ks, mode="drop")}
+        v_pool = {"q": vq_pool.at[layer, pb, :, wi, :].set(vq, mode="drop"),
+                  "s": vs_pool.at[layer, pb, :, half, row].set(
+                      vs, mode="drop")}
+        return k_pool, v_pool
     n_phys, bs = k_pool.shape[1], k_pool.shape[3]
     b, t = k_new.shape[0], k_new.shape[1]
     mb = block_table.shape[1]
@@ -335,14 +394,36 @@ def write_kv_blocks(k_pool, v_pool, k_new, v_new, layer, idx, block_table):
     return k_pool, v_pool
 
 
-def gather_block_kv(pool_layer, block_table):
+def gather_block_kv(pool_layer, block_table, out_dtype=None):
     """Per-layer slot view of the block pool: gather each row's blocks
     ``[N+1, Hkv, bs, Dh] -> [B, Hkv, MB * bs, Dh]`` (the shape
     :func:`decode_attention` expects). Sentinel table entries read the
     garbage row — garbage, but FINITE (a fill-value NaN would poison
     the PV einsum through the masked positions' 0 * NaN), and always
     dead behind the per-slot length mask; ``mode="clip"`` keeps even a
-    corrupt table in range."""
+    corrupt table in range.
+
+    A quantized ``{"q", "s"}`` layer gathers payload AND scales, then
+    dequantizes into ``out_dtype`` (required for quantized layers —
+    callers pass the query dtype); garbage-row reads dequantize to
+    finite junk exactly like the unquantized pool's (zero at
+    allocation, arbitrary once inactive slots' masked writes land
+    there — always dead behind the length mask either way)."""
+    if isinstance(pool_layer, dict):
+        from deepspeed_tpu.serving.kv_quant import (kv_dequantize,
+                                                    scales_token_order)
+
+        assert out_dtype is not None, \
+            "gather_block_kv on a quantized layer needs out_dtype"
+        ql, sl = pool_layer["q"], pool_layer["s"]    # [N,Hkv,bs,Dh] /
+        n, hkv, bs, dh = ql.shape                    # [N,Hkv,pair,bs/pair]
+        b, mb = block_table.shape
+        kb = jnp.take(ql, block_table, axis=0, mode="clip")
+        sb = scales_token_order(
+            jnp.take(sl, block_table, axis=0, mode="clip"))  # [B,MB,Hkv,bs]
+        kb = kb.transpose(0, 2, 1, 3, 4).reshape(b, hkv, mb * bs, dh)
+        sb = sb.transpose(0, 2, 1, 3).reshape(b, hkv, mb * bs)
+        return kv_dequantize(kb, sb, out_dtype)
     n, hkv, bs, dh = pool_layer.shape
     b, mb = block_table.shape
     kb = jnp.take(pool_layer, block_table, axis=0, mode="clip")
@@ -358,10 +439,21 @@ def _block_cached_attention(q, k_pool, v_pool, k_new, v_new, layer, idx,
     to the fused Pallas block-table step (ops/decode_step.py) — the
     kernel streams each slot's valid blocks straight from the pool, so
     paging costs no extra HBM copy; everything else (suffix prefill,
-    speculative verify blocks, CPU) takes the gather + einsum path."""
+    speculative verify blocks, CPU) takes the gather + einsum path.
+
+    Quantized pools (ISSUE 12): same two routes — the fused kernel
+    streams int8/fp8 payload chunks and dequantizes in-register (half
+    the HBM bytes per chunk), the einsum path writes through the
+    quantizing :func:`write_kv_blocks` and reads through the
+    dequantizing :func:`gather_block_kv`. Both attend over the
+    quantize->dequantize image of the NEW token too (the value future
+    steps will read), so kernel and einsum outputs agree across
+    backends."""
+    quant = isinstance(k_pool, dict)
+    kq_arr = k_pool["q"] if quant else k_pool
     b, t = q.shape[0], q.shape[1]
     dh = q.shape[3]
-    l, n, hkv, bsp, dhp = k_pool.shape
+    l, n, hkv, bsp, dhp = kq_arr.shape
     pair = dhp // dh
     bs = bsp * pair
     assert jnp.ndim(idx) == 1, \
@@ -377,14 +469,30 @@ def _block_cached_attention(q, k_pool, v_pool, k_new, v_new, layer, idx,
                                            layer, idx, block_table,
                                            scale=scale)
     shape = (l, n, hkv, bs, dh)
-    ku = k_pool.reshape(shape) if pair > 1 else k_pool
-    vu = v_pool.reshape(shape) if pair > 1 else v_pool
+    if quant:
+        ku = {"q": k_pool["q"].reshape(shape) if pair > 1 else k_pool["q"],
+              "s": k_pool["s"]}
+        vu = {"q": v_pool["q"].reshape(shape) if pair > 1 else v_pool["q"],
+              "s": v_pool["s"]}
+    else:
+        ku = k_pool.reshape(shape) if pair > 1 else k_pool
+        vu = v_pool.reshape(shape) if pair > 1 else v_pool
     ku, vu = write_kv_blocks(ku, vu, k_new, v_new, layer, idx, block_table)
-    kl = jax.lax.dynamic_index_in_dim(ku, layer, 0, keepdims=False)
-    vl = jax.lax.dynamic_index_in_dim(vu, layer, 0, keepdims=False)
-    attn = decode_attention(q, gather_block_kv(kl, block_table),
-                            gather_block_kv(vl, block_table), idx,
-                            scale=scale, bias=bias, window=window)
+
+    def at_layer(tree):
+        return jax.tree_util.tree_map(
+            lambda a: jax.lax.dynamic_index_in_dim(a, layer, 0,
+                                                   keepdims=False), tree)
+
+    kl, vl = at_layer(ku), at_layer(vu)
+    attn = decode_attention(
+        q, gather_block_kv(kl, block_table, q.dtype),
+        gather_block_kv(vl, block_table, q.dtype), idx,
+        scale=scale, bias=bias, window=window)
+    if quant:
+        return (attn,
+                {"q": ku["q"].reshape(k_pool["q"].shape), "s": ku["s"]},
+                {"q": vu["q"].reshape(v_pool["q"].shape), "s": vu["s"]})
     return attn, ku.reshape(k_pool.shape), vu.reshape(v_pool.shape)
 
 

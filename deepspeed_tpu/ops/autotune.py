@@ -1,0 +1,122 @@
+"""Measured kernel-plan store for the Pallas serving kernels (ISSUE 12
+satellite; VERDICT next-round #4).
+
+The fused decode kernels and the int8 weight-streaming matmul each carry
+hand-picked plan constants — ``(bg, cs, vmem_limit)`` batch-group /
+chunk sizing in ops/decode_step.py, ``(bd, be, cap)`` divisor tiles in
+ops/int8_matmul.py — that were calibrated on one chip generation at one
+model size. This module makes MEASURED plans the primary source: a
+micro-bench harness (scripts/autotune_kernels.py) times candidate plans
+per shape on the actual backend and writes a committed artifact
+(``AUTOTUNE_KERNELS_MEASURED.json`` at the repo root, the
+AUTOTUNE_125M_MEASURED.json idiom); the kernels consult
+:func:`lookup` at trace time and fall back to the hand-picked constants
+when no valid entry exists.
+
+Safety rails:
+
+  * entries apply only when the artifact's ``backend`` matches the
+    running ``jax.default_backend()`` — a CPU-smoke artifact must never
+    re-plan kernels on a real TPU (and vice versa);
+  * every consumer re-validates an entry's divisibility/alignment
+    against the live shape and silently falls back on mismatch — a
+    stale or hand-edited artifact can cost performance, never
+    correctness;
+  * lookups happen at TRACE time only (plans are compile-time
+    constants), so the artifact read is paid once per program, never on
+    the serving hot path.
+
+Artifact schema::
+
+    {"metric": "kernel_plan_autotune",
+     "backend": "cpu" | "tpu",
+     "plans": {
+       "decode_step":       {"<key>": {"bg", "cs", "vmem_mb", "mha",
+                                       "us", "hand_us", ...}},
+       "block_decode_step": {"<key>": {"mha", "vmem_mb", ...}},
+       "int8_matmul_dma":   {"<key>": {"bd", "be", "cap", ...}}}}
+
+``us`` is the chosen plan's measured per-call microseconds and
+``hand_us`` the hand-picked plan's in the same windows — the harness
+always includes the hand-picked plan in the candidate set and picks the
+argmin, so a committed plan beats-or-ties the constants BY CONSTRUCTION
+in its own measurement.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Optional
+
+ENV_PATH = "DSTPU_KERNEL_PLANS"   # artifact path override; "" disables
+
+_REPO_ARTIFACT = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), os.pardir, os.pardir,
+    "AUTOTUNE_KERNELS_MEASURED.json")
+
+_UNSET = object()
+_artifact = _UNSET
+
+
+# ------------------------------------------------------------------- keys
+def decode_key(b: int, hkv: int, s_max: int, dh: int, itemsize: int) -> str:
+    """Shape key of one fused_decode_step geometry (slot-paged)."""
+    return f"b{b}_hkv{hkv}_s{s_max}_dh{dh}_e{itemsize}"
+
+
+def block_decode_key(b: int, hkv: int, bs: int, dh: int,
+                     itemsize: int) -> str:
+    """Shape key of one fused_block_decode_step geometry (block-paged;
+    ``itemsize`` is the PAYLOAD's — 1 for int8/fp8 pools)."""
+    return f"b{b}_hkv{hkv}_bs{bs}_dh{dh}_e{itemsize}"
+
+
+def matmul_key(d: int, e: int) -> str:
+    """Shape key of one int8_matmul_dma [D, E] weight geometry."""
+    return f"d{d}_e{e}"
+
+
+# ------------------------------------------------------------------ store
+def artifact_path() -> str:
+    return os.environ.get(ENV_PATH, _REPO_ARTIFACT)
+
+
+def _load():
+    global _artifact
+    if _artifact is not _UNSET:
+        return _artifact
+    path = artifact_path()
+    art = None
+    if path:
+        try:
+            with open(path) as f:
+                d = json.load(f)
+            if isinstance(d, dict) and isinstance(d.get("plans"), dict):
+                art = d
+        except Exception:
+            art = None
+    _artifact = art
+    return art
+
+
+def reload() -> None:
+    """Drop the memoized artifact (tests point ``DSTPU_KERNEL_PLANS``
+    at scratch files; production never needs this)."""
+    global _artifact
+    _artifact = _UNSET
+
+
+def lookup(kind: str, key: str) -> Optional[dict]:
+    """Measured plan entry for ``(kind, key)`` on the CURRENT backend,
+    or None (→ the caller's hand-picked constants). Consumers must
+    re-validate fields against the live shape before use."""
+    art = _load()
+    if art is None:
+        return None
+    import jax
+
+    if art.get("backend") != jax.default_backend():
+        return None
+    ent = art.get("plans", {}).get(kind, {}).get(key)
+    return ent if isinstance(ent, dict) else None

@@ -58,11 +58,11 @@ _CHUNK_BUDGET = 3_300_000
 _VMEM_LIMIT = 40 * 1024 * 1024
 
 
-def _compiler_params():
+def _compiler_params(vmem_bytes: int = _VMEM_LIMIT):
     try:
-        return pltpu.CompilerParams(vmem_limit_bytes=_VMEM_LIMIT)
+        return pltpu.CompilerParams(vmem_limit_bytes=vmem_bytes)
     except Exception:  # older naming (flash_attention._grid_params idiom)
-        return pltpu.TPUCompilerParams(vmem_limit_bytes=_VMEM_LIMIT)
+        return pltpu.TPUCompilerParams(vmem_limit_bytes=vmem_bytes)
 
 
 def supports(hq: int, hkv: int, s_max: int, dh: int) -> bool:
@@ -95,11 +95,77 @@ def _plan(b: int, hkv: int, s_max: int, dh: int, itemsize: int):
     return 1, 128
 
 
+def _resolve_plan(b: int, hkv: int, s_max: int, dh: int, itemsize: int,
+                  override=None):
+    """(bg, cs, vmem_bytes, mha) for one fused_decode_step geometry:
+    the measured artifact entry (ops/autotune.py) when one exists for
+    this backend+shape and VALIDATES against the live shape, else the
+    hand-picked :func:`_plan` constants. ``mha`` picks the rep==1
+    score/PV engine — "mxu" (default: [1, Dh] x [Dh, CS] slabs like the
+    GQA path, ISSUE 12's fused-decode shave) or "vpu" (the pre-ISSUE-12
+    broadcast-multiply+reduce, kept plan-selectable so the autotuner
+    can measure both). ``override`` is the micro-bench harness's
+    candidate entry — same schema, same validation."""
+    from deepspeed_tpu.ops import autotune
+
+    ent = override
+    if ent is None:
+        ent = autotune.lookup(
+            "decode_step", autotune.decode_key(b, hkv, s_max, dh, itemsize))
+    bg, cs = _plan(b, hkv, s_max, dh, itemsize)
+    vmem, mha = _VMEM_LIMIT, "mxu"
+    if ent:
+        try:
+            bg2 = int(ent.get("bg", bg))
+            cs2 = int(ent.get("cs", cs))
+            # re-validate against the live shape: a stale artifact may
+            # cost performance, never a mis-shaped DMA
+            if (bg2 >= 1 and b % bg2 == 0 and cs2 >= 128
+                    and cs2 % 128 == 0 and s_max % cs2 == 0):
+                bg, cs = bg2, cs2
+            vmem, mha = _entry_vmem_mha(ent, vmem, mha)
+        except Exception:
+            pass
+    return bg, cs, vmem, mha
+
+
+def _entry_vmem_mha(ent: dict, vmem: int, mha: str):
+    """Shared artifact-entry parsing for the per-kernel tunables both
+    decode resolvers honor: the clamped VMEM scope and the rep==1
+    score/PV engine (one implementation, so the two kernels can never
+    diverge in how they read the same schema)."""
+    vmem = max(16, min(int(ent.get("vmem_mb", vmem >> 20)), 128)) << 20
+    if ent.get("mha") in ("mxu", "vpu"):
+        mha = ent["mha"]
+    return vmem, mha
+
+
+def _resolve_block_plan(b: int, hkv: int, bs: int, dh: int, itemsize: int,
+                        override=None):
+    """(vmem_bytes, mha) for one fused_block_decode_step geometry (the
+    block kernel's chunk size IS the pool's block size, so only the
+    VMEM scope and the rep==1 engine are tunable)."""
+    from deepspeed_tpu.ops import autotune
+
+    ent = override
+    if ent is None:
+        ent = autotune.lookup(
+            "block_decode_step",
+            autotune.block_decode_key(b, hkv, bs, dh, itemsize))
+    vmem, mha = _VMEM_LIMIT, "mxu"
+    if ent:
+        try:
+            vmem, mha = _entry_vmem_mha(ent, vmem, mha)
+        except Exception:
+            pass
+    return vmem, mha
+
+
 def _kernel(layer_ref, idx_ref, q_ref, kn_ref, vn_ref, _kin_ref, _vin_ref,
             attn_ref, k_ref, v_ref,
             kbuf, vbuf, kwin, vwin, m_ref, l_ref, acc_ref, wsem, rsem,
             *, b: int, bg: int, cs: int, hq: int, hkv: int, dh: int,
-            pair: int, scale: float, per_slot: bool):
+            pair: int, scale: float, per_slot: bool, mha: str = "mxu"):
     layer = layer_ref[0]
     idx = idx_ref[0]
     rep = hq // hkv
@@ -239,19 +305,12 @@ def _kernel(layer_ref, idx_ref, q_ref, kn_ref, vn_ref, _kin_ref, _vin_ref,
                 chunk_dma(nxt, c + 1, k_ref, kbuf, 0).start()
                 chunk_dma(nxt, c + 1, v_ref, vbuf, 1).start()
 
-            chunk_dma(slot, c, k_ref, kbuf, 0).wait()
-            chunk_dma(slot, c, v_ref, vbuf, 1).wait()
-
-            kc = kbuf[slot]                         # [bg, Hkv, CSP, Dh*pair]
-            vc = vbuf[slot]                         # bf16: products run in
-            # bf16 with f32 accumulation — the same precision contract as
-            # the einsum path's MXU (bf16 multiply, f32 accumulate); a full
-            # f32 materialization of both chunks measured ~2x the VPU time
+            # splice mask (shared by K now and V below): each row's new
+            # token lands at its own position (per_slot: any chunk of the
+            # group walk; uniform: only the final chunk — the prefix walk
+            # never pays the vector work)
+            spl = None
             if per_slot:
-                # per-row splice: each slot's new token lands at its own
-                # position, which may fall in ANY chunk of the group walk
-                # — so every chunk pays the select (serving batches are
-                # small; the uniform path keeps its last-chunk-only form)
                 idxm = group_idx_vec((bg, hkv, csp, dhp))
                 rowg = c * csp + jax.lax.broadcasted_iota(
                     jnp.int32, (bg, hkv, csp, dhp), 2)
@@ -260,14 +319,11 @@ def _kernel(layer_ref, idx_ref, q_ref, kn_ref, vn_ref, _kin_ref, _vin_ref,
                     spl &= (jax.lax.broadcasted_iota(
                         jnp.int32, (bg, hkv, csp, dhp), 3) // dh
                             == idxm - (idxm // pair) * pair)
-                kc = jnp.where(spl, kn_ref[pl.ds(b0, bg)], kc)
-                vc = jnp.where(spl, vn_ref[pl.ds(b0, bg)], vc)
             elif splice:
                 # in-register splice of the new token (its async cache
                 # write may still be in flight; every other row is
                 # unchanged, so a read/write race can only return
-                # identical bytes). Only the final chunk contains idx —
-                # the prefix walk never pays this vector work.
+                # identical bytes)
                 rowg = c * csp + jax.lax.broadcasted_iota(
                     jnp.int32, (bg, hkv, csp, dhp), 2)
                 spl = rowg == idx // pair
@@ -275,16 +331,30 @@ def _kernel(layer_ref, idx_ref, q_ref, kn_ref, vn_ref, _kin_ref, _vin_ref,
                     spl &= (jax.lax.broadcasted_iota(
                         jnp.int32, (bg, hkv, csp, dhp), 3) // dh
                             == idx - (idx // pair) * pair)
+
+            # K first: the scores + running-max update run while the V
+            # half of the chunk is still in flight (ISSUE 12 shave — the
+            # old joint wait serialized ~half the chunk DMA behind the
+            # VPU/MXU math it could hide under)
+            chunk_dma(slot, c, k_ref, kbuf, 0).wait()
+            kc = kbuf[slot]                         # [bg, Hkv, CSP, Dh*pair]
+            # bf16: products run in bf16 with f32 accumulation — the same
+            # precision contract as the einsum path's MXU (bf16 multiply,
+            # f32 accumulate); a full f32 materialization of both chunks
+            # measured ~2x the VPU time
+            if spl is not None:
                 kc = jnp.where(spl, kn_ref[pl.ds(b0, bg)], kc)
-                vc = jnp.where(spl, vn_ref[pl.ds(b0, bg)], vc)
             # scores for each packed lane slice (its own position stream)
             ss = []
             for h in range(pair):
                 k = kc[..., h * dh:(h + 1) * dh]    # [bg, Hkv, CSP, Dh]
-                if rep == 1:
+                if rep == 1 and mha == "vpu":
                     s = jnp.sum(qv * k, -1,
                                 dtype=jnp.float32)         # VPU [bg, H, CSP]
                 else:
+                    # MXU [rep, Dh] x [Dh, CS] slabs per kv head (rep==1
+                    # degenerates to [1, Dh] matvecs — the ISSUE 12
+                    # default; the autotuned plan can select "vpu" back)
                     qg = qv.reshape(bg * hkv, rep, dh)     # 1 batch dim
                     kg = k.reshape(bg * hkv, csp, dh)      # (Mosaic limit)
                     s = jax.lax.dot_general(               # MXU
@@ -304,11 +374,17 @@ def _kernel(layer_ref, idx_ref, q_ref, kn_ref, vn_ref, _kin_ref, _vin_ref,
             corr = jnp.exp(m_prev - m_new)
             l_new = l_ref[...] * corr
             acc = acc_ref[...] * corr[:, :, None]
-            for h, s in enumerate(ss):
-                p = jnp.exp(s - m_new[:, :, None])
+            ps = [jnp.exp(s - m_new[:, :, None]) for s in ss]
+            for p in ps:
                 l_new = l_new + p.sum(-1)
+
+            chunk_dma(slot, c, v_ref, vbuf, 1).wait()
+            vc = vbuf[slot]
+            if spl is not None:
+                vc = jnp.where(spl, vn_ref[pl.ds(b0, bg)], vc)
+            for h, p in enumerate(ps):
                 v = vc[..., h * dh:(h + 1) * dh]
-                if rep == 1:
+                if rep == 1 and mha == "vpu":
                     pb = p[:, :, :, None].astype(v.dtype)  # None-insert in
                     # f32 (bf16 unit-dim reshape is unsupported), cast after
                     pv = jnp.sum(pb * v, 2,
@@ -367,11 +443,30 @@ def supports_block(hq: int, hkv: int, block_size: int, dh: int) -> bool:
     return 128 % dh == 0 and block_size % (8 * (128 // dh)) == 0
 
 
-def _block_kernel(layer_ref, idx_ref, tbl_ref, q_ref, kn_ref, vn_ref,
-                  _kin_ref, _vin_ref, attn_ref, k_ref, v_ref,
-                  kbuf, vbuf, kwin, vwin, m_ref, l_ref, acc_ref, wsem, rsem,
-                  *, b: int, mb: int, csp: int, hq: int, hkv: int, dh: int,
-                  pair: int, scale: float):
+def _quantize_token(x, kv_dtype: str, cdtype):
+    """In-register quantization of one packed new-token row
+    ``x [B, Hkv, 1, Dh*pair]``: a direct call into the einsum path's
+    quantizer (serving/kv_quant.kv_quantize_keepdims — ONE shared
+    implementation, so stored-byte bit-identity between the fused and
+    einsum paths holds by construction). The pair lane slices are
+    COPIES of the same Dh values, so the amax over the packed row
+    equals the unpacked row's and one per-(row, head) scale covers
+    every copy. Returns ``(payload [B, Hkv, 1, Dh*pair],
+    scale [B, Hkv, 1, 1] bf16, deq [B, Hkv, 1, Dh*pair] cdtype)``
+    where ``deq`` is the quantize->dequantize image — the value every
+    LATER step will read, spliced into THIS step's chunks so kernel
+    and einsum attend identically."""
+    from deepspeed_tpu.serving.kv_quant import kv_quantize_keepdims
+
+    payload, s = kv_quantize_keepdims(x, kv_dtype)
+    deq = (payload.astype(jnp.float32)
+           * s.astype(jnp.float32)).astype(cdtype)
+    return payload, s, deq
+
+
+def _block_kernel(*refs, b: int, mb: int, csp: int, hq: int, hkv: int,
+                  dh: int, pair: int, scale: float, quant: bool,
+                  kv_dtype: str, mha: str):
     """Block-paged decode layer-step (the block-table analog of
     :func:`_kernel`'s per_slot path): each batch row's KV lives in the
     pool blocks named by its ``tbl_ref[i]`` row, so both the new token's
@@ -382,62 +477,138 @@ def _block_kernel(layer_ref, idx_ref, tbl_ref, q_ref, kn_ref, vn_ref,
     same double-buffered fetch + in-register splice + online-softmax
     structure as the slot kernel. Sentinel table entries name the
     pool's garbage row (kv_blocks.BlockKVPool), so inactive slots'
-    writes and reads are unconditionally safe — no predication."""
+    writes and reads are unconditionally safe — no predication.
+
+    ``quant`` (ISSUE 12): the pools are int8/fp8 payload + pair-grouped
+    bf16 scale arrays (serving/kv_quant.py). The chunk walk DMAs 1-byte
+    payload blocks (half the streamed bytes of bf16) plus their tiny
+    scale rows and dequantizes IN-REGISTER per lane slice; the write
+    side quantizes the new token in-register and RMWs the WHOLE tail
+    block + its scale row (whole-block windows sidestep int8's 32-row
+    HBM tile quantum; a block is at most a few KB). Scores/PV run in
+    the compute dtype either way — the quantization lives entirely in
+    the DMA boundary."""
+    if quant:
+        (layer_ref, idx_ref, tbl_ref, q_ref, kn_ref, vn_ref,
+         _kqi, _vqi, _ksi, _vsi,
+         attn_ref, k_ref, v_ref, ks_ref, vs_ref,
+         kbuf, vbuf, ksbuf, vsbuf, kwin, vwin, kswin, vswin,
+         m_ref, l_ref, acc_ref, wsem, rsem) = refs
+    else:
+        (layer_ref, idx_ref, tbl_ref, q_ref, kn_ref, vn_ref,
+         _kqi, _vqi,
+         attn_ref, k_ref, v_ref,
+         kbuf, vbuf, kwin, vwin,
+         m_ref, l_ref, acc_ref, wsem, rsem) = refs
     layer = layer_ref[0]
     rep = hq // hkv
     bs = csp * pair           # tokens per block
     dhp = dh * pair
+    cdtype = q_ref.dtype
+
+    if quant:
+        # quantize the new tokens once, up front (pure vector math —
+        # nothing waits on it): payloads/scales for the write-back,
+        # dequantized images for the in-register splices
+        kq_new, ks_new, kn_spl = _quantize_token(
+            kn_ref[...], kv_dtype, cdtype)
+        vq_new, vs_new, vn_spl = _quantize_token(
+            vn_ref[...], kv_dtype, cdtype)
+    else:
+        kn_spl, vn_spl = kn_ref[...], vn_ref[...]
 
     # ---- write each row's new token into its current tail block.
-    # Same RMW-window discipline as the slot kernel: HBM tiling forbids
-    # single-row writes, so fetch the 8-aligned pair-row window of the
-    # TABLE-NAMED block, vector-select the token in, write back async.
+    # bf16: RMW the 8-aligned pair-row window (HBM tiling forbids
+    # single-row writes). quant: RMW the WHOLE block + its scale row.
     pbs, w0s = [], []
     for i in range(b):
         pos = idx_ref[i]
         jb = jnp.minimum(pos // bs, mb - 1)
         pbs.append(tbl_ref[i, jb])
-        w0s.append((pos % bs // pair // 8) * 8)
+        w0s.append(0 if quant else (pos % bs // pair // 8) * 8)
+    nwin = csp if quant else 8
 
     def kdma(i):
         return pltpu.make_async_copy(
-            k_ref.at[layer, pl.ds(pbs[i], 1), :, pl.ds(w0s[i], 8), :],
+            k_ref.at[layer, pl.ds(pbs[i], 1), :, pl.ds(w0s[i], nwin), :],
             kwin.at[pl.ds(i, 1)], wsem.at[0, i])
 
     def vdma(i):
         return pltpu.make_async_copy(
-            v_ref.at[layer, pl.ds(pbs[i], 1), :, pl.ds(w0s[i], 8), :],
+            v_ref.at[layer, pl.ds(pbs[i], 1), :, pl.ds(w0s[i], nwin), :],
             vwin.at[pl.ds(i, 1)], wsem.at[1, i])
 
+    def ksdma(i):
+        return pltpu.make_async_copy(
+            ks_ref.at[layer, pl.ds(pbs[i], 1), :, :, :],
+            kswin.at[pl.ds(i, 1)], wsem.at[2, i])
+
+    def vsdma(i):
+        return pltpu.make_async_copy(
+            vs_ref.at[layer, pl.ds(pbs[i], 1), :, :, :],
+            vswin.at[pl.ds(i, 1)], wsem.at[3, i])
+
+    wdmas = [kdma, vdma] + ([ksdma, vsdma] if quant else [])
     for i in range(b):
-        kdma(i).start()
-        vdma(i).start()
+        for mk in wdmas:
+            mk(i).start()
 
     def finish_write():
         for i in range(b):
-            kdma(i).wait()
-            vdma(i).wait()
-        bi = jax.lax.broadcasted_iota(jnp.int32, (b, hkv, 8, dhp), 0)
-        ri = jax.lax.broadcasted_iota(jnp.int32, (b, hkv, 8, dhp), 2)
-        li = jax.lax.broadcasted_iota(jnp.int32, (b, hkv, 8, dhp), 3)
+            for mk in wdmas:
+                mk(i).wait()
+        bi = jax.lax.broadcasted_iota(jnp.int32, (b, hkv, nwin, dhp), 0)
+        ri = jax.lax.broadcasted_iota(jnp.int32, (b, hkv, nwin, dhp), 2)
+        li = jax.lax.broadcasted_iota(jnp.int32, (b, hkv, nwin, dhp), 3)
         sel = bi < 0  # all-false
         for i in range(b):
             r = jax.lax.rem(idx_ref[i], bs)
-            sel_i = (bi == i) & (ri == jax.lax.rem(r // pair, 8))
+            row = r // pair if quant else jax.lax.rem(r // pair, 8)
+            sel_i = (bi == i) & (ri == row)
             if pair > 1:
                 sel_i &= (li // dh == jax.lax.rem(r, pair))
             sel |= sel_i
-        kwin[...] = jnp.where(sel, kn_ref[...], kwin[...])
-        vwin[...] = jnp.where(sel, vn_ref[...], vwin[...])
+        if quant:
+            kwin[...] = jnp.where(sel, kq_new, kwin[...])
+            vwin[...] = jnp.where(sel, vq_new, vwin[...])
+            # scale row splice: pair-grouped [b, Hkv, pair, csp] —
+            # token r sits at [.., r % pair, r // pair]
+            sbi = jax.lax.broadcasted_iota(
+                jnp.int32, (b, hkv, pair, csp), 0)
+            spi = jax.lax.broadcasted_iota(
+                jnp.int32, (b, hkv, pair, csp), 2)
+            sri = jax.lax.broadcasted_iota(
+                jnp.int32, (b, hkv, pair, csp), 3)
+            sel_s = sbi < 0
+            for i in range(b):
+                r = jax.lax.rem(idx_ref[i], bs)
+                sel_s |= ((sbi == i) & (spi == jax.lax.rem(r, pair))
+                          & (sri == r // pair))
+            kswin[...] = jnp.where(sel_s, ks_new, kswin[...])
+            vswin[...] = jnp.where(sel_s, vs_new, vswin[...])
+        else:
+            kwin[...] = jnp.where(sel, kn_ref[...], kwin[...])
+            vwin[...] = jnp.where(sel, vn_ref[...], vwin[...])
         for i in range(b):
             pltpu.make_async_copy(
                 kwin.at[pl.ds(i, 1)],
-                k_ref.at[layer, pl.ds(pbs[i], 1), :, pl.ds(w0s[i], 8), :],
+                k_ref.at[layer, pl.ds(pbs[i], 1), :,
+                         pl.ds(w0s[i], nwin), :],
                 wsem.at[0, i]).start()
             pltpu.make_async_copy(
                 vwin.at[pl.ds(i, 1)],
-                v_ref.at[layer, pl.ds(pbs[i], 1), :, pl.ds(w0s[i], 8), :],
+                v_ref.at[layer, pl.ds(pbs[i], 1), :,
+                         pl.ds(w0s[i], nwin), :],
                 wsem.at[1, i]).start()
+            if quant:
+                pltpu.make_async_copy(
+                    kswin.at[pl.ds(i, 1)],
+                    ks_ref.at[layer, pl.ds(pbs[i], 1), :, :, :],
+                    wsem.at[2, i]).start()
+                pltpu.make_async_copy(
+                    vswin.at[pl.ds(i, 1)],
+                    vs_ref.at[layer, pl.ds(pbs[i], 1), :, :, :],
+                    wsem.at[3, i]).start()
 
     # ---- per-row valid-block walk (chunk == one pool block)
     for i in range(b):
@@ -450,14 +621,40 @@ def _block_kernel(layer_ref, idx_ref, tbl_ref, q_ref, kn_ref, vn_ref,
                 src.at[layer, pl.ds(pb, 1), :, :, :],
                 buf.at[slot], rsem.at[slot, t])
 
+        def start_chunk(slot, j):
+            chunk_dma(slot, j, k_ref, kbuf, 0).start()
+            chunk_dma(slot, j, v_ref, vbuf, 1).start()
+            if quant:
+                chunk_dma(slot, j, ks_ref, ksbuf, 2).start()
+                chunk_dma(slot, j, vs_ref, vsbuf, 3).start()
+
         m_ref[...] = jnp.full_like(m_ref, _NEG)
         l_ref[...] = jnp.zeros_like(l_ref)
         acc_ref[...] = jnp.zeros_like(acc_ref)
-        chunk_dma(0, 0, k_ref, kbuf, 0).start()
-        chunk_dma(0, 0, v_ref, vbuf, 1).start()
+        start_chunk(0, 0)
         if i == 0:
             finish_write()  # overlaps with row 0 / chunk 0's flight
         qv = q_ref[pl.ds(i, 1)]                      # [1, Hq, 1, Dh]
+
+        def half_slice(buf_val, sbuf_val, spl_val, c, h):
+            """Lane slice ``h`` of a loaded chunk in the compute dtype:
+            dequantized against its pair-grouped scale row (quant) or
+            sliced directly (bf16), with the new token spliced in at
+            its own (row, half)."""
+            x = buf_val[..., h * dh:(h + 1) * dh]    # [1, Hkv, CSP, Dh]
+            if quant:
+                sc = sbuf_val[:, :, h, :]            # [1, Hkv, CSP]
+                x = (x.astype(cdtype) * sc[..., None].astype(cdtype))
+            rowg = c * csp + jax.lax.broadcasted_iota(
+                jnp.int32, (1, hkv, csp, dh), 2)
+            spl = rowg == idx_i // pair
+            if pair > 1:
+                spl &= jnp.full((1, hkv, csp, dh),
+                                jax.lax.rem(idx_i, pair) == h)
+            # spl_val is a traced VALUE (not a ref); i is a static
+            # python index, so plain slicing selects the row
+            return jnp.where(
+                spl, spl_val[i:i + 1][..., h * dh:(h + 1) * dh], x)
 
         def body(c, _):
             slot = jax.lax.rem(c, 2)
@@ -465,29 +662,19 @@ def _block_kernel(layer_ref, idx_ref, tbl_ref, q_ref, kn_ref, vn_ref,
 
             @pl.when(c + 1 < nblk)
             def _prefetch():
-                chunk_dma(nxt, c + 1, k_ref, kbuf, 0).start()
-                chunk_dma(nxt, c + 1, v_ref, vbuf, 1).start()
+                start_chunk(nxt, c + 1)
 
+            # K first: scores + running-max math run under the V half's
+            # remaining flight time (ISSUE 12 fused-decode shave)
             chunk_dma(slot, c, k_ref, kbuf, 0).wait()
-            chunk_dma(slot, c, v_ref, vbuf, 1).wait()
-            kc = kbuf[slot]                          # [1, Hkv, CSP, Dh*pair]
-            vc = vbuf[slot]
-            # splice the new token in-register (its async window
-            # write-back may still be in flight; only its own pair-row
-            # can race, and the splice overrides exactly that row)
-            rowg = c * csp + jax.lax.broadcasted_iota(
-                jnp.int32, (1, hkv, csp, dhp), 2)
-            spl = rowg == idx_i // pair
-            if pair > 1:
-                spl &= (jax.lax.broadcasted_iota(
-                    jnp.int32, (1, hkv, csp, dhp), 3) // dh
-                        == jax.lax.rem(idx_i, pair))
-            kc = jnp.where(spl, kn_ref[pl.ds(i, 1)], kc)
-            vc = jnp.where(spl, vn_ref[pl.ds(i, 1)], vc)
+            if quant:
+                chunk_dma(slot, c, ks_ref, ksbuf, 2).wait()
+            kq = kbuf[slot]                          # [1, Hkv, CSP, Dh*pair]
+            ksc = ksbuf[slot] if quant else None
             ss = []
             for h in range(pair):
-                k = kc[..., h * dh:(h + 1) * dh]     # [1, Hkv, CSP, Dh]
-                if rep == 1:
+                k = half_slice(kq, ksc, kn_spl, c, h)
+                if rep == 1 and mha == "vpu":
                     s = jnp.sum(qv * k, -1, dtype=jnp.float32)
                 else:
                     qg = qv.reshape(hkv, rep, dh)
@@ -507,11 +694,18 @@ def _block_kernel(layer_ref, idx_ref, tbl_ref, q_ref, kn_ref, vn_ref,
             corr = jnp.exp(m_prev - m_new)
             l_new = l_ref[...] * corr
             acc = acc_ref[...] * corr[:, :, None]
-            for h, s in enumerate(ss):
-                p = jnp.exp(s - m_new[:, :, None])
+            ps = [jnp.exp(s - m_new[:, :, None]) for s in ss]
+            for p in ps:
                 l_new = l_new + p.sum(-1)
-                v = vc[..., h * dh:(h + 1) * dh]
-                if rep == 1:
+
+            chunk_dma(slot, c, v_ref, vbuf, 1).wait()
+            if quant:
+                chunk_dma(slot, c, vs_ref, vsbuf, 3).wait()
+            vq = vbuf[slot]
+            vsc = vsbuf[slot] if quant else None
+            for h, p in enumerate(ps):
+                v = half_slice(vq, vsc, vn_spl, c, h)
+                if rep == 1 and mha == "vpu":
                     pb_ = p[:, :, :, None].astype(v.dtype)
                     pv = jnp.sum(pb_ * v, 2, dtype=jnp.float32)
                 else:
@@ -536,42 +730,67 @@ def _block_kernel(layer_ref, idx_ref, tbl_ref, q_ref, kn_ref, vn_ref,
     for i in range(b):
         pltpu.make_async_copy(
             kwin.at[pl.ds(i, 1)],
-            k_ref.at[layer, pl.ds(pbs[i], 1), :, pl.ds(w0s[i], 8), :],
+            k_ref.at[layer, pl.ds(pbs[i], 1), :, pl.ds(w0s[i], nwin), :],
             wsem.at[0, i]).wait()
         pltpu.make_async_copy(
             vwin.at[pl.ds(i, 1)],
-            v_ref.at[layer, pl.ds(pbs[i], 1), :, pl.ds(w0s[i], 8), :],
+            v_ref.at[layer, pl.ds(pbs[i], 1), :, pl.ds(w0s[i], nwin), :],
             wsem.at[1, i]).wait()
+        if quant:
+            pltpu.make_async_copy(
+                kswin.at[pl.ds(i, 1)],
+                ks_ref.at[layer, pl.ds(pbs[i], 1), :, :, :],
+                wsem.at[2, i]).wait()
+            pltpu.make_async_copy(
+                vswin.at[pl.ds(i, 1)],
+                vs_ref.at[layer, pl.ds(pbs[i], 1), :, :, :],
+                wsem.at[3, i]).wait()
 
 
-def fused_block_decode_step(q: jax.Array, k_pool: jax.Array,
-                            v_pool: jax.Array, k_new: jax.Array,
-                            v_new: jax.Array, layer, idx, block_table, *,
+def fused_block_decode_step(q: jax.Array, k_pool, v_pool,
+                            k_new: jax.Array, v_new: jax.Array,
+                            layer, idx, block_table, *,
                             scale: Optional[float] = None,
-                            interpret: Optional[bool] = None):
+                            interpret: Optional[bool] = None,
+                            plan: Optional[dict] = None):
     """One decode layer-step against the BLOCK-PAGED pool (ISSUE 6).
 
     q:             [B, 1, Hq, Dh]   — the new token's queries
     k_pool/v_pool: [L, N+1, Hkv, bs(/pair), Dh(*pair)] block pools
-                   (serving/kv_blocks.BlockKVPool; last row = garbage)
+                   (serving/kv_blocks.BlockKVPool; last row = garbage),
+                   or the quantized ``{"q": payload, "s": scales}``
+                   pytrees (ISSUE 12, serving/kv_quant.py) — the kernel
+                   then streams 1-byte payload chunks and dequantizes
+                   in-register, and quantizes the new token on store.
     k_new/v_new:   [B, 1, Hkv, Dh]  — the new token's K/V (unwritten)
     layer:         scalar int32
     idx:           [B] int32 per-slot valid lengths
     block_table:   [B, MB] int32 — TRACED data, one compiled program
                    serves every block assignment.
+    plan:          optional measured-plan override (the autotune
+                   harness's candidate; ops/autotune.py entries are
+                   consulted otherwise).
 
     Returns ``(attn [B, 1, Hq, Dh], k_pool, v_pool)`` with the pools
     updated in place (the returned pools alias the inputs).
     """
     b, t, hq, dh = q.shape
     assert t == 1, "fused_block_decode_step is the single-token path"
-    l, n_phys, hkv, bsp, d_last = k_pool.shape
+    quant = isinstance(k_pool, dict)
+    kq_pool = k_pool["q"] if quant else k_pool
+    vq_pool = v_pool["q"] if quant else v_pool
+    l, n_phys, hkv, bsp, d_last = kq_pool.shape
     pair = d_last // dh
     bs = bsp * pair
     assert supports_block(hq, hkv, bs, dh), (hq, hkv, bs, dh)
     want_pair = 128 // dh if dh < 128 else 1
     assert pair == want_pair, (d_last, dh)  # router checks kv_pack_factor
     sc = float(scale) if scale is not None else dh ** -0.5
+    store_dtype = kq_pool.dtype
+    kv_dtype = ("int8" if store_dtype == jnp.int8 else "fp8") if quant \
+        else "compute"
+    vmem, mha = _resolve_block_plan(
+        b, hkv, bs, dh, jnp.dtype(store_dtype).itemsize, override=plan)
 
     qf = q.transpose(0, 2, 1, 3)                   # [B, Hq, 1, Dh]
     kn = k_new.transpose(0, 2, 1, 3)               # [B, Hkv, 1, Dh]
@@ -587,52 +806,85 @@ def fused_block_decode_step(q: jax.Array, k_pool: jax.Array,
 
     kernel = functools.partial(
         _block_kernel, b=b, mb=mb, csp=bsp, hq=hq, hkv=hkv, dh=dh,
-        pair=pair, scale=sc)
-    attn, k_out, v_out = pl.pallas_call(
+        pair=pair, scale=sc, quant=quant, kv_dtype=kv_dtype, mha=mha)
+    in_specs = [
+        pl.BlockSpec(memory_space=pltpu.SMEM),   # layer
+        pl.BlockSpec(memory_space=pltpu.SMEM),   # idx
+        pl.BlockSpec(memory_space=pltpu.SMEM),   # block table
+        pl.BlockSpec(memory_space=pltpu.VMEM),   # q
+        pl.BlockSpec(memory_space=pltpu.VMEM),   # k_new
+        pl.BlockSpec(memory_space=pltpu.VMEM),   # v_new
+        pl.BlockSpec(memory_space=pl.ANY),       # k payload (aliased)
+        pl.BlockSpec(memory_space=pl.ANY),       # v payload (aliased)
+    ]
+    out_specs = [pl.BlockSpec(memory_space=pltpu.VMEM),
+                 pl.BlockSpec(memory_space=pl.ANY),
+                 pl.BlockSpec(memory_space=pl.ANY)]
+    out_shape = [jax.ShapeDtypeStruct((b, hq, dh), q.dtype),
+                 jax.ShapeDtypeStruct(kq_pool.shape, kq_pool.dtype),
+                 jax.ShapeDtypeStruct(vq_pool.shape, vq_pool.dtype)]
+    nwin = bsp if quant else 8
+    scratch = [
+        pltpu.VMEM((2, 1, hkv, bsp, dh * pair), kq_pool.dtype),
+        pltpu.VMEM((2, 1, hkv, bsp, dh * pair), vq_pool.dtype),
+    ]
+    operands = [layer_a, idx_a, tbl, qf, kn, vn, kq_pool, vq_pool]
+    if quant:
+        ks_pool, vs_pool = k_pool["s"], v_pool["s"]
+        in_specs += [pl.BlockSpec(memory_space=pl.ANY),   # k scales
+                     pl.BlockSpec(memory_space=pl.ANY)]   # v scales
+        out_specs += [pl.BlockSpec(memory_space=pl.ANY),
+                      pl.BlockSpec(memory_space=pl.ANY)]
+        out_shape += [jax.ShapeDtypeStruct(ks_pool.shape, ks_pool.dtype),
+                      jax.ShapeDtypeStruct(vs_pool.shape, vs_pool.dtype)]
+        operands += [ks_pool, vs_pool]
+        scratch += [  # scale chunk double-buffers
+            pltpu.VMEM((2, 1, hkv, pair, bsp), ks_pool.dtype),
+            pltpu.VMEM((2, 1, hkv, pair, bsp), vs_pool.dtype),
+        ]
+        aliases = {6: 1, 7: 2, 8: 3, 9: 4}
+    else:
+        aliases = {6: 1, 7: 2}
+    scratch += [
+        pltpu.VMEM((b, hkv, nwin, dh * pair), kq_pool.dtype),  # write window
+        pltpu.VMEM((b, hkv, nwin, dh * pair), vq_pool.dtype),
+    ]
+    if quant:
+        scratch += [  # scale-row write windows
+            pltpu.VMEM((b, hkv, pair, bsp), k_pool["s"].dtype),
+            pltpu.VMEM((b, hkv, pair, bsp), v_pool["s"].dtype),
+        ]
+    scratch += [
+        pltpu.VMEM((1, hq), jnp.float32),                  # running max
+        pltpu.VMEM((1, hq), jnp.float32),                  # running sum
+        pltpu.VMEM((1, hq, dh), jnp.float32),              # accumulator
+        pltpu.SemaphoreType.DMA((4 if quant else 2, b)),   # write sems
+        pltpu.SemaphoreType.DMA((2, 4 if quant else 2)),   # read sems
+    ]
+    out = pl.pallas_call(
         kernel,
-        in_specs=[
-            pl.BlockSpec(memory_space=pltpu.SMEM),   # layer
-            pl.BlockSpec(memory_space=pltpu.SMEM),   # idx
-            pl.BlockSpec(memory_space=pltpu.SMEM),   # block table
-            pl.BlockSpec(memory_space=pltpu.VMEM),   # q
-            pl.BlockSpec(memory_space=pltpu.VMEM),   # k_new
-            pl.BlockSpec(memory_space=pltpu.VMEM),   # v_new
-            pl.BlockSpec(memory_space=pl.ANY),       # k_pool (aliased)
-            pl.BlockSpec(memory_space=pl.ANY),       # v_pool (aliased)
-        ],
-        out_specs=[
-            pl.BlockSpec(memory_space=pltpu.VMEM),
-            pl.BlockSpec(memory_space=pl.ANY),
-            pl.BlockSpec(memory_space=pl.ANY),
-        ],
-        out_shape=[
-            jax.ShapeDtypeStruct((b, hq, dh), q.dtype),
-            jax.ShapeDtypeStruct(k_pool.shape, k_pool.dtype),
-            jax.ShapeDtypeStruct(v_pool.shape, v_pool.dtype),
-        ],
-        scratch_shapes=[
-            pltpu.VMEM((2, 1, hkv, bsp, dh * pair), k_pool.dtype),
-            pltpu.VMEM((2, 1, hkv, bsp, dh * pair), v_pool.dtype),
-            pltpu.VMEM((b, hkv, 8, dh * pair), k_pool.dtype),  # write window
-            pltpu.VMEM((b, hkv, 8, dh * pair), v_pool.dtype),
-            pltpu.VMEM((1, hq), jnp.float32),                  # running max
-            pltpu.VMEM((1, hq), jnp.float32),                  # running sum
-            pltpu.VMEM((1, hq, dh), jnp.float32),              # accumulator
-            pltpu.SemaphoreType.DMA((2, b)),                   # write sems
-            pltpu.SemaphoreType.DMA((2, 2)),                   # read sems
-        ],
-        input_output_aliases={6: 1, 7: 2},
-        compiler_params=_compiler_params(),
+        in_specs=in_specs,
+        out_specs=out_specs,
+        out_shape=out_shape,
+        scratch_shapes=scratch,
+        input_output_aliases=aliases,
+        compiler_params=_compiler_params(vmem),
         interpret=(jax.default_backend() != "tpu" if interpret is None
                    else interpret),
-    )(layer_a, idx_a, tbl, qf, kn, vn, k_pool, v_pool)
+    )(*operands)
+    if quant:
+        attn, k_out, v_out, ks_out, vs_out = out
+        return (attn[:, None], {"q": k_out, "s": ks_out},
+                {"q": v_out, "s": vs_out})
+    attn, k_out, v_out = out
     return attn[:, None], k_out, v_out
 
 
 def fused_decode_step(q: jax.Array, k_full: jax.Array, v_full: jax.Array,
                       k_new: jax.Array, v_new: jax.Array,
                       layer, idx, *, scale: Optional[float] = None,
-                      interpret: Optional[bool] = None):
+                      interpret: Optional[bool] = None,
+                      plan: Optional[dict] = None):
     """One decode layer-step against the FULL stacked cache.
 
     q:            [B, 1, Hq, Dh]  — the new token's queries
@@ -644,6 +896,9 @@ def fused_decode_step(q: jax.Array, k_full: jax.Array, v_full: jax.Array,
                   serving/engine.py) — each row then writes at and
                   attends over its own prefix, and each batch group
                   streams to the group's max length.
+    plan:         optional measured-plan override (the autotune
+                  harness's candidate; ops/autotune.py entries are
+                  consulted otherwise — ``_resolve_plan``).
 
     Returns ``(attn [B, 1, Hq, Dh], k_full, v_full)`` with the caches
     updated in place (the returned caches alias the inputs).
@@ -657,7 +912,8 @@ def fused_decode_step(q: jax.Array, k_full: jax.Array, v_full: jax.Array,
     assert pair in (1, 128 // dh if dh < 128 else 1), (d_last, dh)
     want_pair = 128 // dh if dh < 128 else 1
     sc = float(scale) if scale is not None else dh ** -0.5
-    bg, cs = _plan(b, hkv, s_max, dh, jnp.dtype(k_full.dtype).itemsize)
+    bg, cs, vmem, mha = _resolve_plan(
+        b, hkv, s_max, dh, jnp.dtype(k_full.dtype).itemsize, override=plan)
 
     qf = q.transpose(0, 2, 1, 3)                   # [B, Hq, 1, Dh]
     kn = k_new.transpose(0, 2, 1, 3)               # [B, Hkv, 1, Dh]
@@ -681,7 +937,7 @@ def fused_decode_step(q: jax.Array, k_full: jax.Array, v_full: jax.Array,
 
     kernel = functools.partial(
         _kernel, b=b, bg=bg, cs=cs, hq=hq, hkv=hkv, dh=dh, pair=pair,
-        scale=sc, per_slot=per_slot)
+        scale=sc, per_slot=per_slot, mha=mha)
     attn, k_out, v_out = pl.pallas_call(
         kernel,
         in_specs=[
@@ -716,7 +972,7 @@ def fused_decode_step(q: jax.Array, k_full: jax.Array, v_full: jax.Array,
             pltpu.SemaphoreType.DMA((2, 2)),                   # read sems
         ],
         input_output_aliases={5: 1, 6: 2},
-        compiler_params=_compiler_params(),
+        compiler_params=_compiler_params(vmem),
         interpret=(jax.default_backend() != "tpu" if interpret is None
                    else interpret),
     )(layer_a, idx_a, qf, kn, vn, kview, vview)

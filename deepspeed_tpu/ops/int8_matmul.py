@@ -95,22 +95,22 @@ def plan_blocks(d: int, e: int):
     return bd, be, (d // bd) * (e // be)
 
 
-def _dma_plan(d: int, e: int, cap: int = 2_500_000):
-    """(bd, be) divisor tiles for the manual-DMA kernel. Offsets/extents
-    must align to the HBM tiling (128 on both edges here: the bf16
-    activation slice shares bd), but tiles only need to DIVIDE the dims —
-    not be powers of two — so divisor-hostile dims still tile fat
-    (11008 = 2^7*86). DMA throughput is set by the ROW length (a [bd, be]
-    tile is bd strided rows of be bytes; be == E is one contiguous
-    block — measured 8x the bandwidth of 256-byte rows), so maximize be
-    FIRST, then bd under the VMEM cap."""
+def _aligned_divisors(n):
+    return [m for m in range(128, n + 1, 128) if n % m == 0]
 
-    def aligned_divisors(n):
-        return [m for m in range(128, n + 1, 128) if n % m == 0]
 
+def _hand_dma_plan(d: int, e: int, cap: int = 2_500_000):
+    """Hand-picked (bd, be) divisor tiles for the manual-DMA kernel.
+    Offsets/extents must align to the HBM tiling (128 on both edges
+    here: the bf16 activation slice shares bd), but tiles only need to
+    DIVIDE the dims — not be powers of two — so divisor-hostile dims
+    still tile fat (11008 = 2^7*86). DMA throughput is set by the ROW
+    length (a [bd, be] tile is bd strided rows of be bytes; be == E is
+    one contiguous block — measured 8x the bandwidth of 256-byte rows),
+    so maximize be FIRST, then bd under the VMEM cap."""
     best = None
-    for be in aligned_divisors(e):
-        for bd in aligned_divisors(d):
+    for be in _aligned_divisors(e):
+        for bd in _aligned_divisors(d):
             if bd * be > cap:
                 continue
             key = (be, bd)  # row length dominates; then tile size
@@ -121,6 +121,31 @@ def _dma_plan(d: int, e: int, cap: int = 2_500_000):
         # not a multiple of 128): callers fall back to the einsum path
         return None
     return best[1], best[2]
+
+
+def _dma_plan(d: int, e: int, cap: int = 2_500_000):
+    """(bd, be) tiles: the MEASURED artifact entry (ops/autotune.py,
+    ISSUE 12 satellite) when one exists for this backend+shape and
+    validates (128-aligned divisors of the live dims), else the
+    hand-picked :func:`_hand_dma_plan`. An entry may carry either
+    explicit ``bd``/``be`` tiles or just a re-tuned VMEM ``cap``."""
+    from deepspeed_tpu.ops import autotune
+
+    ent = autotune.lookup("int8_matmul_dma", autotune.matmul_key(d, e))
+    if ent:
+        try:
+            if "bd" in ent and "be" in ent:
+                bd, be = int(ent["bd"]), int(ent["be"])
+                if (bd in _aligned_divisors(d)
+                        and be in _aligned_divisors(e)):
+                    return bd, be
+            elif "cap" in ent:
+                plan = _hand_dma_plan(d, e, int(ent["cap"]))
+                if plan is not None:
+                    return plan
+        except Exception:
+            pass
+    return _hand_dma_plan(d, e, cap)
 
 
 def _dma_kernel(layer_ref, x_ref, s_ref, w_any, o_ref, wbuf, acc_ref, sem,
@@ -165,9 +190,10 @@ def _dma_kernel(layer_ref, x_ref, s_ref, w_any, o_ref, wbuf, acc_ref, sem,
                     jnp.float32)).astype(out_dtype)
 
 
-@functools.partial(jax.jit, static_argnames=("interpret",))
+@functools.partial(jax.jit, static_argnames=("interpret", "plan"))
 def int8_matmul_dma(x: jax.Array, q: jax.Array, s: jax.Array,
-                    layer=None, interpret: Optional[bool] = None) -> jax.Array:
+                    layer=None, interpret: Optional[bool] = None,
+                    plan: Optional[tuple] = None) -> jax.Array:
     """``(x [B, D]) @ (q [D, E] int8) * (s [..., E] f32) -> [B, E]`` as ONE
     Pallas invocation with manually-driven DMA over divisor tiles.
 
@@ -183,7 +209,10 @@ def int8_matmul_dma(x: jax.Array, q: jax.Array, s: jax.Array,
     pt_binding.cpp:1747-1806) — HBM sees 1 byte/weight, the upcast rides
     the register file. Requires D % 128 == 0 and E % 128 == 0 (int8 HBM
     tile + bf16 activation-slice alignment); ``qdot`` falls back to the
-    einsum otherwise.
+    einsum otherwise. ``plan`` (static ``(bd, be)`` tuple) overrides the
+    tile plan — the autotune micro-bench harness's candidate; production
+    callers leave it None and get the measured-artifact-or-hand-picked
+    resolution of ``_dma_plan``.
     """
     b, d = x.shape
     stacked = q.ndim == 3
@@ -194,9 +223,11 @@ def int8_matmul_dma(x: jax.Array, q: jax.Array, s: jax.Array,
         d2, e = q.shape
         nl = 1
     assert d == d2, (x.shape, q.shape)
-    plan = _dma_plan(d, e)
+    if plan is None:
+        plan = _dma_plan(d, e)
     assert plan is not None, (d, e)
     bd, be = plan
+    assert d % bd == 0 and e % be == 0, (plan, d, e)
     s = s.reshape(nl, e)
     layer_a = jnp.asarray(0 if layer is None else layer, jnp.int32).reshape(1)
     if interpret is None:

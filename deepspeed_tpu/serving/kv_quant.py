@@ -1,0 +1,183 @@
+"""Quantized KV-cache block storage (ISSUE 12).
+
+Low-bit KV caches (KIVI, Liu et al. 2024) are near-lossless for decode
+while doubling the tokens cached per HBM byte — and every serving layer
+above the pool (radix prefix cache, preemption swap, the multi-replica
+fabric) multiplies whatever capacity the KV layer provides. This module
+owns the quantization math and the storage convention; the write/read
+paths live in ops/attention.py (einsum) and ops/decode_step.py (fused
+Pallas), and serving/kv_blocks.BlockKVPool allocates the pools.
+
+Storage convention
+------------------
+A quantized pool is a PYTREE ``{"q": payload, "s": scales}`` instead of
+one array:
+
+  * ``payload`` keeps the exact unquantized pool shape
+    ``[L, N+1, Hkv, bs/pair, Dh*pair]`` in the storage dtype (int8 or
+    float8_e4m3fn) — the same token-pair packing, the same garbage
+    sentinel row, the same block-table addressing;
+  * ``scales`` is ``[L, N+1, Hkv, pair, bs/pair]`` bf16 — ONE symmetric
+    scale per (layer, block, head, token), stored PAIR-GROUPED: token
+    ``t`` of a block lives at ``[..., t % pair, t // pair]``, aligned
+    with the packed payload's lane slices so the fused decode kernel
+    indexes scales by SUBLANE (supported everywhere) instead of a
+    strided lane slice (not portable across Mosaic versions).
+
+Because the pool is a pytree, models never change: the cache dict rides
+the layer-scan carry opaquely, jit programs take it as a normal operand
+tree, and the zero-recompile invariant holds by construction — payloads
+and scales are traced data exactly like the block table.
+
+Scale granularity
+-----------------
+Per-token-per-head, NOT per-block: blocks are APPENDED to in place
+(decode writes one token at a time into the tail block), and a
+per-block scale fixed by earlier tokens would clip any later token with
+a larger amplitude — or force an in-place requantization of the whole
+block on every amax growth. A per-token scale is write-local: each
+token's scale is computed from its own K/V row at store time and never
+revised. Overhead: 2 bytes per (token, head, layer) against Dh payload
+bytes — 3.1% at Dh=64, 1.6% at Dh=128.
+
+Accuracy
+--------
+Symmetric round-to-nearest with the scale itself rounded to bf16 BEFORE
+the payload divide (quantize and dequantize must share the identical
+scale, or the rounding of the scale becomes a multiplicative bias).
+Worst-case per-element relative error ~1/254 for int8; fp8 e4m3 carries
+a ~2^-3 relative mantissa step at full scale. Greedy decode parity is
+gated at >= 0.99 exact-match rate by tests/unit/serving/test_kv_quant.py
+and the bench's ``serving_kv_quant`` mode.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+# storage dtype + symmetric quantization ceiling per kv_dtype name
+_KV_DTYPES = {
+    "int8": (jnp.int8, 127.0),
+    "fp8": (jnp.float8_e4m3fn, 448.0),
+}
+
+SCALE_DTYPE = jnp.bfloat16
+
+# floor on the stored scale: a zero K/V row must dequantize to zero
+# without a 0/0 in the quantize divide. SHARED with the fused kernel's
+# in-register quantizer (ops/decode_step._quantize_token) — the
+# kernel-vs-einsum stored-byte bit-identity depends on both paths using
+# the identical floor and qmax.
+SCALE_FLOOR = 1e-8
+_SCALE_FLOOR = SCALE_FLOOR
+
+
+def normalize_kv_dtype(kv_dtype) -> Optional[str]:
+    """Canonical kv_dtype name: ``None`` means unquantized (the pool
+    stays in the engine's compute dtype)."""
+    if kv_dtype in (None, "bf16", "bfloat16", "fp32", "float32"):
+        return None
+    if kv_dtype == "int8":
+        return "int8"
+    if kv_dtype in ("fp8", "float8", "float8_e4m3", "float8_e4m3fn"):
+        return "fp8"
+    raise ValueError(
+        f"kv_dtype must be one of None/'bf16'/'int8'/'fp8', got "
+        f"{kv_dtype!r}")
+
+
+def storage_dtype(kv_dtype: str):
+    return _KV_DTYPES[kv_dtype][0]
+
+
+def kv_qmax(kv_dtype: str) -> float:
+    return _KV_DTYPES[kv_dtype][1]
+
+
+def is_quantized_pool(pool) -> bool:
+    """True for the ``{"q", "s"}`` pytree form (array pools are the
+    unquantized mode)."""
+    return isinstance(pool, dict) and "q" in pool
+
+
+def pool_payload(pool):
+    """The payload array of either pool form (shape/addressing queries
+    never care about the scales)."""
+    return pool["q"] if is_quantized_pool(pool) else pool
+
+
+def kv_quantize_keepdims(x: jax.Array, kv_dtype: str
+                         ) -> Tuple[jax.Array, jax.Array]:
+    """Symmetric per-row quantization of ``x [..., Dh]`` →
+    ``(payload [..., Dh] storage-dtype, scale [..., 1] bf16)``.
+
+    The scale is rounded to its bf16 storage form BEFORE the divide so
+    quantize and dequantize use bit-identical scales (an f32 quantize
+    scale + bf16 stored scale would bias every element by the scale's
+    own rounding error).
+
+    This keepdims form is THE quantizer — the fused Pallas kernel calls
+    it directly (ops/decode_step._quantize_token; keepdims because
+    Mosaic cannot unit-dim-reshape bf16 vectors), so the
+    kernel-vs-einsum stored-byte bit-identity holds by shared code, not
+    by two hand-synchronized copies."""
+    x32 = x.astype(jnp.float32)
+    amax = jnp.max(jnp.abs(x32), axis=-1, keepdims=True)
+    s = jnp.maximum(amax / kv_qmax(kv_dtype), _SCALE_FLOOR) \
+        .astype(SCALE_DTYPE)
+    y = x32 / s.astype(jnp.float32)
+    if kv_dtype == "int8":
+        payload = jnp.clip(jnp.round(y), -127.0, 127.0).astype(jnp.int8)
+    else:
+        payload = y.astype(jnp.float8_e4m3fn)
+    return payload, s
+
+
+def kv_quantize(x: jax.Array, kv_dtype: str) -> Tuple[jax.Array, jax.Array]:
+    """:func:`kv_quantize_keepdims` with the scale's unit dim squeezed
+    (the einsum write path's shape: scale ``[...]`` scatters into the
+    pair-grouped scale array)."""
+    payload, s = kv_quantize_keepdims(x, kv_dtype)
+    return payload, s[..., 0]
+
+
+def kv_dequantize(payload: jax.Array, scale: jax.Array, dtype) -> jax.Array:
+    """``payload [..., Dh] * scale [...]`` → ``[..., Dh]`` in ``dtype``
+    (f32 multiply — the storage upcast fuses into it)."""
+    return (payload.astype(jnp.float32)
+            * scale.astype(jnp.float32)[..., None]).astype(dtype)
+
+
+def scales_token_order(s_rows: jax.Array) -> jax.Array:
+    """Pair-grouped scales ``[..., pair, bs/pair]`` → token-ordered
+    ``[..., bs]`` (token ``t = r * pair + h`` reads ``[..., h, r]``) —
+    the einsum gather path's view; the fused kernel consumes the
+    pair-grouped form directly."""
+    pair, bsp = s_rows.shape[-2], s_rows.shape[-1]
+    return jnp.moveaxis(s_rows, -2, -1).reshape(
+        s_rows.shape[:-2] + (pair * bsp,))
+
+
+def quantized_pool_like(base_pool: jax.Array, head_dim: int,
+                        kv_dtype: str):
+    """Allocate the ``{"q", "s"}`` pool matching an unquantized pool's
+    shape (serving/kv_blocks.BlockKVPool sizes the base via
+    ``model.init_cache``). Scales init to zero so NEVER-written rows
+    dequantize to 0.0 at allocation; once serving runs, inactive
+    slots' masked writes park real scales in the garbage row — from
+    then on it holds finite junk exactly like the unquantized pool's
+    garbage row, always dead behind the per-slot length mask."""
+    l, n, hkv, bsp, dhp = base_pool.shape
+    pair = dhp // head_dim
+    return {"q": jnp.zeros(base_pool.shape, storage_dtype(kv_dtype)),
+            "s": jnp.zeros((l, n, hkv, pair, bsp), SCALE_DTYPE)}
+
+
+def tree_nbytes(tree) -> int:
+    """Total bytes of a pool/blocks pytree (host or device arrays) —
+    the swap buffer's and telemetry's byte accounting unit."""
+    return sum(int(a.size) * jnp.dtype(a.dtype).itemsize
+               for a in jax.tree_util.tree_leaves(tree))

@@ -26,6 +26,7 @@ from typing import Dict, Optional, Tuple
 import numpy as np
 
 from deepspeed_tpu.serving.errors import SwapCapacityError
+from deepspeed_tpu.serving.kv_quant import tree_nbytes
 
 
 class HostSwapBuffer:
@@ -63,19 +64,24 @@ class HostSwapBuffer:
         return (self.max_bytes is None
                 or self.bytes_stored + nbytes <= self.max_bytes)
 
-    def put(self, rid: int, k: np.ndarray, v: np.ndarray) -> None:
+    def put(self, rid: int, k, v) -> None:
+        """Park a preempted request's KV. ``k``/``v`` are numpy arrays
+        (slot rows / bf16 block stacks) or numpy PYTREES — the
+        quantized pools' ``{"q", "s"}`` payload+scale trees (ISSUE 12),
+        whose int8/fp8 payloads halve the bytes parked per block."""
         if rid in self._entries:
             raise ValueError(
                 f"request {rid} is already swapped out (double preemption "
                 f"without a resume)")
-        if not self.fits(k.nbytes + v.nbytes):
+        nbytes = tree_nbytes(k) + tree_nbytes(v)
+        if not self.fits(nbytes):
             self.capacity_rejections += 1
             raise SwapCapacityError(
                 f"host swap buffer full: {self.bytes_stored} bytes stored "
-                f"+ {k.nbytes + v.nbytes} requested exceeds max_bytes "
+                f"+ {nbytes} requested exceeds max_bytes "
                 f"{self.max_bytes} ({len(self._entries)} parked requests)")
         self._entries[rid] = (k, v)
-        self.bytes_stored += k.nbytes + v.nbytes
+        self.bytes_stored += nbytes
         self.peak_bytes = max(self.peak_bytes, self.bytes_stored)
         self.total_swaps_out += 1
 
@@ -88,16 +94,16 @@ class HostSwapBuffer:
         if entry is None:
             return False
         k, v = entry
-        self.bytes_stored -= k.nbytes + v.nbytes
+        self.bytes_stored -= tree_nbytes(k) + tree_nbytes(v)
         return True
 
-    def pop(self, rid: int) -> Tuple[np.ndarray, np.ndarray]:
+    def pop(self, rid: int) -> Tuple:
         if rid not in self._entries:
             raise KeyError(
                 f"request {rid} has no swapped-out KV (resume without a "
                 f"preemption, or a double resume)")
         k, v = self._entries.pop(rid)
-        self.bytes_stored -= k.nbytes + v.nbytes
+        self.bytes_stored -= tree_nbytes(k) + tree_nbytes(v)
         self.total_swaps_in += 1
         return k, v
 

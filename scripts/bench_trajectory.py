@@ -86,22 +86,52 @@ def load_rounds(paths, full=None):
     return out
 
 
+def _measured_spread(metric, flat):
+    """IQR-derived relative noise for a metric that reports a measured
+    spread (ISSUE 12 variance discipline): benches that emit
+    ``<base>.median`` + ``<base>.iqr`` window statistics carry their
+    OWN noise estimate, so the regression gate for ``<base>.median``
+    (and a bare ``<base>`` echoing it) widens to the measured IQR
+    instead of relying on the fixed global threshold alone. A
+    best-of-windows HEADLINE whose spread rides under a sibling key
+    uses the ``<metric>_windows`` convention (bench.py's top-level
+    ``value`` + ``value_windows.{median,iqr,n}``). Returns None when
+    the round carries no spread for this metric."""
+    if metric.endswith(".median"):
+        base = metric[:-len(".median")]
+    else:
+        base = metric
+    for spread_base in (base, base + "_windows"):
+        iqr = flat.get(spread_base + ".iqr")
+        med = flat.get(spread_base + ".median", flat.get(metric))
+        if iqr is not None and med:
+            return abs(iqr) / abs(med)
+    return None
+
+
 def trend(rounds, threshold=0.10):
     """Per-metric series + newest-vs-previous flag. Returns
     {metric: {"series": {label: value}, "flag": ..., "delta_pct": ...}}
-    over the union of metrics, sorted by path."""
+    over the union of metrics, sorted by path. Metrics whose last path
+    component is ``iqr``/``n`` are spread METADATA, flagged ``spread``
+    and never counted as regressions; a metric accompanied by a
+    measured spread is gated at ``max(threshold, IQR/median)`` of the
+    newer round — the bench's own window noise."""
     if not rounds:
         return {}
     labels = [lbl for lbl, _ in rounds]
     metrics = sorted({m for _, flat in rounds for m in flat})
     out = OrderedDict()
     last_lbl = labels[-1]
+    last_flat = rounds[-1][1]
     for m in metrics:
         series = OrderedDict((lbl, flat[m]) for lbl, flat in rounds
                              if m in flat)
         rec = {"series": series}
         present = list(series)
-        if last_lbl not in series:
+        if m.rsplit(".", 1)[-1] in ("iqr", "n"):
+            rec["flag"] = "spread"
+        elif last_lbl not in series:
             rec["flag"] = "gone"
         elif len(present) == 1:
             rec["flag"] = "new"
@@ -113,7 +143,12 @@ def trend(rounds, threshold=0.10):
             else:
                 delta = (cur - prev) / abs(prev)
                 rec["delta_pct"] = round(delta * 100.0, 2)
-                if abs(delta) <= threshold:
+                eff = threshold
+                spread = _measured_spread(m, last_flat)
+                if spread is not None:
+                    eff = max(eff, spread)
+                    rec["threshold_pct"] = round(eff * 100.0, 2)
+                if abs(delta) <= eff:
                     rec["flag"] = "stable"
                 else:
                     worse = delta > 0 if lower_is_better(m) else delta < 0
@@ -125,7 +160,8 @@ def trend(rounds, threshold=0.10):
 def render(t, only_flagged=False):
     rows = []
     for m, rec in t.items():
-        if only_flagged and rec["flag"] in ("stable", "new", "gone"):
+        if only_flagged and rec["flag"] in ("stable", "new", "gone",
+                                            "spread"):
             continue
         series = rec["series"]
         vals = " ".join(f"{lbl}={v:g}" for lbl, v in series.items())

@@ -42,6 +42,12 @@ grep -qs "def test_" tests/unit/runtime/test_resilience.py || { echo "tier-1: re
 # tests/unit/telemetry/test_spans.py
 grep -qs "def test_" tests/unit/serving/test_tracing.py || { echo "tier-1: tracing tests missing"; exit 1; }
 grep -qs "def test_" tests/unit/telemetry/test_spans.py || { echo "tier-1: span tests missing"; exit 1; }
+# likewise the quantized-KV suite (marker `kvquant`): int8/fp8 block
+# round-trip bounds, capacity ratios, fused dequant-kernel parity,
+# greedy exact-match gate, COW/swap/prefix-hit invariants on quantized
+# pools, and autotuned kernel-plan loading ride `-m 'not slow'` through
+# tests/unit/serving/test_kv_quant.py
+grep -qs "def test_" tests/unit/serving/test_kv_quant.py || { echo "tier-1: kv-quant tests missing"; exit 1; }
 # metric-name drift lint (ISSUE 11 satellite): README metric/event
 # names must exactly cover the counter/gauge/histogram/record_event
 # call sites — fails on undocumented or stale names

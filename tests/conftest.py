@@ -300,7 +300,8 @@ _LATE_MODULES = _OBSERVABILITY_MODULES + (
     "unit/serving/test_slo",
     "unit/serving/test_fabric",
     "unit/runtime/test_resilience",
-    "unit/serving/test_tracing",)
+    "unit/serving/test_tracing",
+    "unit/serving/test_kv_quant",)
 
 
 def pytest_collection_modifyitems(config, items):
