@@ -333,6 +333,37 @@ class DeepSpeedEngine:
                     span_sink = self.telemetry.sink  # interleave, if any
                 self.tracer = _tele.SpanTracer(sink=span_sink)
                 self._train_trace = self.tracer.new_trace()
+        # ---- flight recorder + SLO seam (ISSUE 13): the recorder tees
+        # the telemetry/span streams into bounded rings and dumps one
+        # postmortem JSON when the sentinel hits an actionable anomaly;
+        # an SLOEngine attached via set_slo() is evaluated at the
+        # sentinel's existing check fence (no extra device syncs).
+        self.flight_recorder = None
+        self.slo = None
+        # the sink THIS engine attached to the (global) registry — the
+        # owned JsonlSink itself, or the flight-recorder tee wrapping
+        # it. _shutdown compares against this, not _owned_sink: with
+        # the tee in place an identity check on the bare sink would
+        # never match and the registry would keep a closed sink
+        self._attached_sink = self._owned_sink
+        if tcfg.enabled and tcfg.flight_recorder:
+            from deepspeed_tpu import telemetry as _tele
+
+            self.flight_recorder = _tele.FlightRecorder(
+                dump_dir=tcfg.flight_dir or None, registry=self.telemetry)
+            self._attached_sink = self.flight_recorder.tee(
+                self.telemetry.sink)
+            self.telemetry.attach_sink(self._attached_sink)
+            if self.tracer is not None:
+                if self.tracer.sink is self._spans_sink \
+                        and self._spans_sink is not None:
+                    self.tracer.sink = self.flight_recorder.tee(
+                        self._spans_sink)
+                else:
+                    # interleaved spans ride the registry sink, which is
+                    # now the tee — point the tracer at the same tee so
+                    # spans are recorded exactly once
+                    self.tracer.sink = self.telemetry.sink
         # ---- training resilience (ISSUE 10): anomaly sentinel + finite-grad
         # guard + rewind-and-skip auto-recovery + SDC audits. The sentinel
         # consumes per-step device scalars lazily: they queue as jax arrays
@@ -1226,6 +1257,12 @@ class DeepSpeedEngine:
             anomaly = self._sdc_audit_check()
         if anomaly is None and replay_due:
             anomaly = self._sdc_step_replay_check(batch)
+        if self.slo is not None:
+            # SLO judgment at the sentinel's existing fence (ISSUE 13):
+            # the training SLIs (MFU floor, anomaly rate) read gauges/
+            # counters the fence just refreshed — host-only, on the SLO
+            # engine's own clock
+            self.slo.maybe_evaluate()
         if anomaly is not None:
             self._recover_or_raise(anomaly)
             return
@@ -1322,6 +1359,21 @@ class DeepSpeedEngine:
         host from the next worker group."""
         self._sdc_quarantine_cb = cb
 
+    def set_slo(self, slo) -> None:
+        """Attach an :class:`~deepspeed_tpu.telemetry.slo.SLOEngine`
+        (ISSUE 13): the training SLIs (``train_mfu`` floor,
+        ``train_anomaly_rate``) are evaluated at the sentinel's check
+        fence, where the gauges/counters they read were just refreshed.
+        Requires the resilience sentinel to be armed (the fence is the
+        evaluation site); raises otherwise so a misconfigured job fails
+        loudly instead of silently never judging."""
+        if slo is not None and self.sentinel is None:
+            raise ValueError(
+                "set_slo needs the resilience sentinel armed "
+                "(resilience.enabled): SLO evaluation rides the "
+                "sentinel's check fence")
+        self.slo = slo
+
     def _sdc_step_replay_check(self, batch):
         """Single-host determinism probe: the compiled step run twice from
         bit-identical state copies must agree bit-exactly; a mismatch is
@@ -1370,6 +1422,14 @@ class DeepSpeedEngine:
                            step=anomaly.step, value=anomaly.value,
                            zscore=round(anomaly.zscore, 2),
                            detail=anomaly.detail)
+        if self.flight_recorder is not None:
+            # freeze the pre-incident window BEFORE recovery rewinds
+            # state — the dump is the postmortem of what training saw
+            # at detection, not of the already-healed timeline
+            self.flight_recorder.trigger(
+                "training_anomaly", cls=anomaly.cls, step=anomaly.step,
+                value=anomaly.value, zscore=round(anomaly.zscore, 2),
+                detail=anomaly.detail)
         logger.warning("training anomaly: %s at step %d (%s)",
                        anomaly.cls, anomaly.step, anomaly.detail)
         dl = self.training_dataloader
@@ -1478,10 +1538,16 @@ class DeepSpeedEngine:
             self._spans_sink = None
         if self._owned_sink is not None:
             self._owned_sink.close()
-            if self.telemetry is not None and \
-                    self.telemetry.sink is self._owned_sink:
-                self.telemetry.attach_sink(None)
             self._owned_sink = None
+        if self._attached_sink is not None:
+            if self.telemetry is not None and \
+                    self.telemetry.sink is self._attached_sink:
+                # detach whatever THIS engine attached — the bare owned
+                # sink, or the flight-recorder tee wrapping it — so the
+                # process-global registry never keeps writing through a
+                # closed sink (or a dead engine's recorder) afterwards
+                self.telemetry.attach_sink(None)
+            self._attached_sink = None
 
     # ------------------------------------------ forward/backward/step parity
     def forward(self, batch):

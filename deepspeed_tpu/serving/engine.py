@@ -51,6 +51,7 @@ from deepspeed_tpu.serving.speculative import (AdaptiveK, DraftModelDrafter,
                                                normalize_speculative,
                                                pick_k_bucket)
 from deepspeed_tpu.serving.swap import HostSwapBuffer
+from deepspeed_tpu.telemetry.registry import metric_label
 from deepspeed_tpu.utils.logging import log_dist
 
 # accepted-tokens-per-step / tokens-per-decode-call histograms count small
@@ -95,20 +96,23 @@ class _SlotState:
     from the decode batch. The first generated token (and TTFT) exists
     only once the last chunk lands. ``order`` is the engine's admission
     sequence — chunk continuations run priority-then-admission order,
-    so earlier same-class prompts finish prefilling first."""
+    so earlier same-class prompts finish prefilling first. ``tenant``
+    is the request's SANITIZED accounting tenant (ISSUE 13), resolved
+    once at admission."""
 
     __slots__ = ("request", "result", "last_token", "prefill_pos",
-                 "prefill_total", "order")
+                 "prefill_total", "order", "tenant")
 
     def __init__(self, request: Request, result: RequestResult,
                  last_token: int, prefill_pos: int, prefill_total: int,
-                 order: int):
+                 order: int, tenant: str = "default"):
         self.request = request
         self.result = result
         self.last_token = last_token
         self.prefill_pos = prefill_pos
         self.prefill_total = prefill_total
         self.order = order
+        self.tenant = tenant
 
     @property
     def prefilling(self) -> bool:
@@ -269,6 +273,23 @@ class ServingEngine:
         adds no device work: greedy output stays bit-identical and the
         armed-vs-bare overhead is pinned <= 2% by bench.py
         ``tracing_overhead``.
+    slo: an :class:`~deepspeed_tpu.telemetry.slo.SLOEngine` (ISSUE 13),
+        or None (default). When armed, the engine calls
+        ``slo.maybe_evaluate(now)`` once per serving iteration ON THE
+        ENGINE'S OWN CLOCK — a FakeClock trace replays its alert
+        timeline deterministically. Pure host work at the top of
+        step(); greedy output stays bit-identical.
+    tenants: per-tenant usage accounting (ISSUE 13). None (default)
+        follows ``telemetry`` (accounting into the same registry);
+        True forces a (possibly registry-less) ledger; False disables.
+        Tracks per :attr:`Request.tenant_id`: prompt/decode tokens,
+        prefill tokens computed vs saved by the prefix cache, KV
+        block-seconds (pool occupancy integrated over engine-clock
+        time; quantized pools billed at payload bytes), preemptions,
+        deadline sheds, and TTFT/TPOT histograms — all at call sites
+        the engine already owns (zero extra device syncs; the
+        per-tenant token totals sum exactly to the engine counters,
+        pinned by tests).
     """
 
     def __init__(self, engine, *, num_slots: int = 8, max_len: int = 1024,
@@ -286,7 +307,8 @@ class ServingEngine:
                  swap_max_bytes: Optional[int] = None,
                  priority_aging_sec: Optional[float] = None,
                  tpot_slo_ms: Optional[float] = None,
-                 slo_max_defer: int = 4, tracer=None):
+                 slo_max_defer: int = 4, tracer=None,
+                 slo=None, tenants: Optional[bool] = None):
         self.engine = engine
         model = engine.module
         mcfg = getattr(model, "config", None)
@@ -476,6 +498,31 @@ class ServingEngine:
             self.telemetry = get_registry()
         else:
             self.telemetry = telemetry or None
+        # ---- SLO control plane + per-tenant accounting (ISSUE 13)
+        self.slo = slo
+        if tenants is None:
+            tenants = self.telemetry is not None
+        if tenants:
+            from deepspeed_tpu.telemetry.tenants import TenantLedger
+
+            self.tenants = TenantLedger(self.telemetry)
+        else:
+            self.tenants = None
+        # KV occupancy billing unit: PAYLOAD bytes per pool block (a
+        # quantized pool's blocks bill at what they actually cost in
+        # HBM — the int8 capacity lever shows up on the tenant's bill);
+        # slot-paged mode bills the whole slot row as one "block"
+        if prefix_cache:
+            from deepspeed_tpu.serving.kv_quant import pool_payload
+
+            n_rows = self.cache.num_blocks + 1
+            self._kv_bytes_per_block = (
+                pool_payload(self.cache.k).nbytes
+                + pool_payload(self.cache.v).nbytes) / n_rows
+        else:
+            self._kv_bytes_per_block = (
+                self.cache.k.nbytes + self.cache.v.nbytes) / num_slots
+        self._acct_last_t: Optional[float] = None
         # ---- span-graph tracing + roofline attribution (ISSUE 11)
         self.tracer = tracer
         self._rtraces: Dict[int, _ReqTrace] = {}
@@ -953,11 +1000,14 @@ class ServingEngine:
                            - res.decode_preempted_wall, 0.0) / n_dec * 1e3
                 reg.histogram("serving/tpot_ms").observe(tpot)
                 reg.histogram(
-                    f"serving/tpot_ms/p{res.priority}").observe(tpot)
+                    f"serving/tpot_ms/p{metric_label(res.priority)}"
+                ).observe(tpot)
                 reg.histogram(
                     "serving/tokens_per_decode_call",
                     buckets=_TOKENS_PER_STEP_BUCKETS).observe(
                     (len(res.tokens) - 1) / n_dec)
+                if self.tenants is not None:
+                    self.tenants.note_tpot(st.tenant, tpot)
         return st.result
 
     def _maybe_finish(self, slot: int, now: float) -> Optional[RequestResult]:
@@ -1145,6 +1195,8 @@ class ServingEngine:
             finished.append(res)
             if self.telemetry is not None:
                 self.telemetry.counter("serving/shed_deadline").inc()
+            if self.tenants is not None:
+                self.tenants.note_shed(self.tenants.resolve(req.tenant_id))
             if self.tracer is not None:
                 rt = self._rtraces.pop(req.rid, None)
                 if rt is not None:
@@ -1172,11 +1224,21 @@ class ServingEngine:
         res = RequestResult(rid=req.rid, prompt_len=plen,
                             arrival_time=req.arrival_time,
                             admitted_time=now, priority=req.priority)
+        tenant = self.tenants.resolve(req.tenant_id) \
+            if self.tenants is not None else "default"
         self._slots[slot] = _SlotState(req, res, last_token=0,
                                        prefill_pos=start,
                                        prefill_total=plen,
-                                       order=self._admit_seq)
+                                       order=self._admit_seq,
+                                       tenant=tenant)
         self._admit_seq += 1
+        if self.tenants is not None:
+            # per-tenant usage (ISSUE 13): the prompt lands on the bill
+            # at admission; radix-matched tokens are the prefix cache's
+            # per-tenant dividend (prefill the tenant did NOT pay for)
+            self.tenants.note_admitted(tenant, plen)
+            if start:
+                self.tenants.note_prefill(tenant, 0, saved=start)
         if self.telemetry is not None:
             reg = self.telemetry
             reg.counter("serving/prefills").inc()
@@ -1272,6 +1334,10 @@ class ServingEngine:
             st.result.prefill_chunks += 1
             if self.telemetry is not None:
                 self.telemetry.counter("serving/prefill_chunks").inc()
+            if self.tenants is not None:
+                # billed at the same increment as the engine counter, so
+                # per-tenant computed tokens sum EXACTLY to it
+                self.tenants.note_prefill(st.tenant, chunk)
             if last:
                 tok = int(jax.device_get(out[3]))
                 self.prefill_calls += 1
@@ -1282,11 +1348,15 @@ class ServingEngine:
                 st.result.first_token_time = t_emit
                 st.result.token_times.append(t_emit)
                 self._stream(st, [tok])
+                ttft = max(t_emit - req.arrival_time, 0.0) * 1e3
                 if self.telemetry is not None:
-                    ttft = max(t_emit - req.arrival_time, 0.0) * 1e3
                     self.telemetry.histogram("serving/ttft_ms").observe(ttft)
                     self.telemetry.histogram(
-                        f"serving/ttft_ms/p{req.priority}").observe(ttft)
+                        f"serving/ttft_ms/p{metric_label(req.priority)}"
+                    ).observe(ttft)
+                if self.tenants is not None:
+                    self.tenants.note_tokens(st.tenant, 1)
+                    self.tenants.note_ttft(st.tenant, ttft)
                 if armed:
                     # decode-phase residency starts at the first-token
                     # commit; closed at finish/preemption/cancel
@@ -1411,6 +1481,8 @@ class ServingEngine:
                     "swapped", trace_id=rt.trace_id, parent_id=rt.root,
                     t=since, blocks=n_used)
         self.preemptions += 1
+        if self.tenants is not None:
+            self.tenants.note_preemption(st.tenant)
         if self.telemetry is not None:
             reg = self.telemetry
             reg.counter("serving/preemptions").inc()
@@ -1511,6 +1583,12 @@ class ServingEngine:
         if now is None:
             now = self._time()
         self._last_step_now = now
+        self._account_kv_occupancy(now)
+        if self.slo is not None:
+            # SLO judgment rides the serving clock (ISSUE 13): virtual
+            # traces replay their alert timelines deterministically.
+            # Pure host work — no device interaction, no output change.
+            self.slo.maybe_evaluate(now)
         if self._pending_submit_stamps:
             # first step after a context-carrying submit: this instant
             # is where the dispatcher's router_queue span ends, so the
@@ -1544,6 +1622,35 @@ class ServingEngine:
         if self.spec is not None:
             return self._spec_step(now, active_slots, finished)
         return self._plain_step(now, active_slots, finished)
+
+    def _account_kv_occupancy(self, now: float) -> None:
+        """Integrate per-tenant KV occupancy over the interval since
+        the last step (ISSUE 13): each occupied slot bills its tenant
+        for the pool blocks its table names (block-paged — HOST numpy,
+        no device read; shared radix blocks bill every tenant that
+        depends on them) or its whole slot row (slot-paged). dt is
+        engine-clock time, so virtual traces produce deterministic
+        block-second bills."""
+        if self.tenants is None:
+            return
+        last = self._acct_last_t
+        self._acct_last_t = now
+        if last is None:
+            return
+        dt = now - last
+        if dt <= 0:
+            return
+        paged = self.prefix is not None
+        for i, st in enumerate(self._slots):
+            if st is None:
+                continue
+            if paged:
+                blocks = int((self.cache.tables[i]
+                              != self.cache.sentinel).sum())
+            else:
+                blocks = 1
+            self.tenants.note_kv_occupancy(st.tenant, blocks, dt,
+                                           self._kv_bytes_per_block)
 
     def _iter_trace(self) -> str:
         """Lazy engine-scope trace for iteration-level spans (decode
@@ -1611,6 +1718,8 @@ class ServingEngine:
             st.result.decode_calls += 1
             st.last_token = tok
             self.tokens_generated += 1
+            if self.tenants is not None:
+                self.tenants.note_tokens(st.tenant, 1)
             self._stream(st, [tok])
             done = self._maybe_finish(i, now)
             if done is not None:
@@ -1729,6 +1838,8 @@ class ServingEngine:
             st.result.decode_calls += 1
             st.last_token = emitted[-1]
             self.tokens_generated += len(emitted)
+            if self.tenants is not None:
+                self.tenants.note_tokens(st.tenant, len(emitted))
             # stream only the ACCEPTED (post-truncation) block — a
             # rejected draft token is never observable
             self._stream(st, emitted)

@@ -148,6 +148,17 @@ class FabricRouter:
         dispatched Request makes the engines' lifecycle spans — on
         whichever replica, across failovers — children of that same
         trace.
+    slo: an :class:`~deepspeed_tpu.telemetry.slo.SLOEngine` (ISSUE 13)
+        evaluated once per fabric iteration on the ROUTER's clock —
+        fabric-level SLIs (availability = non-failed finishes) judge
+        crashes and shed storms the per-replica engines cannot see.
+    flight_recorder: a
+        :class:`~deepspeed_tpu.telemetry.flight_recorder.FlightRecorder`
+        the router triggers on its incident classes: replica crash,
+        replica quarantine, and overload shed bursts
+        (``shed_burst_threshold`` sheds within
+        ``shed_burst_window_s``) — each trigger freezes the bounded
+        pre-incident window into one postmortem JSON.
     """
 
     def __init__(self, replicas: Sequence[Replica], *,
@@ -165,7 +176,10 @@ class FabricRouter:
                  retry_jitter: float = 0.0,
                  request_timeout_s: Optional[float] = None,
                  time_fn: Optional[Callable[[], float]] = None,
-                 telemetry=True, seed: int = 0, tracer=None):
+                 telemetry=True, seed: int = 0, tracer=None,
+                 slo=None, flight_recorder=None,
+                 shed_burst_threshold: int = 4,
+                 shed_burst_window_s: float = 1.0):
         if not replicas:
             raise ValueError("fabric needs at least one replica")
         names = [r.name for r in replicas]
@@ -226,6 +240,21 @@ class FabricRouter:
         else:
             self.telemetry = telemetry or None
         self.tracer = tracer
+        # ---- SLO control plane (ISSUE 13)
+        self.slo = slo
+        self.flight_recorder = flight_recorder
+        self.shed_burst_threshold = shed_burst_threshold
+        self.shed_burst_window_s = shed_burst_window_s
+        self._recent_sheds: List[float] = []
+        if self.telemetry is not None:
+            from deepspeed_tpu.telemetry.tenants import TenantLedger
+
+            # router-side tenant ledger: sheds/failures happen BEFORE a
+            # replica engine ever owns the request, so the engine-side
+            # ledgers cannot see them (same registry — one bill)
+            self.tenants = TenantLedger(self.telemetry)
+        else:
+            self.tenants = None
         log_dist(f"FabricRouter: replicas={names} max_queue={max_queue} "
                  f"hb={heartbeat_interval_s}s timeout={request_timeout_s}",
                  ranks=[0])
@@ -326,6 +355,11 @@ class FabricRouter:
             self._count("fabric/rejected_requests")
         else:
             self._count("fabric/failed_requests")
+        if self.tenants is not None and reason.startswith("shed"):
+            self.tenants.note_shed(
+                self.tenants.resolve(tr.request.tenant_id))
+        if reason == "shed_overload":
+            self._note_shed_burst(now)
         if self.tracer is not None and tr.root_span is not None:
             if tr.failover_span is None:
                 # (same double-count guard as _dispatch: an open
@@ -341,6 +375,25 @@ class FabricRouter:
         self._done.append(res)
         return res
 
+    def _note_shed_burst(self, now: float) -> None:
+        """Overload-shed burst detection (ISSUE 13): N overload sheds
+        inside the trailing window is an INCIDENT, not background load
+        shaping — freeze the flight recorder's pre-incident window. The
+        shed list resets on trigger so one sustained storm produces one
+        dump per threshold-crossing, not one per shed."""
+        if self.flight_recorder is None:
+            return
+        self._recent_sheds.append(now)
+        cutoff = now - self.shed_burst_window_s
+        self._recent_sheds = [t for t in self._recent_sheds if t >= cutoff]
+        if len(self._recent_sheds) >= self.shed_burst_threshold:
+            n = len(self._recent_sheds)
+            self._recent_sheds = []
+            self.flight_recorder.trigger(
+                "overload_shed_burst", t=now, sheds_in_window=n,
+                window_s=self.shed_burst_window_s,
+                queue_depth=len(self._queue))
+
     # ------------------------------------------------------------ iteration
     def step(self, now: Optional[float] = None) -> List[RequestResult]:
         """One fabric iteration: resurrect due replicas, heartbeat +
@@ -350,6 +403,9 @@ class FabricRouter:
         request that reached a terminal state (served, shed, failed)."""
         if now is None:
             now = self._now()
+        if self.slo is not None:
+            # fabric-level SLO judgment on the router's clock (ISSUE 13)
+            self.slo.maybe_evaluate(now)
         self._maybe_resurrect(now)
         self._maybe_heartbeat(now)
         self._shed_expired(now)
@@ -429,6 +485,11 @@ class FabricRouter:
         idempotency argument)."""
         self.quarantines += 1
         self._count("fabric/quarantines")
+        if self.flight_recorder is not None:
+            self.flight_recorder.trigger(
+                "replica_quarantine", replica=name, t=now,
+                inflight=sum(tr.replica == name
+                             for tr in self._inflight.values()))
         replica = self.replicas[name]
         for rid, tr in sorted(self._inflight.items()):
             if tr.replica != name:
@@ -447,6 +508,16 @@ class FabricRouter:
         token resume), then ask the supervisor whether to resurrect."""
         self.replica_crashes += 1
         self._count("fabric/replica_crashes")
+        if self.flight_recorder is not None:
+            # the postmortem moment: freeze the pre-incident window
+            # BEFORE failover mutates the in-flight picture
+            self.flight_recorder.trigger(
+                "replica_crash", replica=name, t=now,
+                inflight=sorted(rid for rid, tr in self._inflight.items()
+                                if tr.replica == name),
+                tenants=sorted({(tr.request.tenant_id or "default")
+                                for tr in self._inflight.values()
+                                if tr.replica == name}))
         for rid, tr in sorted(self._inflight.items()):
             if tr.replica == name:
                 self._requeue(tr, now, crashed=True)
@@ -662,6 +733,7 @@ class FabricRouter:
             max_new_tokens=base.max_new_tokens - len(tr.committed),
             arrival_time=base.arrival_time, priority=base.priority,
             on_token=on_token, deadline=base.deadline,
+            tenant_id=base.tenant_id,
             # trace context: every attempt — original or failover
             # re-dispatch — carries the SAME trace id, parented under
             # the router's root span, so the whole multi-replica

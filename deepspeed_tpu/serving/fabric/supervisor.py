@@ -82,6 +82,26 @@ class ReplicaSupervisor:
         # next to the request traces in the Chrome-trace export
         self.tracer = tracer
         self._trace: Optional[str] = None
+        # SLO alert subscription (ISSUE 13): transitions delivered via
+        # SLOEngine.set_alert_callback(supervisor.on_slo_alert) — today
+        # they are recorded + evented (the operator sees WHICH objective
+        # burned while a replica was down); the elastic autoscaler
+        # (ROADMAP item 2) will act on them (scale out on sustained
+        # page-severity burn)
+        self.slo_alerts: List = []
+
+    def on_slo_alert(self, alert) -> None:
+        """Callback seam for :meth:`SLOEngine.set_alert_callback`:
+        record every alert transition against the fabric's restart
+        picture. Host-only, exception-free by construction (appends +
+        a telemetry event)."""
+        from deepspeed_tpu.telemetry import record_event
+
+        self.slo_alerts.append(alert)
+        record_event("fabric/slo_alert", rule=alert.rule, sli=alert.sli,
+                     severity=alert.severity, transition=alert.kind,
+                     t=alert.t, burn_short=alert.burn_short,
+                     burn_long=alert.burn_long)
 
     def _span(self, name: str, start: float, end: float, **attrs) -> None:
         if self.tracer is None:

@@ -55,6 +55,12 @@ class Request:
     # sheds a request whose deadline expired while still queued —
     # before it wastes prefill compute it can no longer make use of.
     deadline: Optional[float] = None
+    # usage-accounting tenant (ISSUE 13): which caller's bill this
+    # request lands on — token counts, prefill saved-vs-computed, KV
+    # block-seconds, preemptions/sheds, per-tenant TTFT/TPOT. None is
+    # the default tenant (every pre-existing call site unchanged). The
+    # id is sanitized (telemetry.metric_label) before it names metrics.
+    tenant_id: Optional[str] = None
     # distributed trace context (ISSUE 11): set by whoever OWNS the
     # request's root span (the fabric router, or the engine at submit
     # when standalone). A failover re-dispatch carries the SAME

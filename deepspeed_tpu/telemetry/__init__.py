@@ -27,6 +27,7 @@ from deepspeed_tpu.telemetry.attribution import (abstract_args,
                                                  attribution_table,
                                                  program_cost, roofline_row)
 from deepspeed_tpu.telemetry.config import TelemetryConfig, get_telemetry_config
+from deepspeed_tpu.telemetry.flight_recorder import FlightRecorder
 from deepspeed_tpu.telemetry.mfu import mfu, peak_flops_per_sec
 from deepspeed_tpu.telemetry.registry import (
     DEFAULT_LATENCY_BUCKETS_MS,
@@ -35,34 +36,51 @@ from deepspeed_tpu.telemetry.registry import (
     Histogram,
     MetricsRegistry,
     get_registry,
+    metric_label,
     record_event,
     reset_registry,
+    sanitize_metric_name,
 )
 from deepspeed_tpu.telemetry.sink import JsonlSink, read_jsonl
+from deepspeed_tpu.telemetry.slo import (DEFAULT_SLO_CONFIG, SLI, BurnRateRule,
+                                         SLOAlert, SLOConfigError, SLOEngine,
+                                         parse_slo_config, validate_slo_config)
+from deepspeed_tpu.telemetry.tenants import DEFAULT_TENANT, TenantLedger
 from deepspeed_tpu.telemetry.spans import (PHASE_OF_SPAN, PHASES, Span,
                                            SpanTracer, aggregate_phase_stats,
                                            phase_breakdown, trace_summaries)
 from deepspeed_tpu.telemetry.trace import annotate, trace
 
 __all__ = [
+    "BurnRateRule",
     "DEFAULT_LATENCY_BUCKETS_MS",
+    "DEFAULT_SLO_CONFIG",
+    "DEFAULT_TENANT",
     "Counter",
+    "FlightRecorder",
     "Gauge",
     "Histogram",
     "JsonlSink",
     "MetricsRegistry",
     "PHASES",
     "PHASE_OF_SPAN",
+    "SLI",
+    "SLOAlert",
+    "SLOConfigError",
+    "SLOEngine",
     "Span",
     "SpanTracer",
     "TelemetryConfig",
+    "TenantLedger",
     "abstract_args",
     "aggregate_phase_stats",
     "annotate",
     "attribution_table",
     "get_registry",
     "get_telemetry_config",
+    "metric_label",
     "mfu",
+    "parse_slo_config",
     "peak_flops_per_sec",
     "phase_breakdown",
     "program_cost",
@@ -70,6 +88,8 @@ __all__ = [
     "record_event",
     "reset_registry",
     "roofline_row",
+    "sanitize_metric_name",
     "trace",
     "trace_summaries",
+    "validate_slo_config",
 ]

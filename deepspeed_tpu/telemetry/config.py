@@ -22,6 +22,14 @@ Keys:
   spans_path     — JSONL file for span records; empty reuses jsonl_path's
                    sink (spans interleave with snapshots/events in one
                    file — telemetry_report.py renders both).
+  flight_recorder — arm the crash-safe flight recorder (ISSUE 13): a
+                   bounded ring of recent spans/events/snapshots teed
+                   off the JSONL stream, dumped as one postmortem JSON
+                   when the training sentinel hits an actionable
+                   anomaly (default off).
+  flight_dir     — directory for flight-recorder dump artifacts
+                   (``flight_<NNN>_<reason>.json``); empty records
+                   triggers without writing files.
 """
 
 from __future__ import annotations
@@ -36,6 +44,8 @@ class TelemetryConfig(DeepSpeedConfigModel):
     cost_analysis: bool = True
     spans: bool = False
     spans_path: str = ""
+    flight_recorder: bool = False
+    flight_dir: str = ""
 
 
 def get_telemetry_config(param_dict: dict) -> TelemetryConfig:

@@ -29,9 +29,40 @@ checkpoint counters, the train loop owns the train metrics).
 
 from __future__ import annotations
 
+import re
 import threading
 from bisect import bisect_left
 from typing import Dict, List, Optional, Sequence
+
+# the one character class every scraped metric name must reduce to —
+# shared by to_prometheus() and metric_label() so a name that is valid
+# in-process is valid (and collision-stable) after Prometheus
+# sanitization too
+_PROM_INVALID = re.compile(r"[^a-zA-Z0-9_:]")
+# labels reduce to the [a-zA-Z0-9_] subset: any character the
+# Prometheus sanitizer would fold to "_" is folded HERE, so two
+# distinct in-process names can never collide only at scrape time
+_LABEL_INVALID = re.compile(r"[^a-zA-Z0-9_]")
+
+
+def sanitize_metric_name(name: str, prefix: str = "dstpu") -> str:
+    """Prometheus-exposition name for an in-process metric name
+    (``serving/ttft_ms`` -> ``dstpu_serving_ttft_ms``)."""
+    out = _PROM_INVALID.sub("_", name)
+    return f"{prefix}_{out}" if prefix else out
+
+
+def metric_label(value) -> str:
+    """Sanitize a CALLER-SUPPLIED label value (tenant id, priority
+    class) for embedding into a metric name segment (ISSUE 13
+    satellite): arbitrary strings must neither break the ``/``-separated
+    name paths the report sections parse nor collide after
+    :func:`sanitize_metric_name`. Invalid characters (including ``/``)
+    become ``_``; empty values become ``_``; length is clamped so a
+    hostile tenant id cannot balloon the registry keys."""
+    s = str(value)
+    s = _LABEL_INVALID.sub("_", s)[:64]
+    return s or "_"
 
 
 def _default_latency_buckets_ms() -> List[float]:
@@ -239,12 +270,13 @@ class MetricsRegistry:
         gain the conventional ``_total`` suffix; histograms emit the
         full CUMULATIVE bucket series (+Inf included) plus ``_sum`` and
         ``_count``, so Prometheus-side ``histogram_quantile`` sees the
-        same fixed buckets the in-process percentiles use."""
-        import re
-
+        same fixed buckets the in-process percentiles use. Name
+        sanitization is the module-level :func:`sanitize_metric_name`,
+        shared with :func:`metric_label` (the per-tenant / per-class
+        name segments), so any name the engines can emit scrapes
+        cleanly."""
         def san(name: str) -> str:
-            out = re.sub(r"[^a-zA-Z0-9_:]", "_", name)
-            return f"{prefix}_{out}" if prefix else out
+            return sanitize_metric_name(name, prefix)
 
         lines: List[str] = []
         with self._lock:

@@ -32,6 +32,11 @@ class JsonlSink:
         self._fh = None
         self._warned = False
         self.records_written = 0
+        # dropped-data accounting (ISSUE 13 satellite): every record
+        # this sink failed to durably write — serialization errors and
+        # failed drains both — so a postmortem can state whether its
+        # JSONL record is complete
+        self.records_dropped = 0
         parent = os.path.dirname(os.path.abspath(path))
         os.makedirs(parent, exist_ok=True)
 
@@ -41,7 +46,17 @@ class JsonlSink:
             from deepspeed_tpu.utils.logging import logger
 
             logger.warning(f"telemetry sink {self.path}: {type(e).__name__}: "
-                           f"{e}; further records dropped silently")
+                           f"{e}; further records dropped (counted in "
+                           f"telemetry/events_dropped)")
+
+    def _note_dropped(self, n: int) -> None:
+        self.records_dropped += n
+        try:
+            from deepspeed_tpu.telemetry.registry import get_registry
+
+            get_registry().counter("telemetry/events_dropped").inc(n)
+        except Exception:
+            pass
 
     def write(self, record: dict) -> None:
         rec = dict(record)
@@ -50,6 +65,7 @@ class JsonlSink:
             line = json.dumps(rec, default=str)
         except Exception as e:
             self._warn_once(e)
+            self._note_dropped(1)
             return
         with self._lock:
             self._buf.append(line)
@@ -71,6 +87,7 @@ class JsonlSink:
             self.records_written += len(self._buf)
         except Exception as e:
             self._warn_once(e)
+            self._note_dropped(len(self._buf))
         finally:
             self._buf.clear()
 

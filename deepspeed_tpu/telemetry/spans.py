@@ -127,6 +127,7 @@ class SpanTracer:
         self._next_span = 0
         self.spans: List[Span] = []        # finished spans, append order
         self.dropped = 0                   # finished spans past max_spans
+        self._drop_warned = False
 
     # ------------------------------------------------------------------ ids
     def new_trace(self) -> str:
@@ -185,11 +186,35 @@ class SpanTracer:
         return span
 
     def _commit(self, span: Span) -> None:
+        dropped = False
         with self._lock:
             if len(self.spans) < self.max_spans:
                 self.spans.append(span)
             else:
                 self.dropped += 1
+                dropped = True
+        if dropped:
+            # dropped-data accounting (ISSUE 13 satellite): a silent
+            # drop would let a postmortem claim completeness it does
+            # not have — count every drop, warn once
+            try:
+                from deepspeed_tpu.telemetry.registry import get_registry
+
+                get_registry().counter("telemetry/spans_dropped").inc()
+            except Exception:
+                pass
+            if not self._drop_warned:
+                self._drop_warned = True
+                try:
+                    from deepspeed_tpu.utils.logging import logger
+
+                    logger.warning(
+                        f"SpanTracer buffer full ({self.max_spans} spans): "
+                        f"further spans are dropped from the in-memory "
+                        f"buffer (counted in telemetry/spans_dropped; "
+                        f"JSONL streaming, if armed, continues)")
+                except Exception:
+                    pass
         if self.sink is not None:
             try:
                 self.sink.write(span.as_dict())

@@ -157,7 +157,7 @@ def trend(rounds, threshold=0.10):
     return out
 
 
-def render(t, only_flagged=False):
+def _trend_rows(t, only_flagged=False):
     rows = []
     for m, rec in t.items():
         if only_flagged and rec["flag"] in ("stable", "new", "gone",
@@ -168,6 +168,11 @@ def render(t, only_flagged=False):
         delta = (f"{rec['delta_pct']:+.1f}%" if "delta_pct" in rec
                  else "-")
         rows.append((m, rec["flag"], delta, vals))
+    return rows
+
+
+def render(t, only_flagged=False):
+    rows = _trend_rows(t, only_flagged)
     if not rows:
         return "bench trajectory: no metrics" + \
             (" flagged" if only_flagged else " found")
@@ -178,6 +183,39 @@ def render(t, only_flagged=False):
              "  ".join("-" * w for w in widths)]
     for r in rows:
         lines.append("  ".join(str(c).ljust(w) for c, w in zip(r, widths)))
+    return "\n".join(lines)
+
+
+def render_markdown(t, rounds, only_flagged=False):
+    """GitHub-flavored markdown trend report (ISSUE 13 satellite) —
+    pasteable into a PR description or review round: one table row per
+    metric, flags bolded so regressions jump out, and a summary line
+    up top. ``|`` in metric paths (none today) would be escaped by the
+    cell join; series cells use the same ``label=value`` form as the
+    text renderer."""
+    rows = _trend_rows(t, only_flagged)
+    n_reg = sum(r["flag"] == "regression" for r in t.values())
+    n_imp = sum(r["flag"] == "improvement" for r in t.values())
+    lines = [
+        f"## Bench trajectory",
+        "",
+        f"{len(rounds)} round(s) ({', '.join(lbl for lbl, _ in rounds)}), "
+        f"{len(t)} metric(s): **{n_reg} regression(s)**, "
+        f"{n_imp} improvement(s).",
+        "",
+    ]
+    if not rows:
+        lines.append("_no metrics" +
+                     (" flagged_" if only_flagged else " found_"))
+        return "\n".join(lines)
+    lines.append("| metric | flag | delta | series |")
+    lines.append("| --- | --- | --- | --- |")
+    for m, flag, delta, vals in rows:
+        shown = f"**{flag}**" if flag in ("regression", "improvement") \
+            else flag
+        cells = [str(c).replace("|", "\\|")
+                 for c in (f"`{m}`", shown, delta, vals)]
+        lines.append("| " + " | ".join(cells) + " |")
     return "\n".join(lines)
 
 
@@ -194,6 +232,10 @@ def main(argv=None) -> int:
                    help="show only regressions/improvements")
     p.add_argument("--json", action="store_true",
                    help="emit the trend dict as JSON")
+    p.add_argument("--markdown", action="store_true",
+                   help="emit the trend table as GitHub-flavored "
+                        "markdown (one row per metric, regression/"
+                        "improvement flags bolded)")
     args = p.parse_args(argv)
     paths = args.paths
     if not paths:
@@ -209,6 +251,8 @@ def main(argv=None) -> int:
         print(json.dumps({"threshold": args.threshold, "rounds":
                           [lbl for lbl, _ in rounds], "metrics": t},
                          indent=2))
+    elif args.markdown:
+        print(render_markdown(t, rounds, only_flagged=args.flagged))
     else:
         n_reg = sum(r["flag"] == "regression" for r in t.values())
         n_imp = sum(r["flag"] == "improvement" for r in t.values())

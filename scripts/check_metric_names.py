@@ -37,7 +37,7 @@ import re
 import sys
 
 PREFIXES = ("train", "serving", "fabric", "resilience", "device",
-            "checkpoint", "elastic")
+            "checkpoint", "elastic", "slo", "telemetry")
 _NAME_RE = re.compile(
     r"^(?:%s)/[A-Za-z0-9_][A-Za-z0-9_/<>*-]*$" % "|".join(PREFIXES))
 # methods whose first string argument is a metric/event name

@@ -1,0 +1,72 @@
+#!/usr/bin/env python
+"""SLO/alert-rule config lint (ISSUE 13 satellite).
+
+Usage:
+    python scripts/check_slo_rules.py [CONFIG.json ...]
+
+Validates SLO configs against the typed rules in
+``deepspeed_tpu.telemetry.slo.validate_slo_config``: unknown SLI names
+in rules, unknown kinds/severities, missing per-kind fields, objectives
+outside (0, 1), malformed windows (non-positive, short >= long), and
+burn thresholds that can NEVER fire (burn > 1 / (1 - objective) — the
+bad fraction caps at 1.0, so such a rule looks armed but is dead).
+
+With no arguments the built-in :data:`DEFAULT_SLO_CONFIG` is validated
+— the config every engine runs when none is supplied, so a bad default
+fails CI before it ships. Wired into scripts/run_tier1.sh.
+
+Exit status: 0 = every config valid, 1 = problems (all listed), 2 =
+unreadable input.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("paths", nargs="*",
+                   help="SLO config JSON files (default: validate the "
+                        "built-in DEFAULT_SLO_CONFIG)")
+    args = p.parse_args(argv)
+    from deepspeed_tpu.telemetry.slo import (DEFAULT_SLO_CONFIG,
+                                             validate_slo_config)
+
+    targets = []
+    if args.paths:
+        for path in args.paths:
+            try:
+                with open(path, "r", encoding="utf-8") as f:
+                    cfg = json.load(f)
+            except (OSError, ValueError) as e:
+                print(f"check_slo_rules: cannot parse {path}: "
+                      f"{type(e).__name__}: {e}", file=sys.stderr)
+                return 2
+            targets.append((path, cfg))
+    else:
+        targets.append(("<built-in DEFAULT_SLO_CONFIG>",
+                        DEFAULT_SLO_CONFIG))
+    rc = 0
+    for name, cfg in targets:
+        errors = validate_slo_config(cfg)
+        if errors:
+            rc = 1
+            print(f"INVALID SLO config {name}:", file=sys.stderr)
+            for e in errors:
+                print(f"  {e}", file=sys.stderr)
+        else:
+            n_slis = len(cfg.get("slis", []))
+            n_rules = len(cfg.get("rules", []))
+            print(f"SLO config OK: {name} ({n_slis} SLI(s), "
+                  f"{n_rules} rule(s))")
+    return rc
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

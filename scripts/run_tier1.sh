@@ -48,8 +48,23 @@ grep -qs "def test_" tests/unit/telemetry/test_spans.py || { echo "tier-1: span 
 # pools, and autotuned kernel-plan loading ride `-m 'not slow'` through
 # tests/unit/serving/test_kv_quant.py
 grep -qs "def test_" tests/unit/serving/test_kv_quant.py || { echo "tier-1: kv-quant tests missing"; exit 1; }
+# likewise the SLO control-plane suite (marker `sloplane`): burn-rate
+# window math + multi-window alert determinism, per-tenant accounting
+# conservation, flight-recorder dump/postmortem reconstruction and
+# report degrade paths ride `-m 'not slow'` through
+# tests/unit/telemetry/test_slo_plane.py and
+# tests/unit/serving/test_slo_plane.py
+grep -qs "def test_" tests/unit/telemetry/test_slo_plane.py || { echo "tier-1: slo-plane tests missing"; exit 1; }
+grep -qs "def test_" tests/unit/serving/test_slo_plane.py || { echo "tier-1: slo-plane serving tests missing"; exit 1; }
 # metric-name drift lint (ISSUE 11 satellite): README metric/event
 # names must exactly cover the counter/gauge/histogram/record_event
 # call sites — fails on undocumented or stale names
 python scripts/check_metric_names.py || { echo "tier-1: metric-name drift"; exit 1; }
+# SLO/alert-rule config lint (ISSUE 13 satellite): the built-in
+# DEFAULT_SLO_CONFIG must validate — unknown SLI names, malformed
+# windows and never-firing burn thresholds are typed errors
+JAX_PLATFORMS=cpu python scripts/check_slo_rules.py || { echo "tier-1: slo config invalid"; exit 1; }
+# bench-trajectory smoke (ISSUE 13 satellite): the markdown trend
+# report must render over the checked-in BENCH_r*.json round files
+python scripts/bench_trajectory.py --markdown > /dev/null || { echo "tier-1: bench trajectory markdown"; exit 1; }
 exit $rc

@@ -22,6 +22,20 @@ Sections:
   attribution — per-program roofline (ISSUE 11): flops/bytes/intensity,
                 achieved vs attainable TFLOPs and the binding roof, from
                 "attribution" records
+  slo         — SLO scheduling view (ISSUE 8) merged with the SLO
+                control plane (ISSUE 13): error-budget consumption per
+                SLI, burn-rate timeline stats per rule, and the
+                fired/resolved alert sequence from "slo_eval" +
+                slo/alert_* event records
+  tenants     — per-tenant usage table (ISSUE 13): prompt/decode
+                tokens, prefill computed vs saved, KV block-seconds,
+                preemptions/sheds, TTFT/TPOT p50 from the
+                serving/tenant/<t>/* metrics
+  postmortem  — incident summary from a flight-recorder dump
+                (``--postmortem DUMP.json``, or pass the dump file as
+                the positional path): trigger, affected requests and
+                tenants, alert state at the dump instant, record-
+                completeness verdict
 
 ``--json`` emits the aggregate as one JSON object instead of tables
 (machine-readable; the smoke test uses it). Stdlib only — runs anywhere.
@@ -61,18 +75,21 @@ def load_records(path):
     return out, bad
 
 
-def aggregate(records, n_bad_lines=0):
+def aggregate(records, n_bad_lines=0, postmortem=None):
     last_snapshot = None
     scalars = OrderedDict()   # tag -> stats dict
     events = OrderedDict()    # name -> {count, last_fields}
     spans = []                # raw span records, arrival order
     attributions = OrderedDict()   # scope -> last program table
+    slo_evals = []            # SLO-engine burn-rate timeline (ISSUE 13)
     for rec in records:
         kind = rec.get("kind")
         if kind == "snapshot":
             last_snapshot = rec
         elif kind == "span":
             spans.append(rec)
+        elif kind == "slo_eval":
+            slo_evals.append(rec)
         elif kind == "attribution":
             attributions[rec.get("scope", "?")] = rec.get("programs", {})
         elif kind == "scalar":
@@ -108,11 +125,13 @@ def aggregate(records, n_bad_lines=0):
         "events": events,
         "speculation": _speculation_summary(metrics),
         "prefix_cache": _prefix_cache_summary(metrics),
-        "slo": _slo_summary(metrics),
+        "slo": _slo_summary(metrics, slo_evals, events),
+        "tenants": _tenants_summary(metrics),
         "fabric": _fabric_summary(metrics),
         "resilience": _resilience_summary(metrics),
         "spans": _spans_summary(spans),
         "attribution": _attribution_summary(attributions),
+        "postmortem": _postmortem_summary(postmortem),
         "n_records": len(records),
         "n_bad_lines": n_bad_lines,
     }
@@ -248,12 +267,151 @@ def _prefix_cache_summary(metrics):
     return out
 
 
-def _slo_summary(metrics):
-    """Derived SLO-scheduling view (ISSUE 8) over the serving engine's
-    raw counters/gauges/histograms: overload-control actions (chunked
-    prefill, TPOT-guard deferrals, preemptions, host swap traffic) and
-    the per-priority-class latency tails. Empty dict when the run never
-    used the SLO machinery."""
+def _slo_summary(metrics, slo_evals=None, events=None):
+    """Derived SLO view: the ISSUE-8 scheduling actions (chunked
+    prefill, TPOT-guard deferrals, preemptions, host swap traffic,
+    per-class latency tails) merged with the ISSUE-13 control plane —
+    error-budget consumption per SLI, per-rule burn-rate timeline
+    stats over the "slo_eval" records, and the alert transition
+    sequence. Empty dict when the run used neither."""
+    base = _slo_sched_summary(metrics)
+    plane = _slo_plane_summary(slo_evals or [], events or {})
+    base.update(plane)
+    return base
+
+
+def _slo_plane_summary(slo_evals, events):
+    """SLO-engine fields (ISSUE 13). Empty dict when the run recorded
+    no slo_eval records and no alert events."""
+    out = {}
+    fired = events.get("slo/alert_fired", {}).get("count", 0)
+    resolved = events.get("slo/alert_resolved", {}).get("count", 0)
+    if not slo_evals and not fired and not resolved:
+        return out
+    if fired or resolved:
+        out["alerts_fired"] = fired
+        out["alerts_resolved"] = resolved
+    if not slo_evals:
+        return out
+    out["slo_evaluations"] = len(slo_evals)
+    last = slo_evals[-1]
+    for sli, consumed in sorted(
+            (last.get("budget_consumed") or {}).items()):
+        out[f"budget_consumed/{sli}"] = consumed
+    # per-rule burn timeline: max observed burn + evaluations spent
+    # firing — the compressed "when and how hard did it burn" view
+    rules = {}
+    for rec in slo_evals:
+        for rule, st in (rec.get("rules") or {}).items():
+            if not isinstance(st, dict):
+                continue
+            r = rules.setdefault(rule, {"max_burn_short": 0.0,
+                                        "max_burn_long": 0.0,
+                                        "evals_firing": 0})
+            try:
+                r["max_burn_short"] = max(r["max_burn_short"],
+                                          float(st.get("burn_short", 0)))
+                r["max_burn_long"] = max(r["max_burn_long"],
+                                         float(st.get("burn_long", 0)))
+            except (TypeError, ValueError):
+                pass
+            if st.get("firing"):
+                r["evals_firing"] += 1
+    for rule, r in sorted(rules.items()):
+        out[f"rule/{rule}"] = {
+            "max_burn_short": round(r["max_burn_short"], 2),
+            "max_burn_long": round(r["max_burn_long"], 2),
+            "evals_firing": r["evals_firing"]}
+    return out
+
+
+def _tenants_summary(metrics):
+    """Per-tenant usage table (ISSUE 13) over the
+    ``serving/tenant/<t>/<metric>`` namespace in the newest snapshot.
+    Empty dict when the run carried no tenant accounting."""
+    out = OrderedDict()
+    prefix = "serving/tenant/"
+    for name, v in sorted(metrics.get("counters", {}).items()):
+        if not name.startswith(prefix):
+            continue
+        rest = name[len(prefix):]
+        tenant, _, metric = rest.rpartition("/")
+        if not tenant:
+            continue
+        row = out.setdefault(tenant, OrderedDict())
+        row[metric] = round(v, 3) if isinstance(v, float) else v
+    for name, h in sorted(metrics.get("histograms", {}).items()):
+        if not name.startswith(prefix) or not h.get("count"):
+            continue
+        rest = name[len(prefix):]
+        tenant, _, metric = rest.rpartition("/")
+        if not tenant:
+            continue
+        row = out.setdefault(tenant, OrderedDict())
+        row[f"{metric}_p50"] = h.get("p50")
+        row[f"{metric}_p99"] = h.get("p99")
+    return out
+
+
+def _postmortem_summary(dump):
+    """Incident summary from a flight-recorder dump payload (ISSUE 13):
+    what tripped, which requests/tenants were in the blast radius, the
+    alert state at the dump instant, and whether the record itself is
+    complete. Empty dict when no dump was given."""
+    if not isinstance(dump, dict) or dump.get("kind") != "flight_dump":
+        return {}
+    out = OrderedDict()
+    out["trigger"] = dump.get("reason", "?")
+    ctx = dump.get("context") or {}
+    for k, v in sorted(ctx.items()):
+        out[f"context/{k}"] = v
+    spans = [s for s in dump.get("spans", []) if isinstance(s, dict)]
+    events = [e for e in dump.get("events", []) if isinstance(e, dict)]
+    out["window_spans"] = len(spans)
+    out["window_events"] = len(events)
+    rids = sorted({a.get("rid") for s in spans
+                   for a in [s.get("attrs") or {}] if a.get("rid")
+                   is not None})
+    if rids:
+        out["requests_in_window"] = len(rids)
+        out["request_ids"] = rids[:20]
+    counters = (dump.get("metrics") or {}).get("counters", {})
+    tenants = sorted({name.split("/")[2]
+                      for name in counters
+                      if name.startswith("serving/tenant/")
+                      and len(name.split("/")) > 3})
+    if tenants:
+        out["tenants"] = tenants
+    alerts = [a for a in dump.get("alerts", []) if isinstance(a, dict)]
+    firing = []
+    budget = {}
+    for rec in alerts:
+        for rule, st in (rec.get("rules") or {}).items():
+            if isinstance(st, dict) and st.get("firing") \
+                    and rule not in firing:
+                firing.append(rule)
+        budget.update(rec.get("budget_consumed") or {})
+    if firing:
+        out["rules_fired_in_window"] = firing
+    for sli, consumed in sorted(budget.items()):
+        out[f"budget_consumed/{sli}"] = consumed
+    ev_names = OrderedDict()
+    for e in events:
+        n = e.get("name", e.get("kind", "?"))
+        ev_names[n] = ev_names.get(n, 0) + 1
+    if ev_names:
+        out["event_counts"] = dict(ev_names)
+    dropped = dump.get("upstream_dropped") or {}
+    out["complete"] = bool(dump.get("complete", False))
+    if dropped.get("spans") or dropped.get("events"):
+        out["upstream_dropped"] = dropped
+    return out
+
+
+def _slo_sched_summary(metrics):
+    """The ISSUE-8 half of the slo section: scheduling actions + the
+    per-priority-class latency tails. Empty dict when the run never
+    used the SLO scheduling machinery."""
     counters = metrics.get("counters", {})
     hists = metrics.get("histograms", {})
     per_class = {name: h for name, h in hists.items()
@@ -403,6 +561,13 @@ def render(agg):
            [(k, _fmt(v) if not isinstance(v, dict) else
              " ".join(f"{kk}={_fmt(vv)}" for kk, vv in v.items()))
             for k, v in agg.get("slo", {}).items()], out)
+    _table("tenants", ("tenant", "usage"),
+           [(t, " ".join(f"{kk}={_fmt(vv)}" for kk, vv in row.items()))
+            for t, row in agg.get("tenants", {}).items()], out)
+    _table("postmortem", ("field", "value"),
+           [(k, _fmt(v) if not isinstance(v, (dict, list)) else
+             json.dumps(v, default=str)[:80])
+            for k, v in agg.get("postmortem", {}).items()], out)
     _table("fabric", ("metric", "value"),
            [(k, _fmt(v) if not isinstance(v, dict) else
              " ".join(f"{kk}={_fmt(vv)}" for kk, vv in v.items()))
@@ -440,19 +605,56 @@ def render(agg):
     return "\n".join(out)
 
 
+def load_flight_dump(path):
+    """Parse a flight-recorder dump JSON; returns the payload dict or
+    None when the file is not a dump (crash-tolerant: unreadable /
+    corrupt files degrade to None, never raise — the postmortem tool
+    must not fail on the artifact needed to debug the crash)."""
+    try:
+        with open(path, "rb") as f:
+            payload = json.loads(
+                f.read().decode("utf-8", errors="replace"))
+    except (OSError, ValueError):
+        return None
+    if isinstance(payload, dict) and payload.get("kind") == "flight_dump":
+        return payload
+    return None
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
-    p.add_argument("path", help="telemetry JSONL file")
+    p.add_argument("path", help="telemetry JSONL file, or a "
+                                "flight-recorder dump JSON")
     p.add_argument("--json", action="store_true",
                    help="emit the aggregate as JSON instead of tables")
+    p.add_argument("--postmortem", default=None, metavar="DUMP",
+                   help="flight-recorder dump JSON rendered as the "
+                        "postmortem section (ISSUE 13)")
     args = p.parse_args(argv)
-    try:
-        records, n_bad = load_records(args.path)
-    except OSError as e:
-        print(f"telemetry_report: cannot read {args.path}: {e}",
-              file=sys.stderr)
+    dump = load_flight_dump(args.postmortem) if args.postmortem else None
+    if args.postmortem and dump is None:
+        print(f"telemetry_report: --postmortem {args.postmortem} is not "
+              f"a readable flight-recorder dump", file=sys.stderr)
         return 2
-    agg = aggregate(records, n_bad_lines=n_bad)
+    # the positional path may itself be a dump: render the incident's
+    # embedded window instead of demanding a separate JSONL
+    primary_dump = load_flight_dump(args.path)
+    if primary_dump is not None:
+        records = (primary_dump.get("spans", [])
+                   + primary_dump.get("events", [])
+                   + primary_dump.get("snapshots", [])
+                   + primary_dump.get("alerts", []))
+        records = [r for r in records if isinstance(r, dict)]
+        n_bad = 0
+        dump = dump or primary_dump
+    else:
+        try:
+            records, n_bad = load_records(args.path)
+        except OSError as e:
+            print(f"telemetry_report: cannot read {args.path}: {e}",
+                  file=sys.stderr)
+            return 2
+    agg = aggregate(records, n_bad_lines=n_bad, postmortem=dump)
     if args.json:
         print(json.dumps(agg, indent=2, default=str))
     else:

@@ -301,7 +301,9 @@ _LATE_MODULES = _OBSERVABILITY_MODULES + (
     "unit/serving/test_fabric",
     "unit/runtime/test_resilience",
     "unit/serving/test_tracing",
-    "unit/serving/test_kv_quant",)
+    "unit/serving/test_kv_quant",
+    "unit/telemetry/test_slo_plane",
+    "unit/serving/test_slo_plane",)
 
 
 def pytest_collection_modifyitems(config, items):
