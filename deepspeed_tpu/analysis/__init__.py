@@ -1,0 +1,32 @@
+"""dstpu-lint — AST invariant checker for the repo's machine-enforceable
+contracts (ISSUE 14).
+
+Every perf/robustness win since PR 2 rests on invariants the test suite
+can only probe dynamically and per-site: zero recompiles after warmup,
+no host synchronization inside engine hot loops except at declared
+fences, typed errors in the serving paths, and metric-name / jax_compat
+discipline.  This package makes those contracts *static*: one shared AST
+walk over ``deepspeed_tpu/``, a registry of passes that each encode one
+contract, inline suppressions that require a written justification, and
+a committed baseline for grandfathered findings that may only burn down.
+
+Entry points:
+
+  * :func:`run_lint` — programmatic (used by tests and the CLI);
+  * ``scripts/dstpu_lint.py`` — the CLI, wired into run_tier1.sh;
+  * ``scripts/check_metric_names.py`` / ``check_slo_rules.py`` — thin
+    shims over the :mod:`~deepspeed_tpu.analysis.passes.metric_names`
+    and :mod:`~deepspeed_tpu.analysis.passes.slo_rules` passes (their
+    CLIs and exit-code contracts predate the framework and are pinned
+    by tests).
+
+See the README "Static analysis" section for the pass catalog, the
+suppression syntax, and the baseline burn-down workflow.
+"""
+
+from __future__ import annotations
+
+from deepspeed_tpu.analysis.core import (  # noqa: F401
+    EXIT_CLEAN, EXIT_FINDINGS, EXIT_INTERNAL, EXIT_USAGE,
+    Baseline, BaselineEntry, Corpus, Directive, FileContext, Finding,
+    LintPass, LintResult, load_passes, registered_passes, run_lint)

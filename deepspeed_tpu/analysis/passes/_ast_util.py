@@ -1,0 +1,85 @@
+"""Small shared AST helpers for the lint passes (stdlib only)."""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator, Optional, Tuple
+
+
+def attr_chain(node: ast.AST) -> str:
+    """Dotted name of an Attribute/Name chain (``jax.experimental.
+    shard_map`` -> that string); '' when the chain roots in a call or
+    subscript (not a plain name path)."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return ""
+
+
+def call_name(node: ast.Call) -> str:
+    """Trailing name of the called object: ``jax.device_get(...)`` ->
+    'device_get', ``device_get(...)`` -> 'device_get', else ''."""
+    if isinstance(node.func, ast.Attribute):
+        return node.func.attr
+    if isinstance(node.func, ast.Name):
+        return node.func.id
+    return ""
+
+
+def expr_root(node: ast.AST) -> Tuple[str, ...]:
+    """Leading names of an Attribute/Subscript chain:
+    ``self.cache.lengths[i]`` -> ('self', 'cache', 'lengths')."""
+    parts = []
+    while True:
+        if isinstance(node, ast.Attribute):
+            parts.append(node.attr)
+            node = node.value
+        elif isinstance(node, ast.Subscript):
+            node = node.value
+        elif isinstance(node, ast.Name):
+            parts.append(node.id)
+            return tuple(reversed(parts))
+        else:
+            return ()
+
+
+def walk_with_parents(tree: ast.AST) -> Iterator[Tuple[ast.AST, list]]:
+    """Yield ``(node, ancestors)`` for every node, ancestors outermost
+    first (one shared, mutated list — copy if you keep it)."""
+    stack: list = []
+
+    def rec(node):
+        yield node, stack
+        stack.append(node)
+        for child in ast.iter_child_nodes(node):
+            yield from rec(child)
+        stack.pop()
+
+    yield from rec(tree)
+
+
+def enclosing_function(ancestors) -> Optional[ast.AST]:
+    for a in reversed(ancestors):
+        if isinstance(a, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            return a
+    return None
+
+
+def in_loop(ancestors, *, stop_at: ast.AST = None) -> bool:
+    """True when any ancestor below ``stop_at`` is a For/While."""
+    for a in reversed(ancestors):
+        if a is stop_at:
+            return False
+        if isinstance(a, (ast.For, ast.AsyncFor, ast.While)):
+            return True
+    return False
+
+
+def is_jit_call(node: ast.AST) -> bool:
+    """``jax.jit(...)`` / ``jit(...)`` / ``pjit(...)`` construction."""
+    return (isinstance(node, ast.Call)
+            and call_name(node) in ("jit", "pjit"))

@@ -1,0 +1,114 @@
+"""host-sync — no device→host synchronization in hot paths except at
+declared fences.
+
+The serving decode loop and the training step path live or die by async
+dispatch: one stray ``jax.device_get`` / ``.item()`` /
+``block_until_ready`` serializes the pipeline and the TPU idles for a
+host round-trip per step (PR 3 measured the telemetry fence at 1.4%
+precisely because every OTHER read stays on-device).  The legitimate
+sync points — token emission, swap-out gathers, the periodic telemetry
+fence, sentinel drains — are *declared*: each carries a
+``# dstpu-lint: fence=<why>`` comment naming its reason, so a new
+unfenced sync in these files is a lint error, not a perf regression
+found three PRs later.
+
+Flags, inside the hot-path scopes:
+
+  * ``jax.device_get(...)`` / ``device_get(...)``;
+  * ``jax.block_until_ready(...)``;
+  * ``<expr>.item()``;
+  * ``float()/int()/bool()/np.asarray()`` directly on ``self.state.*``
+    or ``self.cache.*`` — this repo's conventions put live device
+    arrays there, so the cast is an *implicit* transfer (the honest
+    spelling is an explicit ``jax.device_get`` under a fence comment).
+"""
+
+from __future__ import annotations
+
+import ast
+
+from deepspeed_tpu.analysis.core import FileContext, LintPass, register
+from deepspeed_tpu.analysis.passes._ast_util import (call_name, expr_root)
+
+#: the engine hot loops this contract protects (serving decode/prefill,
+#: training step paths).  Cold paths — checkpointing, ZeRO offload
+#: consolidation, eigenvalue probes — sync by design and stay out.
+HOT_PATH_SCOPES = (
+    "deepspeed_tpu/serving/",
+    "deepspeed_tpu/runtime/engine.py",
+    "deepspeed_tpu/runtime/pipe/engine.py",
+    "deepspeed_tpu/runtime/hybrid_engine.py",
+)
+
+_SYNC_CALLS = ("device_get", "block_until_ready")
+_CAST_CALLS = ("float", "int", "bool", "asarray")
+_DEVICE_STATE_ROOTS = (("self", "state"), ("self", "cache"))
+
+_FENCE_HINT = ("declare the sync: `# dstpu-lint: fence=<why>` on this "
+               "line, or batch the read into an existing fence")
+
+
+@register
+class HostSyncPass(LintPass):
+    id = "host-sync"
+    title = "no host synchronization in hot paths except declared fences"
+    scope = HOT_PATH_SCOPES
+
+    def check_file(self, ctx: FileContext):
+        # `asarray(...)` resolves through the file's imports: only
+        # numpy's is a device->host transfer (jnp's is an upload).
+        # Track both from-imports of the function and aliases of the
+        # module itself (`import numpy as onp`).
+        np_asarray_names = set()
+        np_quals = {"np", "numpy"}
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.ImportFrom) \
+                    and node.module == "numpy":
+                for a in node.names:
+                    if a.name == "asarray":
+                        np_asarray_names.add(a.asname or a.name)
+            elif isinstance(node, ast.Import):
+                for a in node.names:
+                    if a.name == "numpy":
+                        np_quals.add(a.asname or a.name)
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = call_name(node)
+            if name in _SYNC_CALLS:
+                what = ("jax.device_get" if name == "device_get"
+                        else "jax.block_until_ready")
+                yield ctx.finding(
+                    self.id, node,
+                    f"{what} in a hot path forces a device->host sync "
+                    "(async dispatch stalls for the round-trip)",
+                    suggestion=_FENCE_HINT)
+            elif (isinstance(node.func, ast.Attribute)
+                  and node.func.attr == "item" and not node.args
+                  and not node.keywords):
+                yield ctx.finding(
+                    self.id, node,
+                    ".item() in a hot path is a hidden device->host sync",
+                    suggestion=_FENCE_HINT)
+            elif name in _CAST_CALLS and len(node.args) == 1:
+                if name == "asarray":
+                    # np.asarray on a device array is an implicit
+                    # transfer; jnp.asarray is an upload (host->device),
+                    # fine in a hot path
+                    if isinstance(node.func, ast.Attribute):
+                        qual = node.func.value.id \
+                            if isinstance(node.func.value, ast.Name) \
+                            else ""
+                        if qual not in np_quals:
+                            continue
+                    elif node.func.id not in np_asarray_names:
+                        continue   # bare asarray not from numpy
+                root = expr_root(node.args[0])
+                if any(root[:2] == r for r in _DEVICE_STATE_ROOTS):
+                    yield ctx.finding(
+                        self.id, node,
+                        f"{name}() on device state "
+                        f"({'.'.join(root)}) is an implicit "
+                        "device->host transfer in a hot path",
+                        suggestion="spell the sync explicitly "
+                        "(jax.device_get) and " + _FENCE_HINT)

@@ -1,0 +1,160 @@
+"""jax-compat — version-gated jax APIs route through utils/jax_compat.
+
+``shard_map`` moved namespaces and renamed kwargs across jax releases
+(``check_rep`` -> ``check_vma``, ``auto`` -> ``axis_names``), vma typing
+appeared, ``lax.pcast`` appeared, and ``PartitionId``-era symbols died.
+``utils/jax_compat.py`` shims all of it — but only for call sites that
+go THROUGH the shim.  A direct import compiles on one jax and breaks on
+the next; the 37 still-failing seed tests (ROADMAP item 4) are exactly
+the sites that didn't.  This pass finds every direct use and names the
+shim to use; ``scripts/dstpu_lint.py --jaxcompat-report`` additionally
+emits the full call-site inventory (shim-internal sites included, as
+status ``shim``) — the migration work-list artifact LINT_JAXCOMPAT.md.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List
+
+from deepspeed_tpu.analysis.core import Corpus, FileContext, LintPass, register
+from deepspeed_tpu.analysis.passes._ast_util import attr_chain
+
+#: sanctioned shim layers: utils/jax_compat owns the API translation;
+#: ops/flash_attention owns the vma-typing probe/out-struct factory the
+#: kernel callers (ring_attention) route through
+SHIM_FILES = ("deepspeed_tpu/utils/jax_compat.py",
+              "deepspeed_tpu/ops/flash_attention.py")
+
+_SHARD_MAP_FIX = ("from deepspeed_tpu.utils.jax_compat import shard_map "
+                  "(translates check_rep/check_vma and auto/axis_names "
+                  "per installed jax)")
+_PCAST_FIX = ("deepspeed_tpu.utils.jax_compat.pcast_varying "
+              "(identity on jax without lax.pcast)")
+_VMA_FIX = ("deepspeed_tpu.ops.flash_attention.vma_typing_supported / "
+            "out_struct, or utils.jax_compat.has_vma_typing")
+_PARTITION_FIX = ("gate behind utils.jax_compat.has_vma_typing() or "
+                  "migrate off PartitionId-era symbols (ROADMAP item 4)")
+
+
+def gated_sites(ctx: FileContext) -> List[dict]:
+    """Every version-gated jax API reference in one file."""
+    out: List[dict] = []
+
+    def site(node, api, fix):
+        out.append({"path": ctx.relpath, "line": node.lineno,
+                    "api": api, "fix": fix, "symbol": ctx.symbol(node)})
+
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                if a.name.startswith("jax.experimental.shard_map"):
+                    site(node, "import jax.experimental.shard_map",
+                         _SHARD_MAP_FIX)
+                elif a.name.startswith("jax.experimental.maps"):
+                    site(node, "jax.experimental.maps (removed xmap era)",
+                         _PARTITION_FIX)
+        elif isinstance(node, ast.ImportFrom):
+            mod = node.module or ""
+            if mod.startswith("jax.experimental.shard_map"):
+                site(node, "jax.experimental.shard_map import",
+                     _SHARD_MAP_FIX)
+            elif mod == "jax.experimental" and any(
+                    a.name in ("shard_map", "maps") for a in node.names):
+                site(node, "from jax.experimental import shard_map/maps",
+                     _SHARD_MAP_FIX)
+            elif mod == "jax" and any(a.name == "shard_map"
+                                      for a in node.names):
+                site(node, "from jax import shard_map (new-jax only)",
+                     _SHARD_MAP_FIX)
+            elif mod.startswith("jax.experimental.maps"):
+                site(node, "jax.experimental.maps (removed xmap era)",
+                     _PARTITION_FIX)
+        elif isinstance(node, ast.Attribute):
+            chain = attr_chain(node)
+            if chain.startswith("jax.experimental.shard_map"):
+                site(node, chain, _SHARD_MAP_FIX)
+            elif chain.endswith("lax.pcast"):
+                site(node, chain + " (absent on older jax)", _PCAST_FIX)
+            elif node.attr == "PartitionId":
+                site(node, chain or node.attr, _PARTITION_FIX)
+        elif isinstance(node, ast.Name) and node.id == "PartitionId":
+            site(node, "PartitionId (pre-vma jax only)", _PARTITION_FIX)
+        elif isinstance(node, ast.Call):
+            # kwarg checks are scoped to the APIs that own them — a
+            # generic `check_rep=`/`vma=` on an unrelated call is not a
+            # jax-version hazard
+            callee = node.func.attr \
+                if isinstance(node.func, ast.Attribute) else (
+                    node.func.id if isinstance(node.func, ast.Name)
+                    else "")
+            for kw in node.keywords:
+                if kw.arg == "check_rep" and callee == "shard_map":
+                    site(kw.value,
+                         "check_rep= kwarg (renamed check_vma)",
+                         _SHARD_MAP_FIX)
+                elif kw.arg == "vma" and callee == "ShapeDtypeStruct":
+                    site(kw.value, "vma= kwarg (vma-typing jax only)",
+                         _VMA_FIX)
+    return out
+
+
+@register
+class JaxCompatPass(LintPass):
+    id = "jax-compat"
+    title = "version-gated jax APIs must route through utils/jax_compat"
+    scope = ()          # whole tree
+    exempt = SHIM_FILES
+
+    def check_file(self, ctx: FileContext):
+        from deepspeed_tpu.analysis.core import Finding
+
+        for s in gated_sites(ctx):
+            yield Finding(
+                self.id, ctx.relpath, s["line"], 0,
+                f"direct use of version-gated jax API: {s['api']}",
+                symbol=s["symbol"], suggestion=s["fix"])
+
+    # ---------------------------------------------------------- inventory
+    def inventory(self, corpus: Corpus) -> List[dict]:
+        """Every version-gated call site in the tree — the ROADMAP item 4
+        migration work-list: 'direct' (violations), 'shim' (the
+        translation layers' own uses), and 'routed' (call sites that go
+        through a shim entry point — the surface the migration PR must
+        revisit when the compat layer changes shape)."""
+        shim_names = ("shard_map", "pcast_varying", "has_vma_typing",
+                      "vma_typing_supported", "out_struct")
+        rows: List[dict] = []
+        for ctx in corpus.files:
+            if ctx.tree is None:
+                continue
+            status = "shim" if ctx.relpath in SHIM_FILES else "direct"
+            for s in gated_sites(ctx):
+                s["status"] = status
+                rows.append(s)
+            if status == "shim":
+                continue
+            # names this file imports FROM the shims
+            routed: dict = {}
+            for node in ast.walk(ctx.tree):
+                if isinstance(node, ast.ImportFrom):
+                    mod = node.module or ""
+                    if mod.endswith("jax_compat") \
+                            or mod.endswith("ops.flash_attention"):
+                        for a in node.names:
+                            if a.name in shim_names:
+                                routed[a.asname or a.name] = a.name
+            if not routed:
+                continue
+            for node in ast.walk(ctx.tree):
+                if (isinstance(node, ast.Call)
+                        and isinstance(node.func, ast.Name)
+                        and node.func.id in routed):
+                    rows.append({
+                        "path": ctx.relpath, "line": node.lineno,
+                        "api": f"via shim: {routed[node.func.id]}",
+                        "fix": "", "symbol": ctx.symbol(node),
+                        "status": "routed"})
+        order = {"direct": 0, "shim": 1, "routed": 2}
+        rows.sort(key=lambda r: (order[r["status"]], r["path"], r["line"]))
+        return rows

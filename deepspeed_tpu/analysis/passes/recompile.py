@@ -1,0 +1,108 @@
+"""recompile-hazard — jit program construction whose cache key can vary
+per call.
+
+The zero-recompile contract (pinned dynamically by every serving suite
+via compile counters) has a static shadow: a ``jax.jit`` whose compiled
+object is discarded, rebuilt per loop iteration, or keyed by a raw
+length/shape re-traces on data the engine cannot bucket.  Three shapes
+of the same bug:
+
+  * **R1 immediate invocation** — ``jax.jit(f)(x)`` inside a function:
+    the compiled callable is dropped on the floor, so every call of the
+    enclosing function pays a fresh trace+compile.
+  * **R2 construction in a loop** — ``jax.jit(...)`` in a For/While
+    body compiles per iteration.  Exempt when the result is stored into
+    a subscript (``cache[key] = jax.jit(...)``) — that is the repo's
+    keyed-memoization idiom (inference/engine.py ``self._compiled``).
+  * **R3 unbucketed cache key** — ``cache[<key with len()/.shape>] =
+    jax.jit(...)``: the key takes a distinct value per prompt length,
+    so the "cache" is a compile-per-request log.  Keys must be bucket
+    ids (the ``self.buckets`` discipline serving/engine.py pins with
+    compile-counter tests).
+"""
+
+from __future__ import annotations
+
+import ast
+
+from deepspeed_tpu.analysis.core import FileContext, LintPass, register
+from deepspeed_tpu.analysis.passes._ast_util import (
+    enclosing_function, in_loop, is_jit_call, walk_with_parents)
+
+SCOPES = (
+    "deepspeed_tpu/serving/",
+    "deepspeed_tpu/inference/",
+    "deepspeed_tpu/runtime/",
+    "deepspeed_tpu/moe/",
+)
+
+
+def _key_varies(key: ast.AST) -> str:
+    """Non-empty reason when a cache-key expression derives from a raw
+    length or shape (compiles per distinct value)."""
+    for n in ast.walk(key):
+        if (isinstance(n, ast.Call) and isinstance(n.func, ast.Name)
+                and n.func.id == "len"):
+            return "len(...)"
+        if isinstance(n, ast.Attribute) and n.attr == "shape":
+            return ".shape"
+    return ""
+
+
+@register
+class RecompileHazardPass(LintPass):
+    id = "recompile-hazard"
+    title = "jit construction whose cache key can vary per call"
+    scope = SCOPES
+
+    def check_file(self, ctx: FileContext):
+        reported_inner = set()   # jit calls already covered by an R1 site
+        for node, ancestors in walk_with_parents(ctx.tree):
+            # R1: jax.jit(f)(...) — compiled object discarded
+            if (isinstance(node, ast.Call) and is_jit_call(node.func)
+                    and enclosing_function(ancestors) is not None):
+                reported_inner.add(id(node.func))
+                yield ctx.finding(
+                    self.id, node,
+                    "jit program invoked immediately: the compiled "
+                    "callable is discarded, so every call re-traces and "
+                    "re-compiles",
+                    suggestion="build once (module scope or keyed cache) "
+                    "and call the cached program")
+                continue
+            if not is_jit_call(node) or id(node) in reported_inner:
+                continue
+            fn = enclosing_function(ancestors)
+            if fn is None:
+                continue  # module-scope construction compiles once
+            parent = ancestors[-1] if ancestors else None
+            grand = ancestors[-2] if len(ancestors) >= 2 else None
+            # the memoization idiom: cache[key] = jax.jit(...)
+            memo_target = None
+            if isinstance(parent, ast.Assign) and node is parent.value:
+                tgt = parent.targets[0]
+                if isinstance(tgt, ast.Subscript):
+                    memo_target = tgt
+            elif (isinstance(grand, ast.Assign)
+                  and isinstance(grand.targets[0], ast.Subscript)):
+                memo_target = grand.targets[0]
+            # R3: keyed memoization with an unbucketed key
+            if memo_target is not None:
+                varies = _key_varies(memo_target.slice)
+                if varies:
+                    yield ctx.finding(
+                        self.id, node,
+                        f"jit cache key derives from {varies}: one "
+                        "compile per distinct runtime value — the cache "
+                        "is a compile-per-request log",
+                        suggestion="key by bucket id (round the length "
+                        "up to a fixed bucket set first)")
+                continue
+            # R2: un-memoized construction inside a loop
+            if in_loop(ancestors, stop_at=fn):
+                yield ctx.finding(
+                    self.id, node,
+                    "jax.jit constructed inside a loop compiles per "
+                    "iteration",
+                    suggestion="hoist out of the loop, or memoize into a "
+                    "keyed cache (cache[key] = jax.jit(...))")

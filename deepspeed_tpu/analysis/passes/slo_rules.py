@@ -1,0 +1,41 @@
+"""slo-rules — the built-in DEFAULT_SLO_CONFIG must validate.
+
+Migrated from ``scripts/check_slo_rules.py`` (ISSUE 13 satellite) onto
+the pass framework; the script stays as the CLI for validating
+arbitrary config files (exit 0/1/2 contract pinned by
+tests/unit/telemetry/test_slo_plane.py).  As a pass it pins the config
+every engine runs when none is supplied: unknown SLI names, malformed
+windows, and burn thresholds that can NEVER fire (a rule that looks
+armed but is dead) fail the lint before they ship.
+"""
+
+from __future__ import annotations
+
+from deepspeed_tpu.analysis.core import Corpus, Finding, LintPass, register
+
+_SLO_PATH = "deepspeed_tpu/telemetry/slo.py"
+
+
+@register
+class SloRulesPass(LintPass):
+    id = "slo-rules"
+    title = "the built-in DEFAULT_SLO_CONFIG validates"
+
+    #: test seam: swap in a known-bad config to prove the pass fires
+    config_override = None
+
+    def finalize(self, corpus: Corpus):
+        # the default config only matters on trees that ship it (the
+        # fixture corpora in tests/unit/analysis don't)
+        if corpus.by_relpath(_SLO_PATH) is None:
+            return
+        from deepspeed_tpu.telemetry.slo import (DEFAULT_SLO_CONFIG,
+                                                 validate_slo_config)
+
+        cfg = self.config_override or DEFAULT_SLO_CONFIG
+        for err in validate_slo_config(cfg):
+            yield Finding(
+                self.id, _SLO_PATH, 1, 0,
+                f"built-in DEFAULT_SLO_CONFIG invalid: {err}",
+                suggestion="fix the shipped default (every engine runs "
+                "it when no SLO config is supplied)")

@@ -1,0 +1,58 @@
+"""typed-error — the serving stack raises its typed hierarchy, never
+bare stdlib exceptions.
+
+The fabric router's retry policy keys on exception TYPE (serving/
+errors.py: ``TransientReplicaError`` retries, ``ReplicaCrashedError``
+fails over, ``InvalidRequestError`` never retries) — a bare
+``ValueError`` raised anywhere in ``deepspeed_tpu/serving/`` is
+invisible to that machinery and to callers who catch the typed bases.
+Every raise in the serving tree must use (a subclass of) the hierarchy;
+the compat rule from ISSUE 9 still holds, so typed config/invariant
+errors subclass ``ValueError``/``RuntimeError`` and pre-existing
+``except ValueError`` call sites keep working.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from deepspeed_tpu.analysis.core import FileContext, LintPass, register
+
+SCOPES = ("deepspeed_tpu/serving/",)
+
+#: bare type -> the typed replacement to suggest
+_BARE = {
+    "ValueError": "EngineConfigError (or an InvalidRequestError subclass "
+                  "for per-request validation)",
+    "RuntimeError": "EngineInvariantError (or SwapCapacityError / a "
+                    "FabricError subclass)",
+    "Exception": "a ServingError subclass",
+    "TypeError": "EngineTypeError (keeps the TypeError lineage)",
+}
+
+
+@register
+class TypedErrorPass(LintPass):
+    id = "typed-error"
+    title = "serving paths raise the typed hierarchy from serving/errors.py"
+    scope = SCOPES
+    exempt = ("deepspeed_tpu/serving/errors.py",)
+
+    def check_file(self, ctx: FileContext):
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Raise) or node.exc is None:
+                continue
+            exc = node.exc
+            name = None
+            if isinstance(exc, ast.Call) and isinstance(exc.func, ast.Name):
+                name = exc.func.id
+            elif isinstance(exc, ast.Name):
+                name = exc.id
+            if name in _BARE:
+                yield ctx.finding(
+                    self.id, node,
+                    f"bare `raise {name}` in the serving stack: the "
+                    "fabric's retry policy and typed `except` sites key "
+                    "on serving/errors.py types and cannot see this",
+                    suggestion=f"raise {_BARE[name]} from "
+                    "deepspeed_tpu/serving/errors.py")

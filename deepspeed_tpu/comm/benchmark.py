@@ -27,7 +27,8 @@ def run_collective_bench(op: str = "all_reduce", sizes: List[int] = None,
     import jax
     import jax.numpy as jnp
     from jax.sharding import Mesh, PartitionSpec as P
-    from jax.experimental.shard_map import shard_map
+
+    from deepspeed_tpu.utils.jax_compat import shard_map
 
     devices = jax.devices()
     n = len(devices)

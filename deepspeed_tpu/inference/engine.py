@@ -149,6 +149,7 @@ class InferenceEngine:
                 # ever materialize — initializing a 7B model in f32 and
                 # casting after would transiently need 2x the weight HBM
                 # (27 GB at 6.7B)
+                # dstpu-lint: disable=recompile-hazard -- one-shot fused init+cast at engine construction
                 params = jax.jit(self._init_cast)(
                     jax.random.PRNGKey(config.seed))
             self.params = self._shard_and_cast(params)
@@ -226,6 +227,7 @@ class InferenceEngine:
 
             # block per leaf: overlapping two leaf programs would double the
             # transient bf16 footprint this path exists to avoid
+            # dstpu-lint: disable=recompile-hazard -- init-time weight quantize: serial per-leaf programs bound the transient bf16 footprint
             quantized[path] = jax.block_until_ready(jax.jit(leaf_q)(key))
 
         def rest(key):
@@ -240,6 +242,7 @@ class InferenceEngine:
         # NamedSharding) — this path is gated to tp=1/ep=1, where the
         # specs are replicated, but the contract should not silently
         # diverge between init paths
+        # dstpu-lint: disable=recompile-hazard -- one-shot init-time quantize of the non-block leaves
         params = self._shard_and_cast(jax.jit(rest)(key))
         for path, qleaf in quantized.items():
             get(params, path[:-1])[path[-1]] = qleaf

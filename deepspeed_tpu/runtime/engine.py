@@ -278,6 +278,7 @@ class DeepSpeedEngine:
         if training_data is not None:
             self.training_dataloader = self.deepspeed_io(training_data, collate_fn=collate_fn)
         self.timers = SynchronizedWallClockTimer(
+            # dstpu-lint: fence=timer sync_fn IS the declared wall-clock fence (utils/timer.py)
             sync_fn=lambda: jax.block_until_ready(self.state.params))
         self.tput_timer = ThroughputTimer(
             batch_size=config.train_batch_size,
@@ -490,6 +491,7 @@ class DeepSpeedEngine:
                               global_step=jnp.zeros((), jnp.int32))
         opt_init = self._onebit_opt_init if self._onebit_compressed \
             else self.optimizer.init
+        # dstpu-lint: disable=recompile-hazard -- one-shot optimizer-state init at engine construction
         opt_state = jax.jit(opt_init, out_shardings=self.opt_shardings)(params)
         return TrainState(params=params, opt_state=opt_state, scaler=scaler_state,
                           global_step=jnp.zeros((), jnp.int32))
@@ -900,6 +902,7 @@ class DeepSpeedEngine:
         if self.sentinel is not None:
             self._resilience_step(metrics, batch)
         if self._sync_each_step:
+            # dstpu-lint: fence=opt-in per-step fence (config sync_each_step)
             jax.block_until_ready(self.state.params)
         return metrics["loss"]
 
@@ -922,8 +925,8 @@ class DeepSpeedEngine:
                 ltd_keep = keep
         grads, metrics = self._compiled_grad_step(self.state, batch, rng,
                                                   ltd_keep)
-        overflow = bool(jax.device_get(metrics["overflow"]))
-        norm = float(jax.device_get(metrics["grad_norm"]))
+        overflow = bool(jax.device_get(metrics["overflow"]))  # dstpu-lint: fence=host-optimizer path: overflow/norm gate the host apply
+        norm = float(jax.device_get(metrics["grad_norm"]))  # dstpu-lint: fence=host-optimizer path: overflow/norm gate the host apply
         self._host_apply(grads, overflow, norm, lr)
         self._global_grad_norm = metrics["grad_norm"]
         self.micro_steps += self.gas
@@ -941,6 +944,7 @@ class DeepSpeedEngine:
         if self.sentinel is not None:
             self._resilience_step(metrics, batch)
         if self._sync_each_step:
+            # dstpu-lint: fence=opt-in per-step fence (config sync_each_step)
             jax.block_until_ready(self.state.params)
         return metrics["loss"]
 
@@ -987,7 +991,7 @@ class DeepSpeedEngine:
             # dispatch the timer otherwise brackets only the dispatch and
             # self-reports physically impossible rates (36M tokens/sec
             # observed on the tunnel chip in round 4)
-            float(jax.device_get(metrics["loss"]))
+            float(jax.device_get(metrics["loss"]))  # dstpu-lint: fence=autotune armed-step fence: honest rates
         if result_path and self.global_steps >= 5:
             import json as _json
 
@@ -1005,14 +1009,16 @@ class DeepSpeedEngine:
         # config key; 0 = legacy coupling to steps_per_print)
         mon_interval = cfg.monitor_interval or max(cfg.steps_per_print or 0, 1)
         if self.monitor.enabled and self.global_steps % mon_interval == 0:
+            # dstpu-lint: fence=monitor cadence read (mon_interval-gated)
             loss = float(jax.device_get(metrics["loss"]))
             events = [("Train/Samples/train_loss", loss, self.global_steps),
                       ("Train/Samples/lr", self.get_lr()[0], self.global_steps)]
             if self.fp16_enabled:
                 events.append(("Train/Samples/loss_scale",
-                               float(jax.device_get(metrics["loss_scale"])), self.global_steps))
+                               float(jax.device_get(metrics["loss_scale"])), self.global_steps))  # dstpu-lint: fence=monitor cadence read
             self.monitor.write_events(events)
         if cfg.steps_per_print and self.global_steps % cfg.steps_per_print == 0:
+            # dstpu-lint: fence=steps_per_print cadence read
             loss = float(jax.device_get(metrics["loss"]))
             log_dist(f"step={self.global_steps} loss={loss:.4f} lr={self.get_lr()[0]:.3e}",
                      ranks=[0])
@@ -1069,6 +1075,7 @@ class DeepSpeedEngine:
         _reset_telemetry_window — caller-side stalls between steps are
         still charged (they are invisible from here)."""
         reg = self.telemetry
+        # dstpu-lint: fence=THE periodic telemetry fence (sync_interval): device-truth metrics
         jax.block_until_ready(self.state.params)
         now = time.perf_counter()
         steps = self.global_steps - self._fence_step
@@ -1104,20 +1111,20 @@ class DeepSpeedEngine:
         # these fetches are free of extra sync
         try:
             reg.gauge("train/grad_norm").set(
-                float(jax.device_get(metrics["grad_norm"])))
+                float(jax.device_get(metrics["grad_norm"])))  # dstpu-lint: fence=post-fence read: pipeline already drained
             reg.gauge("train/loss").set(
-                float(jax.device_get(metrics["loss"])))
+                float(jax.device_get(metrics["loss"])))  # dstpu-lint: fence=post-fence read: pipeline already drained
             if self.fp16_enabled:
                 reg.gauge("train/loss_scale").set(
-                    float(jax.device_get(metrics["loss_scale"])))
+                    float(jax.device_get(metrics["loss_scale"])))  # dstpu-lint: fence=post-fence read: pipeline already drained
                 # device global_step counts only successful steps; the host
                 # counter counts all — the difference IS the skip count
-                device_gs = int(jax.device_get(self.state.global_step))
+                device_gs = int(jax.device_get(self.state.global_step))  # dstpu-lint: fence=post-fence read: pipeline already drained
                 reg.gauge("train/fp16_skipped_steps").set(
                     max(self.global_steps - device_gs, 0))
             elif self._check_finite_grads:
                 # same accounting for the bf16/fp32 finite-grad guard
-                device_gs = int(jax.device_get(self.state.global_step))
+                device_gs = int(jax.device_get(self.state.global_step))  # dstpu-lint: fence=post-fence read: pipeline already drained
                 reg.gauge("train/nonfinite_skipped_steps").set(
                     max(self.global_steps - device_gs, 0))
         except Exception:
@@ -1286,6 +1293,7 @@ class DeepSpeedEngine:
         t0 = time.perf_counter() if self.tracer is not None else 0.0
         pending, self._pending_anomaly_reads = \
             self._pending_anomaly_reads, []
+        # dstpu-lint: fence=sentinel drain: ONE batched fetch at the declared cadence
         vals = jax.device_get([(l, n, o) for _, l, n, o in pending])
         reg = self.telemetry
         found = None
@@ -1603,7 +1611,7 @@ class DeepSpeedEngine:
                     self._unscale_epilogue, donate_argnums=(0,))
             grads, overflow, norm = self._compiled_prep_grads(
                 self._grad_acc, self.state.scaler)
-            self._host_apply(grads, bool(jax.device_get(overflow)),
+            self._host_apply(grads, bool(jax.device_get(overflow)),  # dstpu-lint: fence=host-optimizer path: boundary apply is host-side
                              float(jax.device_get(norm)), self.get_lr()[0])
             self._grad_acc = None
             self._acc_count = 0
@@ -1660,6 +1668,7 @@ class DeepSpeedEngine:
 
     def get_global_grad_norm(self):
         return None if self._global_grad_norm is None else float(
+            # dstpu-lint: fence=user-facing accessor, not on the step path
             jax.device_get(self._global_grad_norm))
 
     def train_micro_batch_size_per_gpu(self) -> int:
@@ -1679,6 +1688,7 @@ class DeepSpeedEngine:
         return self.state.params
 
     def get_loss_scale(self):
+        # dstpu-lint: fence=user-facing accessor, not on the step path
         return float(jax.device_get(self.state.scaler.cur_scale))
 
     # --------------------------------------------------------------- data io

@@ -420,6 +420,7 @@ class PipelineEngine(DeepSpeedEngine):
             self._record_step_telemetry(
                 metrics, batch, time.perf_counter() - t_start)
         if self._sync_each_step:
+            # dstpu-lint: fence=opt-in per-step fence (config sync_each_step)
             jax.block_until_ready(self.state.params)
         return metrics["loss"]
 

@@ -17,9 +17,13 @@ typed error hierarchy in serving/errors.py.
 """
 
 from deepspeed_tpu.serving.engine import ServingEngine
-from deepspeed_tpu.serving.errors import (EmptyPromptError, FabricError,
+from deepspeed_tpu.serving.errors import (EmptyPromptError,
+                                          EngineConfigError,
+                                          EngineInvariantError,
+                                          EngineTypeError, FabricError,
                                           InvalidMaxNewTokensError,
                                           InvalidRequestError,
+                                          KVLifecycleError,
                                           NoHealthyReplicaError,
                                           PromptTooLongError,
                                           ReplicaCrashedError,
@@ -59,4 +63,7 @@ __all__ = ["ServingEngine", "SlotKVCache", "BlockKVPool", "PrefixCache",
            "SlotCapacityError", "SwapCapacityError", "FabricError",
            "RouterOverloadedError", "NoHealthyReplicaError",
            "RetriesExhaustedError", "ReplicaCrashedError",
-           "TransientReplicaError"]
+           "TransientReplicaError",
+           # typed errors (ISSUE 14 typed-error pass)
+           "EngineConfigError", "KVLifecycleError", "EngineInvariantError",
+           "EngineTypeError"]

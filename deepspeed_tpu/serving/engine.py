@@ -36,6 +36,8 @@ import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from deepspeed_tpu.serving.errors import (EmptyPromptError,
+                                          EngineConfigError,
+                                          EngineInvariantError,
                                           InvalidMaxNewTokensError,
                                           PromptTooLongError,
                                           SlotCapacityError,
@@ -65,7 +67,7 @@ def _host_blocks(tree, n_used: int):
     blocks (axis 1 is block-major on every leaf — payloads AND the
     quantized pools' scale arrays), as host numpy."""
     return jax.tree_util.tree_map(
-        lambda a: np.asarray(a)[:, :n_used], jax.device_get(tree))
+        lambda a: np.asarray(a)[:, :n_used], jax.device_get(tree))  # dstpu-lint: fence=swap-out gather lands host-side by definition
 
 
 def _expand_blocks(tree, mb: int):
@@ -316,12 +318,12 @@ class ServingEngine:
         if not getattr(mcfg, "has_position_table", True):
             model_max = None
         if model_max is not None and max_len > model_max:
-            raise ValueError(
+            raise EngineConfigError(
                 f"serving max_len {max_len} exceeds the model's max_seq_len "
                 f"{model_max} (position table size)")
         self.kv_dtype = normalize_kv_dtype(kv_dtype)
         if self.kv_dtype is not None and not prefix_cache:
-            raise ValueError(
+            raise EngineConfigError(
                 f"kv_dtype={kv_dtype!r} needs prefix_cache=True: quantized "
                 "KV lives in the block-paged pool (serving/kv_quant.py); "
                 "the slot-paged cache stays in the compute dtype")
@@ -354,10 +356,10 @@ class ServingEngine:
         # 1024-token bucket, not a 512 ceiling)
         self.buckets = tuple(sorted({min(b, max_len) for b in buckets}))
         if not self.buckets:
-            raise ValueError(f"no prefill buckets given: {buckets}")
+            raise EngineConfigError(f"no prefill buckets given: {buckets}")
         for b in self.buckets:
             if b % max(self.cache.pair, 1):
-                raise ValueError(
+                raise EngineConfigError(
                     f"prefill bucket {b} must be a multiple of the cache "
                     f"token-pair pack factor {self.cache.pair} "
                     "(ops/attention.kv_pack_factor)")
@@ -382,7 +384,7 @@ class ServingEngine:
         # ---- SLO-aware scheduling (ISSUE 8)
         if prefill_token_budget is not None:
             if prefill_token_budget < self.buckets[0]:
-                raise ValueError(
+                raise EngineConfigError(
                     f"prefill_token_budget {prefill_token_budget} below the "
                     f"smallest prefill bucket {self.buckets[0]}: no chunk "
                     f"program could ever run under it")
@@ -395,7 +397,7 @@ class ServingEngine:
             self._chunk_max = None
         self.prefill_token_budget = prefill_token_budget
         if preemption not in (None, "swap"):
-            raise ValueError(f"preemption policy must be None or 'swap', "
+            raise EngineConfigError(f"preemption policy must be None or 'swap', "
                              f"got {preemption!r}")
         self.preemption = preemption
         # swap_max_bytes (ISSUE 9 satellite) caps the host swap buffer:
@@ -407,7 +409,7 @@ class ServingEngine:
             if preemption else None
         self._preempted: Dict[int, _Preempted] = {}
         if tpot_slo_ms is not None and prefill_token_budget is None:
-            raise ValueError(
+            raise EngineConfigError(
                 "tpot_slo_ms needs prefill_token_budget: the SLO guard "
                 "defers budgeted prefill work, and monolithic admission "
                 "has no budget to defer")
@@ -454,7 +456,7 @@ class ServingEngine:
             # acceptance — reserve the lookahead rows at admission
             self._lookahead = self.spec.k_max
             if max_len <= self._lookahead:
-                raise ValueError(
+                raise EngineConfigError(
                     f"speculative k_max {self._lookahead} leaves no slot "
                     f"capacity at max_len {max_len}")
             if self.spec.mode == "draft":
@@ -771,14 +773,14 @@ class ServingEngine:
                         self.cache.sentinel, np.int32))
                     ko, vo = self._swap_out_fn(*self._cap(
                         "swap_out", self.cache.k, self.cache.v, sent))
-                    args_in = (_to_device(jax.device_get(ko)),
+                    args_in = (_to_device(jax.device_get(ko)),  # dstpu-lint: fence=warmup: pre-cache numpy-upload swap signature
                                _to_device(jax.device_get(vo)),
                                sent)
                 else:
                     ko, vo = self._swap_out_fn(*self._cap(
                         "swap_out", self.cache.k, self.cache.v,
                         np.int32(0)))
-                    args_in = (jnp.asarray(np.asarray(jax.device_get(ko))),
+                    args_in = (jnp.asarray(np.asarray(jax.device_get(ko))),  # dstpu-lint: fence=warmup: pre-cache numpy-upload swap signature
                                jnp.asarray(np.asarray(jax.device_get(vo))))
                 out = self._swap_in_fn(*self._cap(
                     "swap_in", self.cache.k, self.cache.v,
@@ -910,7 +912,7 @@ class ServingEngine:
                 self._slots[i] = None
                 self.scheduler.release(i)
                 if self.prefix is not None:
-                    length = int(jax.device_get(self.cache.lengths[i]))
+                    length = int(jax.device_get(self.cache.lengths[i]))  # dstpu-lint: fence=cancel path (cold): computed length gates the radix donate
                     self.prefix.finish(i, donate_upto=length)
                 self._trace_cancel(rid, "slot")
                 return True
@@ -1339,7 +1341,7 @@ class ServingEngine:
                 # per-tenant computed tokens sum EXACTLY to it
                 self.tenants.note_prefill(st.tenant, chunk)
             if last:
-                tok = int(jax.device_get(out[3]))
+                tok = int(jax.device_get(out[3]))  # dstpu-lint: fence=token emission: the chunk's final pick must reach the host stream
                 self.prefill_calls += 1
                 self.tokens_generated += 1
                 st.last_token = tok
@@ -1431,7 +1433,7 @@ class ServingEngine:
         if armed:
             t_sw0 = self._now(now)
             w0 = time.perf_counter()
-        length = int(jax.device_get(self.cache.lengths[slot]))
+        length = int(jax.device_get(self.cache.lengths[slot]))  # dstpu-lint: fence=preemption swap-out: computed length bounds the parked blocks
         if self.prefix is not None:
             n_used = self.cache.blocks_for(length)
             table = jnp.asarray(self.cache.tables[slot])
@@ -1454,7 +1456,7 @@ class ServingEngine:
             ko, vo = self._swap_out_fn(self.cache.k, self.cache.v,
                                        np.int32(slot))
             self.swap.put(st.request.rid,
-                          np.asarray(jax.device_get(ko)),
+                          np.asarray(jax.device_get(ko)),  # dstpu-lint: fence=preemption swap-out parks KV host-side
                           np.asarray(jax.device_get(vo)))
             n_used = 1
             self.swapped_blocks_out += 1      # the slot page
@@ -1692,7 +1694,7 @@ class ServingEngine:
                                jnp.asarray(toks), jnp.asarray(active),
                                self._temp, self._next_rng())
             self.cache.update(*out[:3])
-            nxt = np.asarray(jax.device_get(out[3]))
+            nxt = np.asarray(jax.device_get(out[3]))  # dstpu-lint: fence=token emission: decode's picks feed host continuations + streams
         dt = time.perf_counter() - t0
         self.decode_wall += dt
         if armed:
@@ -1801,8 +1803,8 @@ class ServingEngine:
                 jnp.asarray(tokens), jnp.asarray(lens),
                 jnp.asarray(active), self._temp, self._next_rng())
             self.cache.update(*out[:3])
-            out_tokens = np.asarray(jax.device_get(out[3]))
-            n_emit = np.asarray(jax.device_get(out[4]))
+            out_tokens = np.asarray(jax.device_get(out[3]))  # dstpu-lint: fence=token emission: accepted drafts reach host streams
+            n_emit = np.asarray(jax.device_get(out[4]))  # dstpu-lint: fence=token emission: accepted drafts reach host streams
         dt = time.perf_counter() - t0
         self._verify_wall += dt
         self.decode_wall += dt
@@ -1882,7 +1884,7 @@ class ServingEngine:
                         time.sleep(min(nxt - now, 0.05))
                     stall += 1
                     if stall > 10_000_000:
-                        raise RuntimeError(
+                        raise EngineInvariantError(
                             "serving clock is not advancing toward the "
                             "next arrival (non-monotonic time_fn?)")
                     continue

@@ -25,6 +25,36 @@ class ServingError(Exception):
     """Base of every typed serving-stack error."""
 
 
+# ----------------------------------------------- config / lifecycle / bugs
+class EngineConfigError(ServingError, ValueError):
+    """Construction-time misconfiguration of the engine, KV pools,
+    scheduler, drafter, or fabric (bad buckets, dtypes, thresholds):
+    permanent — no retry or admission order can serve it.  Subclasses
+    ``ValueError`` so pre-typed ``except ValueError`` sites keep working
+    (ISSUE 14 typed-error pass: every serving raise is typed)."""
+
+
+class KVLifecycleError(ServingError, ValueError):
+    """KV block/swap lifecycle misuse by a caller: unpinning an unpinned
+    block, freeing a pinned one, evicting an interior radix node, double
+    preemption without a resume.  A programming error at the call site,
+    not capacity pressure (subclasses ``ValueError`` — these sites
+    predate the typed hierarchy and tests pin that family)."""
+
+
+class EngineTypeError(ServingError, TypeError):
+    """A serving-config argument of the wrong TYPE (vs. a bad value):
+    subclasses ``TypeError`` so the stdlib convention — and any
+    pre-typed ``except TypeError`` site — keeps holding."""
+
+
+class EngineInvariantError(ServingError, RuntimeError):
+    """An internal serving invariant broke — pool exhausted past the
+    admission gate, a clock that stops advancing: an engine bug, not an
+    operator or caller error (subclasses ``RuntimeError`` for
+    compatibility with pre-typed call sites)."""
+
+
 # --------------------------------------------------------- submit validation
 class InvalidRequestError(ServingError, ValueError):
     """The request itself is malformed — permanent, never retried

@@ -22,6 +22,8 @@ from __future__ import annotations
 
 from typing import Optional
 
+from deepspeed_tpu.serving.errors import EngineConfigError
+
 CLOSED = "closed"
 OPEN = "open"
 HALF_OPEN = "half_open"
@@ -35,7 +37,7 @@ class CircuitBreaker:
     def __init__(self, *, failure_threshold: int = 3,
                  cooldown_s: float = 1.0):
         if failure_threshold < 1:
-            raise ValueError(f"failure_threshold must be >= 1, "
+            raise EngineConfigError(f"failure_threshold must be >= 1, "
                              f"got {failure_threshold}")
         self.failure_threshold = failure_threshold
         self.cooldown_s = cooldown_s

@@ -50,7 +50,9 @@ import time
 from typing import Callable, Dict, List, Optional, Sequence
 
 from deepspeed_tpu.elasticity.elastic_agent import backoff_delay
-from deepspeed_tpu.serving.errors import (InvalidRequestError,
+from deepspeed_tpu.serving.errors import (EngineConfigError,
+                                          EngineInvariantError,
+                                          InvalidRequestError,
                                           NoHealthyReplicaError,
                                           ReplicaCrashedError,
                                           RouterOverloadedError,
@@ -181,10 +183,10 @@ class FabricRouter:
                  shed_burst_threshold: int = 4,
                  shed_burst_window_s: float = 1.0):
         if not replicas:
-            raise ValueError("fabric needs at least one replica")
+            raise EngineConfigError("fabric needs at least one replica")
         names = [r.name for r in replicas]
         if len(set(names)) != len(names):
-            raise ValueError(f"duplicate replica names: {names}")
+            raise EngineConfigError(f"duplicate replica names: {names}")
         self.replicas: Dict[str, Replica] = {r.name: r for r in replicas}
         self.replica_factory = replica_factory
         self.supervisor = supervisor
@@ -877,7 +879,7 @@ class FabricRouter:
                 time.sleep(0.001)
             stall = 0 if progressed else stall + 1
             if stall > 10_000_000:
-                raise RuntimeError(
+                raise EngineInvariantError(
                     "fabric clock is not advancing toward the next "
                     "arrival/retry/restart (non-monotonic time_fn?)")
         out.extend(self._done)   # sheds emitted after the last step drain

@@ -55,6 +55,9 @@ from typing import List, Tuple
 import jax.numpy as jnp
 import numpy as np
 
+from deepspeed_tpu.serving.errors import (EngineConfigError,
+                                          EngineInvariantError,
+                                          KVLifecycleError)
 from deepspeed_tpu.serving.kv_quant import (normalize_kv_dtype,
                                             pool_payload,
                                             quantized_pool_like,
@@ -76,11 +79,11 @@ class BlockKVPool:
                  block_size: int = 16, num_blocks: int = None, dtype=None,
                  kv_dtype=None):
         if num_slots < 1:
-            raise ValueError(f"num_slots must be >= 1, got {num_slots}")
+            raise EngineConfigError(f"num_slots must be >= 1, got {num_slots}")
         if block_size < 1:
-            raise ValueError(f"block_size must be >= 1, got {block_size}")
+            raise EngineConfigError(f"block_size must be >= 1, got {block_size}")
         if max_len % block_size:
-            raise ValueError(
+            raise EngineConfigError(
                 f"max_len {max_len} must be a multiple of block_size "
                 f"{block_size} (the block table is fixed-width)")
         self.block_size = block_size
@@ -93,7 +96,7 @@ class BlockKVPool:
             # radix index caches on top lives in whatever is left over
             num_blocks = num_slots * self.max_blocks_per_slot
         if num_blocks < self.max_blocks_per_slot:
-            raise ValueError(
+            raise EngineConfigError(
                 f"num_blocks {num_blocks} below max_blocks_per_slot "
                 f"{self.max_blocks_per_slot}: a single full-length request "
                 f"could never be admitted")
@@ -165,13 +168,13 @@ class BlockKVPool:
 
     def alloc_block(self) -> int:
         if not self._free:
-            raise RuntimeError("block pool exhausted (admission should have "
+            raise EngineInvariantError("block pool exhausted (admission should have "
                                "evicted or deferred — this is a bug)")
         return self._free.pop()
 
     def free_block(self, block: int) -> None:
         if self.ref[block] != 0:
-            raise ValueError(
+            raise KVLifecycleError(
                 f"freeing block {block} with refcount {self.ref[block]} "
                 f"(still pinned by a running slot)")
         self._free.append(block)
@@ -181,7 +184,7 @@ class BlockKVPool:
 
     def unpin(self, block: int) -> None:
         if self.ref[block] <= 0:
-            raise ValueError(f"unpin of unpinned block {block}")
+            raise KVLifecycleError(f"unpin of unpinned block {block}")
         self.ref[block] -= 1
 
     # ------------------------------------------------------------ sizing

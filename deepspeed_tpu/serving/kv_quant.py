@@ -58,6 +58,8 @@ from typing import Optional, Tuple
 import jax
 import jax.numpy as jnp
 
+from deepspeed_tpu.serving.errors import EngineConfigError
+
 # storage dtype + symmetric quantization ceiling per kv_dtype name
 _KV_DTYPES = {
     "int8": (jnp.int8, 127.0),
@@ -84,7 +86,7 @@ def normalize_kv_dtype(kv_dtype) -> Optional[str]:
         return "int8"
     if kv_dtype in ("fp8", "float8", "float8_e4m3", "float8_e4m3fn"):
         return "fp8"
-    raise ValueError(
+    raise EngineConfigError(
         f"kv_dtype must be one of None/'bf16'/'int8'/'fp8', got "
         f"{kv_dtype!r}")
 

@@ -29,6 +29,8 @@ from typing import Tuple
 
 import jax.numpy as jnp
 
+from deepspeed_tpu.serving.errors import EngineConfigError
+
 
 class SlotKVCache:
     """Owns the persistent slot-paged cache arrays + per-slot lengths.
@@ -41,7 +43,7 @@ class SlotKVCache:
 
     def __init__(self, model, num_slots: int, max_len: int, dtype=None):
         if num_slots < 1:
-            raise ValueError(f"num_slots must be >= 1, got {num_slots}")
+            raise EngineConfigError(f"num_slots must be >= 1, got {num_slots}")
         base = model.init_cache(num_slots, max_len, dtype=dtype)
         self.k = base["k"]
         self.v = base["v"]

@@ -36,6 +36,8 @@ from __future__ import annotations
 
 from typing import Dict, List, Optional, Sequence, Tuple
 
+from deepspeed_tpu.serving.errors import (EngineInvariantError,
+                                          KVLifecycleError)
 from deepspeed_tpu.serving.kv_blocks import BlockKVPool
 
 
@@ -160,11 +162,11 @@ class PrefixCache:
         Errors on a pinned block (a running slot still names it) or an
         interior node (its children's KV depends on its context)."""
         if node.children:
-            raise ValueError(
+            raise KVLifecycleError(
                 f"evicting interior radix node {node!r}: its children's "
                 f"cached KV is only valid beneath it")
         if self.pool.ref[node.block] != 0:
-            raise ValueError(
+            raise KVLifecycleError(
                 f"evicting pinned block {node.block} "
                 f"(refcount {self.pool.ref[node.block]})")
         del node.parent.children[node.key]
@@ -182,7 +184,7 @@ class PrefixCache:
             victims = sorted(self._iter_evictable(),
                              key=lambda nd: nd.last_used)
             if not victims:
-                raise RuntimeError(
+                raise EngineInvariantError(
                     f"need {n_needed} blocks, have {self.pool.free_count} "
                     f"free and nothing evictable (admission gating bug)")
             self.evict_node(victims[0])

@@ -30,6 +30,8 @@ import dataclasses
 from collections import deque
 from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
+from deepspeed_tpu.serving.errors import EngineConfigError
+
 
 @dataclasses.dataclass
 class Request:
@@ -477,7 +479,7 @@ def straggler_trace(rng, n_requests: int, *, rate: float,
     preemption + chunked-prefill stressor. Poisson arrivals at ``rate``
     like :func:`poisson_trace`."""
     if straggler_every < 1:
-        raise ValueError(f"straggler_every must be >= 1, "
+        raise EngineConfigError(f"straggler_every must be >= 1, "
                          f"got {straggler_every}")
     reqs: List[Request] = []
     t = 0.0

@@ -66,6 +66,8 @@ import jax.numpy as jnp
 import numpy as np
 
 from deepspeed_tpu.inference.engine import filter_logits
+from deepspeed_tpu.serving.errors import (EngineConfigError,
+                                          EngineTypeError)
 
 
 # --------------------------------------------------------------- config
@@ -104,17 +106,17 @@ class SpeculativeConfig:
 
     def __post_init__(self):
         if self.mode not in ("ngram", "draft"):
-            raise ValueError(f"speculative mode must be 'ngram' or "
+            raise EngineConfigError(f"speculative mode must be 'ngram' or "
                              f"'draft', got {self.mode!r}")
         self.k_buckets = tuple(sorted({int(k) for k in self.k_buckets}))
         if not self.k_buckets or self.k_buckets[0] < 1:
-            raise ValueError(f"k_buckets must be >= 1: {self.k_buckets}")
+            raise EngineConfigError(f"k_buckets must be >= 1: {self.k_buckets}")
         if self.mode == "draft" and self.draft_engine is None:
-            raise ValueError("speculative mode 'draft' needs a "
+            raise EngineConfigError("speculative mode 'draft' needs a "
                              "draft_engine (an InferenceEngine over the "
                              "draft model)")
         if not (self.min_ngram >= 1 and self.max_ngram >= self.min_ngram):
-            raise ValueError(f"need 1 <= min_ngram <= max_ngram, got "
+            raise EngineConfigError(f"need 1 <= min_ngram <= max_ngram, got "
                              f"{self.min_ngram}..{self.max_ngram}")
 
     @property
@@ -133,8 +135,8 @@ def normalize_speculative(spec) -> Optional[SpeculativeConfig]:
         return SpeculativeConfig(mode=spec)
     if isinstance(spec, dict):
         return SpeculativeConfig(**spec)
-    raise TypeError(f"speculative= takes None/'off'/mode str/dict/"
-                    f"SpeculativeConfig, got {type(spec).__name__}")
+    raise EngineTypeError(f"speculative= takes None/'off'/mode str/dict/"
+                          f"SpeculativeConfig, got {type(spec).__name__}")
 
 
 def pick_k_bucket(k: int, k_buckets: Sequence[int]) -> int:
@@ -305,7 +307,7 @@ class DraftModelDrafter:
         model_max = getattr(mcfg, "max_seq_len", None)
         need = self.window + config.k_max
         if model_max is not None and need > model_max:
-            raise ValueError(
+            raise EngineConfigError(
                 f"draft_window {self.window} + k_max {config.k_max} "
                 f"exceeds the draft model's max_seq_len {model_max}")
         self._programs = {}
@@ -328,7 +330,7 @@ class DraftModelDrafter:
             wlen[i] = len(tail)
         out = self._program(kb)(self.engine.params, jnp.asarray(ids),
                                 jnp.asarray(wlen))
-        drafts = np.asarray(jax.device_get(out))                # [B, kb]
+        drafts = np.asarray(jax.device_get(out))                # [B, kb]  # dstpu-lint: fence=draft tokens feed the host-side verify batch assembly
         lens = np.minimum(np.asarray(want, np.int32), kb)
         lens = np.where([h is not None for h in histories], lens, 0)
         return drafts.astype(np.int32), lens.astype(np.int32)

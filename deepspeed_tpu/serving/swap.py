@@ -25,7 +25,9 @@ from typing import Dict, Optional, Tuple
 
 import numpy as np
 
-from deepspeed_tpu.serving.errors import SwapCapacityError
+from deepspeed_tpu.serving.errors import (EngineConfigError,
+                                          KVLifecycleError,
+                                          SwapCapacityError)
 from deepspeed_tpu.serving.kv_quant import tree_nbytes
 
 
@@ -49,7 +51,7 @@ class HostSwapBuffer:
 
     def __init__(self, max_bytes: Optional[int] = None):
         if max_bytes is not None and max_bytes <= 0:
-            raise ValueError(f"swap max_bytes must be positive or None, "
+            raise EngineConfigError(f"swap max_bytes must be positive or None, "
                              f"got {max_bytes}")
         self.max_bytes = max_bytes
         self._entries: Dict[int, Tuple[np.ndarray, np.ndarray]] = {}
@@ -70,7 +72,7 @@ class HostSwapBuffer:
         quantized pools' ``{"q", "s"}`` payload+scale trees (ISSUE 12),
         whose int8/fp8 payloads halve the bytes parked per block."""
         if rid in self._entries:
-            raise ValueError(
+            raise KVLifecycleError(
                 f"request {rid} is already swapped out (double preemption "
                 f"without a resume)")
         nbytes = tree_nbytes(k) + tree_nbytes(v)

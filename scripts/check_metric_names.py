@@ -7,7 +7,11 @@ Usage:
 PR 3's contract is that every counter/gauge/histogram/event the code
 emits is documented in the README (operators grep the README, not the
 source), and PRs 4-11 each grew the namespace — by hand, in both
-places. This lint (ISSUE 11 satellite) makes the contract mechanical:
+places. This lint (ISSUE 11 satellite) makes the contract mechanical;
+since ISSUE 14 the collection/matching logic lives in the shared
+static-analysis framework as the ``metric-names`` pass
+(deepspeed_tpu/analysis/passes/metric_names.py) and this script is a
+thin CLI shim over it — same flags, same output, same exit codes:
 
   * CODE side: an AST walk over ``deepspeed_tpu/`` collects the first
     string argument of every ``counter(...)``, ``gauge(...)``,
@@ -24,99 +28,23 @@ Failure modes (exit 1, both listed):
   * STALE       — documented in the README, emitted by nothing.
 
 Wired into tier-1 via tests/unit/telemetry/test_spans.py and
-scripts/run_tier1.sh. Stdlib only.
+scripts/run_tier1.sh (through dstpu_lint.py). No longer stdlib-only:
+importing the framework pass pulls in the deepspeed_tpu package (and
+jax) — run with JAX_PLATFORMS=cpu where no accelerator is configured.
 """
 
 from __future__ import annotations
 
 import argparse
-import ast
-import fnmatch
 import os
-import re
 import sys
 
-PREFIXES = ("train", "serving", "fabric", "resilience", "device",
-            "checkpoint", "elastic", "slo", "telemetry")
-_NAME_RE = re.compile(
-    r"^(?:%s)/[A-Za-z0-9_][A-Za-z0-9_/<>*-]*$" % "|".join(PREFIXES))
-# methods whose first string argument is a metric/event name
-_METHODS = {"counter", "gauge", "histogram", "event", "record_event",
-            "_count", "_gauge", "_observe"}
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+from deepspeed_tpu.analysis.passes.metric_names import (  # noqa: E402
+    _covered, code_names, drift, readme_names)
 
-def _pattern_of(node) -> str | None:
-    """Metric-name pattern of a str/f-string AST node (formatted pieces
-    become '*'), or None for non-strings."""
-    if isinstance(node, ast.Constant) and isinstance(node.value, str):
-        return node.value
-    if isinstance(node, ast.JoinedStr):
-        parts = []
-        for v in node.values:
-            if isinstance(v, ast.Constant) and isinstance(v.value, str):
-                parts.append(v.value)
-            else:
-                parts.append("*")
-        return "".join(parts)
-    return None
-
-
-def code_names(root: str) -> dict:
-    """{pattern: [file:line, ...]} over every telemetry call site."""
-    out: dict = {}
-    for dirpath, dirnames, filenames in os.walk(root):
-        dirnames[:] = [d for d in dirnames if d != "__pycache__"]
-        for fn in filenames:
-            if not fn.endswith(".py"):
-                continue
-            path = os.path.join(dirpath, fn)
-            try:
-                with open(path, "r", encoding="utf-8") as f:
-                    tree = ast.parse(f.read(), filename=path)
-            except SyntaxError:
-                continue
-            for node in ast.walk(tree):
-                if not isinstance(node, ast.Call) or not node.args:
-                    continue
-                func = node.func
-                name = (func.attr if isinstance(func, ast.Attribute)
-                        else func.id if isinstance(func, ast.Name)
-                        else None)
-                if name not in _METHODS:
-                    continue
-                pat = _pattern_of(node.args[0])
-                if pat is None or not _NAME_RE.match(pat):
-                    continue
-                out.setdefault(pat, []).append(
-                    f"{os.path.relpath(path, os.path.dirname(root))}:"
-                    f"{node.lineno}")
-    return out
-
-
-def readme_names(readme_path: str) -> dict:
-    """{pattern: [line_no, ...]} over backticked metric-like tokens,
-    ``<placeholder>`` segments normalized to ``*``."""
-    out: dict = {}
-    with open(readme_path, "r", encoding="utf-8") as f:
-        for i, line in enumerate(f, 1):
-            for tok in re.findall(r"`([^`]+)`", line):
-                if not _NAME_RE.match(tok):
-                    continue
-                pat = re.sub(r"<[^>]*>", "*", tok)
-                out.setdefault(pat, []).append(i)
-    return out
-
-
-def _covered(name: str, patterns) -> bool:
-    """A name (possibly itself a wildcard pattern) is covered when any
-    pattern on the other side matches it — either direction, so
-    ``serving/ttft_ms/p*`` (code f-string) pairs with
-    ``serving/ttft_ms/p<class>`` (doc placeholder)."""
-    for p in patterns:
-        if p == name or fnmatch.fnmatchcase(name, p) \
-                or fnmatch.fnmatchcase(p, name):
-            return True
-    return False
+__all__ = ["code_names", "readme_names", "_covered", "main"]
 
 
 def main(argv=None) -> int:
@@ -137,10 +65,7 @@ def main(argv=None) -> int:
         print("== README ==")
         for n in sorted(docs):
             print(f"  {n}  (line {docs[n][0]})")
-    undocumented = {n: sites for n, sites in code.items()
-                    if not _covered(n, docs)}
-    stale = {n: lines for n, lines in docs.items()
-             if not _covered(n, code)}
+    undocumented, stale = drift(code, docs)
     rc = 0
     if undocumented:
         rc = 1

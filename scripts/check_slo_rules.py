@@ -13,7 +13,11 @@ bad fraction caps at 1.0, so such a rule looks armed but is dead).
 
 With no arguments the built-in :data:`DEFAULT_SLO_CONFIG` is validated
 — the config every engine runs when none is supplied, so a bad default
-fails CI before it ships. Wired into scripts/run_tier1.sh.
+fails CI before it ships. Since ISSUE 14 that default-config check also
+runs as the ``slo-rules`` pass of the shared static-analysis framework
+(deepspeed_tpu/analysis/passes/slo_rules.py, via scripts/dstpu_lint.py
+in run_tier1.sh); this CLI stays for validating arbitrary config FILES
+and its exit-code contract is pinned by tests.
 
 Exit status: 0 = every config valid, 1 = problems (all listed), 2 =
 unreadable input.

@@ -56,14 +56,21 @@ grep -qs "def test_" tests/unit/serving/test_kv_quant.py || { echo "tier-1: kv-q
 # tests/unit/serving/test_slo_plane.py
 grep -qs "def test_" tests/unit/telemetry/test_slo_plane.py || { echo "tier-1: slo-plane tests missing"; exit 1; }
 grep -qs "def test_" tests/unit/serving/test_slo_plane.py || { echo "tier-1: slo-plane serving tests missing"; exit 1; }
-# metric-name drift lint (ISSUE 11 satellite): README metric/event
-# names must exactly cover the counter/gauge/histogram/record_event
-# call sites — fails on undocumented or stale names
-python scripts/check_metric_names.py || { echo "tier-1: metric-name drift"; exit 1; }
-# SLO/alert-rule config lint (ISSUE 13 satellite): the built-in
-# DEFAULT_SLO_CONFIG must validate — unknown SLI names, malformed
-# windows and never-firing burn thresholds are typed errors
-JAX_PLATFORMS=cpu python scripts/check_slo_rules.py || { echo "tier-1: slo config invalid"; exit 1; }
+# likewise the static-analysis suite (marker `lint`): each dstpu-lint
+# pass catches its seeded fixture violation and stays silent on the
+# good twin, suppression/baseline round-trips, and the repo-clean
+# end-to-end pin ride `-m 'not slow'` through tests/unit/analysis/
+grep -qs "def test_" tests/unit/analysis/test_lint.py || { echo "tier-1: lint tests missing"; exit 1; }
+# dstpu-lint (ISSUE 14): machine-enforce the static contracts — zero
+# unsuppressed findings across host-sync (a reintroduced hot-path
+# device_get fails here), recompile-hazard (unbucketed jit keys),
+# typed-error (bare raises in serving/), jax-compat (direct
+# version-gated imports), donation-safety, metric-names (ISSUE 11
+# satellite, migrated: README drift), and slo-rules (ISSUE 13
+# satellite, migrated: DEFAULT_SLO_CONFIG validity). Exit codes:
+# 1 findings / 2 usage / 3 internal. The committed LINT_BASELINE.json
+# budget is the growth guard: the baseline only burns down.
+JAX_PLATFORMS=cpu python scripts/dstpu_lint.py || { echo "tier-1: dstpu-lint findings"; exit 1; }
 # bench-trajectory smoke (ISSUE 13 satellite): the markdown trend
 # report must render over the checked-in BENCH_r*.json round files
 python scripts/bench_trajectory.py --markdown > /dev/null || { echo "tier-1: bench trajectory markdown"; exit 1; }

@@ -303,7 +303,8 @@ _LATE_MODULES = _OBSERVABILITY_MODULES + (
     "unit/serving/test_tracing",
     "unit/serving/test_kv_quant",
     "unit/telemetry/test_slo_plane",
-    "unit/serving/test_slo_plane",)
+    "unit/serving/test_slo_plane",
+    "unit/analysis/",)
 
 
 def pytest_collection_modifyitems(config, items):

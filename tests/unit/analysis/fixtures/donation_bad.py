@@ -1,0 +1,18 @@
+# lint fixture: read-after-donate — flagged by donation-safety.
+import jax
+
+
+def train_step(state, batch):
+    step = jax.jit(_step, donate_argnums=(0,))
+    new_state, loss = step(state, batch)
+    # BAD: `state` was donated to step(); its buffer may be reused
+    delta = state.params_norm() - new_state.params_norm()
+    return new_state, loss, delta
+
+
+class Engine:
+    def apply(self, grads):
+        self._apply = jax.jit(_apply, donate_argnums=(0, 1))
+        out = self._apply(self.acc, grads)
+        # BAD: self.acc was donated (argnum 0) and read afterwards
+        return out, self.acc

@@ -1,0 +1,17 @@
+# lint fixture: the good twin — donated references are rebound by the
+# donating statement or never read again; donation-safety stays silent.
+import jax
+
+
+def train_step(state, batch):
+    step = jax.jit(_step, donate_argnums=(0,))
+    norm_before = state.params_norm()      # read BEFORE the donation
+    state, loss = step(state, batch)       # rebinds: taint never lands
+    return state, loss, norm_before
+
+
+class Engine:
+    def apply(self, grads):
+        self._apply = jax.jit(_apply, donate_argnums=(0,))
+        self.acc = self._apply(self.acc, grads)   # rebound same statement
+        return self.acc

@@ -1,0 +1,16 @@
+# lint fixture: every sync here must be flagged by the host-sync pass
+# (installed into a hot-path scope — deepspeed_tpu/serving/ — by the
+# test harness; never imported).
+import jax
+import numpy as np
+
+
+class Engine:
+    def step(self, toks):
+        out = self.program(self.cache.carry(), toks)
+        tok = int(jax.device_get(out[3]))          # BAD: device_get
+        jax.block_until_ready(self.state.params)   # BAD: block_until_ready
+        loss = self.metrics["loss"].item()         # BAD: .item()
+        norm = float(self.state.grad_norm)         # BAD: implicit cast sync
+        rows = np.asarray(self.cache.lengths)      # BAD: np.asarray on state
+        return tok, loss, norm, rows

@@ -1,0 +1,14 @@
+# lint fixture: direct uses of version-gated jax APIs — all flagged.
+import jax
+from jax.experimental.shard_map import shard_map
+
+
+def build(mesh, specs, f):
+    # BAD: check_rep was renamed check_vma; only the shim translates
+    fn = shard_map(f, mesh=mesh, in_specs=specs, out_specs=specs,
+                   check_rep=False)
+    # BAD: lax.pcast is absent on older jax
+    cast = jax.lax.pcast
+    # BAD: vma kwarg only exists on vma-typing jax
+    out = jax.ShapeDtypeStruct((1,), None, vma=frozenset())
+    return fn, cast, out

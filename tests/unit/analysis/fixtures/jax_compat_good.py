@@ -1,0 +1,11 @@
+# lint fixture: the good twin — everything routes through the shims;
+# jax-compat must stay silent.
+from deepspeed_tpu.utils.jax_compat import (has_vma_typing, pcast_varying,
+                                            shard_map)
+
+
+def build(mesh, specs, f, axis):
+    fn = shard_map(f, mesh=mesh, in_specs=specs, out_specs=specs,
+                   check_vma=has_vma_typing())
+    vary = lambda x: pcast_varying(x, (axis,))  # noqa: E731
+    return fn, vary

@@ -1,0 +1,18 @@
+# lint fixture: three recompile hazards, all must be flagged.
+import jax
+
+
+class Engine:
+    def prefill(self, prompt, x):
+        # BAD R1: immediate invocation — compiled object discarded
+        y = jax.jit(self.fwd)(x)
+        # BAD R3: cache key varies with raw length — compile per prompt
+        self._compiled[len(prompt)] = jax.jit(self.fwd)
+        return y
+
+    def warmup(self, xs):
+        fns = []
+        for x in xs:
+            # BAD R2: construction per loop iteration
+            fns.append(jax.jit(self.fwd))
+        return fns

@@ -1,0 +1,27 @@
+# lint fixture: the good twin — module-scope jit, keyed memoization on
+# a bucketed key, assign-then-call; recompile-hazard must stay silent.
+import jax
+
+_top = jax.jit(lambda x: x + 1)   # module scope: compiles once
+
+
+def _bucket_for(n, buckets):
+    return min(b for b in buckets if b >= n)
+
+
+class Engine:
+    def prefill(self, prompt, x):
+        bucket = _bucket_for(len(prompt), self.buckets)
+        if bucket not in self._compiled:
+            # keyed by BUCKET id: bounded compile set
+            self._compiled[bucket] = jax.jit(self.fwd)
+        return self._compiled[bucket](x)
+
+    def warmup(self, buckets):
+        for b in buckets:
+            # memoized into the keyed cache: the loop-construction idiom
+            self._compiled[b] = jax.jit(self.fwd)
+
+    def init(self, x):
+        cast = jax.jit(self.cast_fn)   # assigned, then called
+        return cast(x)

@@ -1,0 +1,17 @@
+# lint fixture: bare stdlib raises in a serving-scope file — all flagged.
+
+
+class Pool:
+    def __init__(self, num_slots):
+        if num_slots < 1:
+            raise ValueError(f"num_slots must be >= 1, got {num_slots}")
+
+    def alloc(self):
+        if not self.free:
+            raise RuntimeError("pool exhausted")
+
+    def configure(self, mode):
+        if mode not in ("a", "b"):
+            raise Exception("bad mode")
+        if not isinstance(mode, str):
+            raise TypeError("mode must be a str")

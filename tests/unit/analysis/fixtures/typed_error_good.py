@@ -1,0 +1,24 @@
+# lint fixture: the good twin — every raise uses the typed hierarchy
+# (or re-raises); typed-error must stay silent.
+from deepspeed_tpu.serving.errors import (EngineConfigError,
+                                          EngineInvariantError,
+                                          InvalidRequestError)
+
+
+class Pool:
+    def __init__(self, num_slots):
+        if num_slots < 1:
+            raise EngineConfigError(
+                f"num_slots must be >= 1, got {num_slots}")
+
+    def alloc(self):
+        if not self.free:
+            raise EngineInvariantError("pool exhausted past admission")
+
+    def submit(self, prompt):
+        if not prompt:
+            raise InvalidRequestError("empty prompt")
+        try:
+            return self.do(prompt)
+        except KeyError:
+            raise
