@@ -1,5 +1,19 @@
 """dstpu-lint — AST invariant checker for the repo's machine-enforceable
-contracts (ISSUE 14).
+contracts (ISSUE 14; corpus-level dataflow + Pallas/TPU passes:
+ISSUE 15 "dstpu-prove").
+
+ISSUE 15 upgraded the per-file scanner to a two-phase corpus analysis:
+phase 1 (:mod:`~deepspeed_tpu.analysis.index`) builds the module/symbol
+index, import-resolved call graph, and per-function donation/aliasing
+summaries; phase 2 passes receive the corpus through
+:meth:`LintPass.begin` and check interprocedural contracts — donated
+buffers followed through helpers (:mod:`~deepspeed_tpu.analysis.taint`
++ the ``sharding-contract`` pass), Pallas tile quanta and DMA pairing
+(``pallas-tile``/``pallas-dma``), and VMEM budgets shared with
+ops/autotune.py (``vmem-budget``).  Incremental runs
+(:mod:`~deepspeed_tpu.analysis.incremental`) cache per-file findings
+by content hash with dependent-region invalidation;
+:mod:`~deepspeed_tpu.analysis.sarif` emits SARIF 2.1.0 for CI.
 
 Every perf/robustness win since PR 2 rests on invariants the test suite
 can only probe dynamically and per-site: zero recompiles after warmup,

@@ -94,6 +94,16 @@ class Finding:
                 "symbol": self.symbol, "message": self.message,
                 "suggestion": self.suggestion}
 
+    @classmethod
+    def from_json(cls, d: dict) -> "Finding":
+        """Inverse of :meth:`to_json` (the incremental cache round-trips
+        findings through JSON; the pair is pinned by a test)."""
+        return cls(pass_id=d["pass"], path=d["path"], line=int(d["line"]),
+                   col=int(d["col"]), message=d["message"],
+                   severity=d.get("severity", "error"),
+                   symbol=d.get("symbol", ""),
+                   suggestion=d.get("suggestion", ""))
+
 
 # -------------------------------------------------------------- directives
 @dataclass
@@ -265,7 +275,11 @@ class Corpus:
 class LintPass:
     """Base pass. Subclasses set ``id``/``title``/``scope`` and override
     :meth:`check_file` (per-file) and/or :meth:`finalize` (whole corpus,
-    runs after every file was visited)."""
+    runs after every file was visited).  Passes that need phase-1
+    interprocedural context (ISSUE 15) override :meth:`begin`, which
+    runs once per lint with the assembled corpus BEFORE any file is
+    visited — the place to grab the shared
+    :func:`~deepspeed_tpu.analysis.index.ensure_index`."""
 
     id: str = ""
     title: str = ""
@@ -281,6 +295,10 @@ class LintPass:
             return True
         return any(relpath == s or relpath.startswith(s)
                    for s in self.scope)
+
+    def begin(self, corpus: Corpus) -> None:
+        """Phase-1 hook: runs once with the whole corpus before any
+        :meth:`check_file` call (build/borrow the shared index here)."""
 
     def check_file(self, ctx: FileContext) -> Iterable[Finding]:
         return ()
@@ -444,13 +462,23 @@ def run_lint(root: str, *, pass_ids: Optional[Sequence[str]] = None,
              baseline: Optional[Baseline] = None,
              subdirs: Sequence[str] = ("deepspeed_tpu",),
              report_unused_directives: Optional[bool] = None,
-             corpus: Optional[Corpus] = None) -> LintResult:
+             corpus: Optional[Corpus] = None,
+             file_cache=None) -> LintResult:
     """Run the registered passes over ``root`` and fold in suppressions
     and the baseline.  ``pass_ids=None`` runs every registered pass;
     unused-directive reporting defaults to on only for full runs (a
     directive for a pass that was not selected is not stale).  Pass a
     pre-built ``corpus`` to reuse already-parsed files (the CLI shares
     one corpus between the lint and the jax-compat inventory).
+
+    ``file_cache`` (incremental mode, ISSUE 15): any object with
+    ``lookup(ctx) -> Optional[List[Finding]]`` and ``store(ctx,
+    findings)``.  A hit replaces the per-file pass execution for that
+    file; finalize passes, directive folding and the baseline always
+    run fresh, so a cached and a cold run report identical findings by
+    construction (pinned by test).  The cache provider is responsible
+    for invalidating entries whose INTERPROCEDURAL inputs changed (see
+    :mod:`deepspeed_tpu.analysis.incremental`).
     """
     all_passes = load_passes()
     if pass_ids is None:
@@ -467,6 +495,8 @@ def run_lint(root: str, *, pass_ids: Optional[Sequence[str]] = None,
 
     if corpus is None:
         corpus = build_corpus(root, subdirs)
+    for p in selected:
+        p.begin(corpus)
     raw: List[Finding] = []
     for ctx in corpus.files:
         for fnd in ctx.directive_errors:
@@ -475,9 +505,17 @@ def run_lint(root: str, *, pass_ids: Optional[Sequence[str]] = None,
             raw.append(Finding("lint-parse", ctx.relpath, 1, 0,
                                f"file does not parse: {ctx.parse_error}"))
             continue
+        cached = file_cache.lookup(ctx) if file_cache is not None else None
+        if cached is not None:
+            raw.extend(cached)
+            continue
+        file_findings: List[Finding] = []
         for p in selected:
             if p.in_scope(ctx.relpath):
-                raw.extend(p.check_file(ctx))
+                file_findings.extend(p.check_file(ctx))
+        if file_cache is not None:
+            file_cache.store(ctx, file_findings)
+        raw.extend(file_findings)
     for p in selected:
         raw.extend(p.finalize(corpus))
 

@@ -1,0 +1,203 @@
+"""Incremental lint: per-file finding cache keyed on content hashes
+(ISSUE 15).
+
+Full-corpus and incremental runs MUST report byte-identical findings
+(pinned by test).  The mechanism:
+
+  * the cache (``.dstpu_lint_cache.json`` at the repo root, gitignored)
+    stores, per file, the sha256 of its source and the per-file
+    findings the passes produced for it — plus a header binding the
+    cache to the PASS SET and a fingerprint of the analysis sources
+    themselves (editing a pass invalidates every entry, so a stale
+    cache can never mask a lint change);
+  * on an incremental run, files whose hash matches reuse their cached
+    findings and skip per-file pass execution.  Finalize passes,
+    suppression folding and the baseline always run fresh;
+  * **interprocedural invalidation**: a cached file's findings may
+    depend on ANOTHER file's function summaries (the sharding-contract
+    pass follows donations through the call graph).  Changed files
+    therefore invalidate their whole dependent region — the reverse
+    import closure from the phase-1 index, a conservative superset of
+    the changed files' strongly-connected call-graph region — and the
+    corpus-global inputs in ``GLOBAL_INPUTS`` (the axis registry and
+    the VMEM capacity table's home, ``ops/autotune.py``) invalidate
+    everything.  The kernel-plan ARTIFACT (AUTOTUNE_KERNELS_MEASURED
+    .json) needs no cache edge only because it is consumed exclusively
+    in ``finalize()``, which always runs fresh — a per-file pass that
+    reads it must add it here first.
+
+``scripts/dstpu_lint.py --changed-only`` wires this up.  ``git diff
+--name-only`` (plus untracked files) feeds the CLI's changed-set
+diagnostics and degrades gracefully to a hash-only run when git is
+unavailable; the content hashes are ALWAYS the invalidation authority
+— git is never trusted over content in either direction.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import subprocess
+from typing import Dict, List, Optional, Set
+
+from deepspeed_tpu.analysis.core import Corpus, Finding
+from deepspeed_tpu.analysis.index import ensure_index
+
+CACHE_VERSION = 1
+DEFAULT_CACHE_NAME = ".dstpu_lint_cache.json"
+
+#: corpus-global lint inputs: a change here can move findings in ANY
+#: file, so it invalidates the whole cache
+GLOBAL_INPUTS = (
+    "deepspeed_tpu/parallel/topology.py",    # sharding axis registry
+    # vmem-budget parses its capacity table (DEFAULT_VMEM_MB /
+    # SCOPED_VMEM_MAX_MB) from this file but applies it to KERNEL files
+    # that never import it — no import edge reaches them, so a budget
+    # change must drop everything
+    "deepspeed_tpu/ops/autotune.py",
+)
+
+
+def source_digest(text: str) -> str:
+    return hashlib.sha256(text.encode("utf-8")).hexdigest()
+
+
+def lint_fingerprint(root: str) -> str:
+    """Digest of the analysis framework itself (passes included) and
+    the CLI — cached findings are only as current as the code that
+    produced them."""
+    h = hashlib.sha256()
+    paths: List[str] = []
+    adir = os.path.join(root, "deepspeed_tpu", "analysis")
+    for dirpath, dirnames, filenames in os.walk(adir):
+        dirnames[:] = sorted(d for d in dirnames if d != "__pycache__")
+        for fn in sorted(filenames):
+            if fn.endswith(".py"):
+                paths.append(os.path.join(dirpath, fn))
+    paths.append(os.path.join(root, "scripts", "dstpu_lint.py"))
+    for p in paths:
+        try:
+            with open(p, "rb") as f:
+                h.update(p.encode())
+                h.update(f.read())
+        except OSError:
+            continue
+    return h.hexdigest()
+
+
+class LintCache:
+    """Per-file finding cache.  ``prepare`` must run before the lint
+    (it drops every entry the current tree invalidates); ``lookup`` /
+    ``store`` are the :func:`~deepspeed_tpu.analysis.core.run_lint`
+    ``file_cache`` protocol."""
+
+    def __init__(self, path: str, fingerprint: str,
+                 pass_ids: Optional[List[str]] = None):
+        self.path = path
+        self.fingerprint = fingerprint
+        self.pass_ids = sorted(pass_ids) if pass_ids is not None else None
+        self.entries: Dict[str, dict] = {}
+        self._digests: Dict[str, str] = {}   # relpath -> sha256 (prepare)
+        self.hits = 0
+        self.misses = 0
+
+    # ------------------------------------------------------------- io
+    @classmethod
+    def load(cls, path: str, root: str,
+             pass_ids: Optional[List[str]] = None) -> "LintCache":
+        cache = cls(path, lint_fingerprint(root), pass_ids)
+        try:
+            with open(path, "r", encoding="utf-8") as f:
+                raw = json.load(f)
+            if (isinstance(raw, dict)
+                    and raw.get("version") == CACHE_VERSION
+                    and raw.get("fingerprint") == cache.fingerprint
+                    and raw.get("passes") == cache.pass_ids
+                    and isinstance(raw.get("files"), dict)):
+                cache.entries = raw["files"]
+        except (OSError, ValueError):
+            pass     # cold cache
+        return cache
+
+    def save(self) -> None:
+        try:
+            with open(self.path, "w", encoding="utf-8") as f:
+                json.dump({"version": CACHE_VERSION,
+                           "fingerprint": self.fingerprint,
+                           "passes": self.pass_ids,
+                           "files": self.entries}, f, sort_keys=True)
+                f.write("\n")
+        except OSError:
+            pass     # cache is an accelerator, never a failure mode
+
+    # ---------------------------------------------------- invalidation
+    def prepare(self, corpus: Corpus) -> Set[str]:
+        """Drop every entry the current tree invalidates; returns the
+        invalidated relpaths.  Content hashes are the sole authority
+        (``git diff`` feeds only the CLI's stderr diagnostics): a file
+        git reports touched whose content matches its cache entry
+        stays cached (worktree-vs-HEAD drift is the common case right
+        after a cache-populating run), and a change git cannot see
+        (non-git root) is still caught by its hash."""
+        changed: Set[str] = set()
+        self._digests = {ctx.relpath: source_digest(ctx.source)
+                         for ctx in corpus.files}
+        for relpath, digest in self._digests.items():
+            ent = self.entries.get(relpath)
+            if ent is None or ent.get("hash") != digest:
+                changed.add(relpath)
+        # deleted files leave stale entries; their importers must rescan
+        changed.update(set(self.entries) - set(self._digests))
+        if not changed:
+            return set()
+        if any(c in GLOBAL_INPUTS for c in changed):
+            region = set(self.entries)       # global input: drop all
+        else:
+            idx = ensure_index(corpus)
+            region = changed | idx.dependents_of(changed)
+        for relpath in region:
+            self.entries.pop(relpath, None)
+        return region
+
+    # ------------------------------------------------- run_lint hooks
+    def lookup(self, ctx) -> Optional[List[Finding]]:
+        ent = self.entries.get(ctx.relpath)
+        digest = self._digests.get(ctx.relpath) \
+            or source_digest(ctx.source)
+        if ent is None or ent.get("hash") != digest:
+            self.misses += 1
+            return None
+        self.hits += 1
+        return [Finding.from_json(d) for d in ent.get("findings", ())]
+
+    def store(self, ctx, findings: List[Finding]) -> None:
+        self.entries[ctx.relpath] = {
+            "hash": self._digests.get(ctx.relpath)
+            or source_digest(ctx.source),
+            "findings": [f.to_json() for f in findings]}
+
+
+def git_changed_files(root: str) -> Optional[Set[str]]:
+    """Repo-relative changed + untracked files per git, or None when
+    git is unavailable (callers fall back to hash-only / full runs)."""
+    try:
+        diff = subprocess.run(
+            ["git", "diff", "--name-only", "HEAD", "--"], cwd=root,
+            capture_output=True, text=True, timeout=30)
+        if diff.returncode != 0:
+            return None
+        untracked = subprocess.run(
+            ["git", "ls-files", "--others", "--exclude-standard"],
+            cwd=root, capture_output=True, text=True, timeout=30)
+        if untracked.returncode != 0:
+            return None
+    except (OSError, subprocess.SubprocessError):
+        return None
+    # one path per LINE (paths may contain spaces); git quotes unusual
+    # paths with surrounding double quotes — strip them so the .py
+    # suffix test still applies
+    names = {line.strip().strip('"')
+             for out in (diff.stdout, untracked.stdout)
+             for line in out.splitlines() if line.strip()}
+    return {n for n in names if n.endswith(".py")}

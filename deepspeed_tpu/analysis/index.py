@@ -1,0 +1,613 @@
+"""Phase-1 corpus index: modules, imports, call graph, summaries (ISSUE 15).
+
+PR 13's passes are single-file AST scans — none can see a donated buffer
+flow into a helper, and none knows that ``self._compiled_train_step``
+(bound in one method) donates its first argument when called from
+another.  This module is the corpus-level upgrade the interprocedural
+passes run on:
+
+  * **ModuleTable** — relpath ↔ dotted module name, top-level symbols
+    (functions, classes, methods), per-module import map (local name →
+    fully-qualified target, relative imports resolved);
+  * **FunctionSummary** — per function/method: positional params,
+    ``donates`` (param positions handed to XLA for in-place reuse when
+    the function is called — directly via a local ``jax.jit(...,
+    donate_argnums=...)`` bind, via a donating callable stored on
+    ``self`` in ANY method of the same class, via a module-level
+    donating callable, or TRANSITIVELY via a call to another summarized
+    function), ``returns_args`` (params returned directly —
+    returns-alias-of-arg), and resolved call edges;
+  * **call graph** — edges resolved through the import maps and class
+    method tables, plus Tarjan SCCs (summary soundness over mutual
+    recursion is pinned through them; the incremental cache invalidates
+    the reverse IMPORT closure, a conservative file-level superset of
+    any changed SCC region);
+  * **import graph** — module → imported modules (repo-internal), with
+    a reverse closure used by the incremental cache to invalidate every
+    file whose findings could depend on a changed file's summaries.
+
+Everything here is pure-AST and stdlib-only (the lint must run without
+jax installed, and must never import the code it analyzes).  Resolution
+is CONSERVATIVE: an unresolvable callee is simply an absent edge —
+passes built on the index can miss, never hallucinate.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+from typing import Dict, FrozenSet, List, Optional, Set, Tuple
+
+# package root all corpus modules live under; imports outside it are
+# third-party and never indexed
+_PKG = "deepspeed_tpu"
+
+
+def attr_chain(node: ast.AST) -> str:
+    """Dotted name of an Attribute/Name chain ('' when the chain roots
+    in a call or subscript).  CANONICAL home: passes/_ast_util.py and
+    taint.py re-export from here (this module cannot import the passes
+    package — its __init__ imports the passes, which import this
+    index)."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return ""
+
+
+def module_name(relpath: str) -> str:
+    """Dotted module name of a repo-relative path
+    (``deepspeed_tpu/ops/decode_step.py`` → ``deepspeed_tpu.ops.
+    decode_step``; package ``__init__.py`` maps to the package)."""
+    mod = relpath[:-3] if relpath.endswith(".py") else relpath
+    mod = mod.replace("/", ".")
+    if mod.endswith(".__init__"):
+        mod = mod[: -len(".__init__")]
+    return mod
+
+
+def donated_positions(call: ast.Call) -> Tuple[int, ...]:
+    """Constant ``donate_argnums`` of a jit/pjit construction (``()``
+    when absent or non-constant — conservative).  Canonical home —
+    taint.py re-exports from here."""
+    for kw in call.keywords:
+        if kw.arg != "donate_argnums":
+            continue
+        v = kw.value
+        if isinstance(v, ast.Constant) and isinstance(v.value, int):
+            return (v.value,)
+        if isinstance(v, (ast.Tuple, ast.List)):
+            out = []
+            for e in v.elts:
+                if isinstance(e, ast.Constant) and isinstance(e.value, int):
+                    out.append(e.value)
+            return tuple(out)
+    return ()
+
+
+def _walk_scope(fn: ast.AST):
+    """Own-scope walk for summary building: yields nested function/
+    class nodes themselves (they BIND a local name) but never descends
+    into them — a closure's body does not execute when the enclosing
+    function is called, so its donations/returns must not pollute the
+    enclosing summary (mirrors taint.walk_scope's scope rule)."""
+    for child in ast.iter_child_nodes(fn):
+        yield child
+        if not isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.Lambda, ast.ClassDef)):
+            yield from _walk_scope(child)
+
+
+def is_self_call(call: ast.Call) -> bool:
+    """``self.method(...)`` — the only Attribute-call form that is
+    provably BOUND (arg 0 is the first real param).  An unbound
+    class-attribute call like ``Engine.step(eng, state)`` passes
+    ``self`` explicitly, so its args line up with the params 1:1."""
+    f = call.func
+    return (isinstance(f, ast.Attribute) and isinstance(f.value, ast.Name)
+            and f.value.id == "self")
+
+
+def is_jit_call(node: ast.AST) -> bool:
+    """``jax.jit(...)`` / ``jit(...)`` / ``pjit(...)`` construction.
+    Canonical home — passes/_ast_util.py and taint.py re-export."""
+    if not isinstance(node, ast.Call):
+        return False
+    f = node.func
+    name = f.attr if isinstance(f, ast.Attribute) else (
+        f.id if isinstance(f, ast.Name) else "")
+    return name in ("jit", "pjit")
+
+
+@dataclass
+class CallEdge:
+    """One resolved call site inside a function."""
+
+    node: ast.Call
+    callee: str                      # FQN ("deepspeed_tpu.x.f" / ".C.m")
+    #: callee param position -> caller param position, for args that are
+    #: plain reads of the caller's own positional params
+    param_args: Dict[int, int] = field(default_factory=dict)
+
+
+@dataclass
+class FunctionSummary:
+    """What a caller needs to know about a function without its body."""
+
+    fqn: str                         # module + "." + qualname
+    module: str
+    qualname: str                    # "fn" or "Class.method"
+    relpath: str
+    node: ast.AST
+    params: Tuple[str, ...] = ()
+    #: param positions donated (directly or transitively) when called
+    donates: Set[int] = field(default_factory=set)
+    #: human-readable provenance per donated position (for findings)
+    donates_via: Dict[int, str] = field(default_factory=dict)
+    #: param positions returned directly (returns-alias-of-arg)
+    returns_args: Set[int] = field(default_factory=set)
+    calls: List[CallEdge] = field(default_factory=list)
+    #: every name bound in the function's own scope (params, assigns,
+    #: nested defs): a call through such a name must NOT resolve to a
+    #: same-named module-level function — local shadowing wins
+    local_binds: Set[str] = field(default_factory=set)
+
+    @property
+    def is_method(self) -> bool:
+        return "." in self.qualname
+
+
+@dataclass
+class ClassInfo:
+    fqn: str
+    module: str
+    name: str
+    relpath: str
+    methods: Dict[str, str] = field(default_factory=dict)  # name -> FQN
+    #: self-attribute -> (donated positions — the INTERSECTION across
+    #: every binding method, so only provably-donated-under-any-live-
+    #: bind positions survive, "bound in <method>[/..]", frozenset of
+    #: binding method names) for donating callables stored on the
+    #: instance in ANY method
+    donating_attrs: Dict[str, Tuple[Tuple[int, ...], str,
+                                    FrozenSet[str]]] = \
+        field(default_factory=dict)
+
+
+class CorpusIndex:
+    """The phase-1 artifact every interprocedural pass shares."""
+
+    def __init__(self) -> None:
+        self.modules: Dict[str, str] = {}          # module -> relpath
+        self.relpaths: Dict[str, str] = {}         # relpath -> module
+        self.imports: Dict[str, Dict[str, str]] = {}   # module -> local->FQN
+        self.functions: Dict[str, FunctionSummary] = {}    # FQN -> summary
+        self.classes: Dict[str, ClassInfo] = {}            # FQN -> class
+        #: module-level donating callables: FQN -> donated positions
+        self.donating_globals: Dict[str, Tuple[int, ...]] = {}
+        self.import_graph: Dict[str, Set[str]] = {}    # module -> imports
+
+    # ------------------------------------------------------------ build
+    @classmethod
+    def build(cls, corpus) -> "CorpusIndex":
+        idx = cls()
+        for ctx in corpus.files:
+            if ctx.tree is None:
+                continue
+            idx._index_file(ctx)
+        idx._resolve_calls()
+        idx._donation_fixpoint()
+        return idx
+
+    def _index_file(self, ctx) -> None:
+        mod = module_name(ctx.relpath)
+        self.modules[mod] = ctx.relpath
+        self.relpaths[ctx.relpath] = mod
+        imap: Dict[str, str] = {}
+        igraph: Set[str] = set()
+        # base package for relative imports: `module_name` strips
+        # `.__init__`, so a package __init__'s `mod` IS its package —
+        # level 1 anchors there, not one level higher
+        pkg_parts = mod.split(".") if ctx.relpath.endswith("__init__.py") \
+            else mod.split(".")[:-1]
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    local = a.asname or a.name.split(".")[0]
+                    target = a.name if a.asname else a.name.split(".")[0]
+                    imap[local] = target
+                    if a.name.startswith(_PKG):
+                        igraph.add(a.name)
+            elif isinstance(node, ast.ImportFrom):
+                base = node.module or ""
+                if node.level:      # relative: resolve against the package
+                    anchor = pkg_parts[: len(pkg_parts) - (node.level - 1)]
+                    base = ".".join(anchor + ([base] if base else []))
+                for a in node.names:
+                    if a.name == "*":
+                        continue
+                    imap[a.asname or a.name] = f"{base}.{a.name}" \
+                        if base else a.name
+                    if base.startswith(_PKG):
+                        igraph.add(base)
+        self.imports[mod] = imap
+        self.import_graph[mod] = igraph
+
+        for node in ctx.tree.body:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self._add_function(ctx, mod, node.name, node)
+            elif isinstance(node, ast.ClassDef):
+                cinfo = ClassInfo(fqn=f"{mod}.{node.name}", module=mod,
+                                  name=node.name, relpath=ctx.relpath)
+                self.classes[cinfo.fqn] = cinfo
+                # donating attrs FIRST: each method's summary scan
+                # consults them to mark params donated through
+                # `self.<attr>(param, ...)` calls
+                self._scan_donating_attrs(cinfo, node)
+                for item in node.body:
+                    if isinstance(item, (ast.FunctionDef,
+                                         ast.AsyncFunctionDef)):
+                        qual = f"{node.name}.{item.name}"
+                        self._add_function(ctx, mod, qual, item)
+                        cinfo.methods[item.name] = f"{mod}.{qual}"
+            elif isinstance(node, ast.Assign) and is_jit_call(node.value):
+                pos = donated_positions(node.value)
+                if pos:
+                    for tgt in node.targets:
+                        if isinstance(tgt, ast.Name):
+                            self.donating_globals[f"{mod}.{tgt.id}"] = pos
+
+    def _add_function(self, ctx, mod: str, qual: str, node) -> None:
+        params = tuple(a.arg for a in (node.args.posonlyargs
+                                       + node.args.args))
+        s = FunctionSummary(fqn=f"{mod}.{qual}", module=mod, qualname=qual,
+                            relpath=ctx.relpath, node=node, params=params)
+        self.functions[s.fqn] = s
+        self._scan_function(s)
+
+    def _scan_donating_attrs(self, cinfo: ClassInfo,
+                             cnode: ast.ClassDef) -> None:
+        """``self.X = jax.jit(..., donate_argnums=...)`` in any method of
+        the class registers ``X`` as a donating instance attribute —
+        the cross-method donation channel (bound in __init__, called in
+        step) the per-scope pass is blind to.  EVERY assign to the same
+        attribute participates: a rebind to a plain callable (or a
+        non-donating jit) contributes an empty position set, and only
+        positions EVERY live bind provably donates survive the
+        intersection — a maybe-donating attr goes silent rather than
+        hallucinating."""
+        binds: Dict[str, List[Tuple[str, Set[int]]]] = {}
+        for method in cnode.body:
+            if not isinstance(method, (ast.FunctionDef,
+                                       ast.AsyncFunctionDef)):
+                continue
+            for node in ast.walk(method):
+                if not isinstance(node, ast.Assign):
+                    continue
+                pos = set(donated_positions(node.value)) \
+                    if is_jit_call(node.value) else set()
+                for tgt in node.targets:
+                    if (isinstance(tgt, ast.Attribute)
+                            and isinstance(tgt.value, ast.Name)
+                            and tgt.value.id == "self"):
+                        binds.setdefault(tgt.attr, []).append(
+                            (method.name, pos))
+        for attr, evs in binds.items():
+            if not any(p for _, p in evs):
+                continue         # never donating: not a channel at all
+            inter = set.intersection(*(p for _, p in evs))
+            methods = frozenset(m for m, _ in evs)
+            where = "bound in " + "/".join(
+                f"{cinfo.name}.{m}" for m in sorted(methods))
+            cinfo.donating_attrs[attr] = (tuple(sorted(inter)), where,
+                                          methods)
+
+    # ----------------------------------------------- per-function scan
+    def _scan_function(self, s: FunctionSummary) -> None:
+        """Direct summary facts: local donating binds, donating calls on
+        own params, returns-alias-of-arg, raw call list (resolved
+        later, once every module is indexed)."""
+        param_pos = {p: i for i, p in enumerate(s.params)}
+        local_donors: Dict[str, Tuple[int, ...]] = {}
+        a = s.node.args
+        s.local_binds.update(s.params)
+        s.local_binds.update(x.arg for x in a.kwonlyargs)
+        for x in (a.vararg, a.kwarg):
+            if x is not None:
+                s.local_binds.add(x.arg)
+        for node in _walk_scope(s.node):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef)):
+                s.local_binds.add(node.name)
+            elif isinstance(node, ast.Name) \
+                    and isinstance(node.ctx, ast.Store):
+                s.local_binds.add(node.id)
+            if isinstance(node, ast.Assign) and is_jit_call(node.value):
+                pos = donated_positions(node.value)
+                if not pos:
+                    continue
+                for tgt in node.targets:
+                    if isinstance(tgt, ast.Name):
+                        local_donors[tgt.id] = pos
+            elif isinstance(node, ast.Return) and node.value is not None:
+                vals = node.value.elts \
+                    if isinstance(node.value, ast.Tuple) else [node.value]
+                for v in vals:
+                    if isinstance(v, ast.Name) and v.id in param_pos:
+                        s.returns_args.add(param_pos[v.id])
+        for node in _walk_scope(s.node):
+            if not isinstance(node, ast.Call):
+                continue
+            s.calls.append(CallEdge(node=node, callee=""))
+            # direct donation of own params through local / self-attr /
+            # (later) global donating callables
+            donor_pos: Tuple[int, ...] = ()
+            via = ""
+            f = node.func
+            if isinstance(f, ast.Name) and f.id in local_donors:
+                donor_pos = local_donors[f.id]
+                via = f"local jit bind `{f.id}`"
+            elif (isinstance(f, ast.Attribute)
+                  and isinstance(f.value, ast.Name)
+                  and f.value.id == "self" and s.is_method):
+                cls_fqn = f"{s.module}.{s.qualname.rsplit('.', 1)[0]}"
+                cinfo = self.classes.get(cls_fqn)
+                if cinfo and f.attr in cinfo.donating_attrs:
+                    donor_pos, where, _ = cinfo.donating_attrs[f.attr]
+                    via = f"donating callable `self.{f.attr}` ({where})"
+            for p in donor_pos:
+                if p < len(node.args):
+                    a = node.args[p]
+                    if isinstance(a, ast.Name) and a.id in param_pos:
+                        i = param_pos[a.id]
+                        s.donates.add(i)
+                        s.donates_via.setdefault(i, via)
+
+    # ------------------------------------------------------ resolution
+    def resolve_call(self, module: str, qualname: str,
+                     call: ast.Call) -> str:
+        """FQN of a call's target, or '' when it cannot be proven.
+        Handles plain names (locals to the module, imported names),
+        dotted module chains, and ``self.method(...)``."""
+        f = call.func
+        imap = self.imports.get(module, {})
+        if isinstance(f, ast.Name):
+            caller = self.functions.get(f"{module}.{qualname}")
+            if caller is not None and f.id in caller.local_binds:
+                return ""       # locally rebound name shadows the
+                                # module-level / imported target
+            if f.id in imap:
+                fqn = imap[f.id]
+                return fqn if (fqn in self.functions
+                               or fqn in self.donating_globals) else ""
+            for cand in (f"{module}.{f.id}",):
+                if cand in self.functions or cand in self.donating_globals:
+                    return cand
+            return ""
+        if isinstance(f, ast.Attribute):
+            if isinstance(f.value, ast.Name) and f.value.id == "self" \
+                    and "." in qualname:
+                cls_fqn = f"{module}.{qualname.rsplit('.', 1)[0]}"
+                cinfo = self.classes.get(cls_fqn)
+                if cinfo and f.attr in cinfo.methods:
+                    return cinfo.methods[f.attr]
+                return ""
+            chain = attr_chain(f)
+            if not chain:
+                return ""
+            root = chain.split(".")[0]
+            caller = self.functions.get(f"{module}.{qualname}")
+            if caller is not None and root in caller.local_binds:
+                return ""       # locally rebound root shadows the chain
+            if root in imap:
+                chain = imap[root] + chain[len(root):]
+            # the bare chain matches import-resolved targets; the
+            # module-prefixed one matches same-module `Class.method`
+            for cand in (chain, f"{module}.{chain}"):
+                if cand in self.functions or cand in self.donating_globals:
+                    return cand
+        return ""
+
+    def _resolve_calls(self) -> None:
+        for s in self.functions.values():
+            param_pos = {p: i for i, p in enumerate(s.params)}
+            for edge in s.calls:
+                edge.callee = self.resolve_call(s.module, s.qualname,
+                                                edge.node)
+                if not edge.callee:
+                    continue
+                callee = self.functions.get(edge.callee)
+                # a BOUND method call consumes the caller's args from
+                # param 1; unbound Class.method(obj, ...) does not
+                shift = 1 if (callee is not None and callee.is_method
+                              and is_self_call(edge.node)) else 0
+                for j, a in enumerate(edge.node.args):
+                    if isinstance(a, ast.Name) and a.id in param_pos:
+                        edge.param_args[j + shift] = param_pos[a.id]
+
+    def _donation_fixpoint(self) -> None:
+        """Propagate donation through the call graph: if f passes its
+        param i to g's donated position j, calling f donates i.  The
+        corpus call graph is small; a simple iterate-to-stable loop
+        (bounded by function count) beats SCC bookkeeping here."""
+        for _ in range(len(self.functions) + 1):
+            changed = False
+            for s in self.functions.values():
+                for edge in s.calls:
+                    target = self.functions.get(edge.callee)
+                    if target is not None:
+                        donated = target.donates
+                        via_map = target.donates_via
+                    elif edge.callee in self.donating_globals:
+                        donated = set(self.donating_globals[edge.callee])
+                        via_map = {}
+                    else:
+                        continue
+                    for j in donated:
+                        i = edge.param_args.get(j)
+                        if i is not None and i not in s.donates:
+                            s.donates.add(i)
+                            tail = via_map.get(j, "")
+                            s.donates_via[i] = (
+                                f"call to `{edge.callee}`"
+                                + (f" ({tail})" if tail else ""))
+                            changed = True
+            if not changed:
+                break
+
+    # --------------------------------------------------------- queries
+    def summary_for_call(self, module: str, qualname: str, call: ast.Call,
+                         ) -> Tuple[Tuple[int, ...], str]:
+        """(donated positions IN CALL-ARG numbering, provenance) for a
+        call site — the phase-2 entry point.  Method calls have the
+        implicit ``self`` stripped, so positions index ``call.args``."""
+        f = call.func
+        if isinstance(f, ast.Attribute) and isinstance(f.value, ast.Name) \
+                and f.value.id == "self" and "." in qualname:
+            # donating callable stored on the instance (bound in another
+            # method, typically __init__) — donate_argnums index the
+            # jitted function's args, i.e. this call's args directly
+            cls_fqn = f"{module}.{qualname.rsplit('.', 1)[0]}"
+            cinfo = self.classes.get(cls_fqn)
+            if cinfo and f.attr in cinfo.donating_attrs:
+                pos, where, bound_in = cinfo.donating_attrs[f.attr]
+                if qualname.rsplit(".", 1)[-1] in bound_in or not pos:
+                    # bound in THIS very method (even if ALSO rebound
+                    # elsewhere): the per-scope donation-safety pass
+                    # already owns that shape — keeping the source
+                    # sets disjoint means one defect is never reported
+                    # (and suppressed) twice; an empty provable-
+                    # position intersection likewise has nothing to say
+                    return (), ""
+                return pos, f"donating callable `self.{f.attr}` ({where})"
+        fqn = self.resolve_call(module, qualname, call)
+        if not fqn:
+            return (), ""
+        if fqn in self.donating_globals:
+            return (self.donating_globals[fqn],
+                    f"module-level donating callable `{fqn}`")
+        s = self.functions.get(fqn)
+        if s is None or not s.donates:
+            return (), ""
+        shift = 1 if (s.is_method and is_self_call(call)) else 0
+        pos = tuple(sorted(p - shift for p in s.donates if p >= shift))
+        via = "; ".join(s.donates_via.get(p + shift, "")
+                        for p in pos if s.donates_via.get(p + shift))
+        return pos, f"`{fqn}` donates it ({via})" if via else f"`{fqn}`"
+
+    def alias_positions_for_call(self, module: str, qualname: str,
+                                 call: ast.Call) -> Tuple[int, ...]:
+        """Call-arg positions whose buffers the call's RETURN value
+        aliases (the ``returns_args`` summaries): ``y = view(x)`` with
+        ``def view(a): return a`` makes ``y`` and ``x`` one buffer, so
+        donating either later stales the other.  Positions index
+        ``call.args`` (implicit ``self`` stripped for method calls)."""
+        fqn = self.resolve_call(module, qualname, call)
+        if not fqn:
+            return ()
+        s = self.functions.get(fqn)
+        if s is None or not s.returns_args:
+            return ()
+        shift = 1 if (s.is_method and is_self_call(call)) else 0
+        return tuple(sorted(p - shift for p in s.returns_args
+                            if p >= shift))
+
+    def sccs(self) -> List[Set[str]]:
+        """Strongly-connected components of the call graph (Tarjan,
+        iterative).  Mutually-recursive summaries converge in the
+        donation fixpoint; the SCCs make that soundness observable (and
+        test-pinned) and bound any future finer-than-import-closure
+        incremental invalidation."""
+        graph: Dict[str, List[str]] = {
+            fqn: [e.callee for e in s.calls
+                  if e.callee in self.functions]
+            for fqn, s in self.functions.items()}
+        index: Dict[str, int] = {}
+        low: Dict[str, int] = {}
+        onstack: Set[str] = set()
+        stack: List[str] = []
+        out: List[Set[str]] = []
+        counter = [0]
+
+        for root in graph:
+            if root in index:
+                continue
+            work = [(root, 0)]
+            while work:
+                node, pi = work[-1]
+                if pi == 0:
+                    index[node] = low[node] = counter[0]
+                    counter[0] += 1
+                    stack.append(node)
+                    onstack.add(node)
+                recurse = False
+                for j in range(pi, len(graph[node])):
+                    nxt = graph[node][j]
+                    if nxt not in index:
+                        work[-1] = (node, j + 1)
+                        work.append((nxt, 0))
+                        recurse = True
+                        break
+                    if nxt in onstack:
+                        low[node] = min(low[node], index[nxt])
+                if recurse:
+                    continue
+                if low[node] == index[node]:
+                    comp: Set[str] = set()
+                    while True:
+                        w = stack.pop()
+                        onstack.discard(w)
+                        comp.add(w)
+                        if w == node:
+                            break
+                    out.append(comp)
+                work.pop()
+                if work:
+                    parent = work[-1][0]
+                    low[parent] = min(low[parent], low[node])
+        return out
+
+    def dependents_of(self, relpaths: Set[str]) -> Set[str]:
+        """Every relpath whose findings may depend on summaries from any
+        of ``relpaths``: the reverse import closure (a module that
+        imports a changed module — transitively — may resolve a call
+        into it).  Conservative superset of the call-graph SCC region.
+        A DELETED file is absent from this (fresh) index but its
+        importers' import edges still point at it — fall back to the
+        path-derived module name so the closure reaches them."""
+        changed_mods = {self.relpaths.get(r, module_name(r))
+                        for r in relpaths}
+        # reverse edges: imported -> importers (prefix-aware: importing
+        # a package pulls every submodule's file into the closure)
+        rev: Dict[str, Set[str]] = {}
+        for mod, deps in self.import_graph.items():
+            for d in deps:
+                rev.setdefault(d, set()).add(mod)
+        frontier = set(changed_mods)
+        seen = set(changed_mods)
+        while frontier:
+            nxt: Set[str] = set()
+            for mod in frontier:
+                # importers of this exact module or of any prefix target
+                # that resolves into it (from pkg import name)
+                for target, importers in rev.items():
+                    if target == mod or target.startswith(mod + ".") \
+                            or mod.startswith(target + "."):
+                        nxt |= importers - seen
+            seen |= nxt
+            frontier = nxt
+        return {self.modules[m] for m in seen if m in self.modules}
+
+
+def ensure_index(corpus) -> CorpusIndex:
+    """Build (once) and memoize the phase-1 index on the corpus — every
+    phase-2 pass and the incremental cache share one instance."""
+    idx = getattr(corpus, "_dstpu_index", None)
+    if idx is None:
+        idx = CorpusIndex.build(corpus)
+        corpus._dstpu_index = idx
+    return idx

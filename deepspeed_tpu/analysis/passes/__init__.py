@@ -5,5 +5,6 @@ built-in pass.  To add a pass: new module here, subclass
 ``tests/unit/analysis/fixtures/`` (README "how to add a pass")."""
 
 from deepspeed_tpu.analysis.passes import (  # noqa: F401
-    donation, host_sync, jax_compat, metric_names, recompile, slo_rules,
-    typed_errors)
+    donation, host_sync, jax_compat, metric_names, pallas_dma,
+    pallas_tile, recompile, sharding_contract, slo_rules, typed_errors,
+    vmem_budget)

@@ -5,19 +5,10 @@ from __future__ import annotations
 import ast
 from typing import Iterator, Optional, Tuple
 
-
-def attr_chain(node: ast.AST) -> str:
-    """Dotted name of an Attribute/Name chain (``jax.experimental.
-    shard_map`` -> that string); '' when the chain roots in a call or
-    subscript (not a plain name path)."""
-    parts = []
-    while isinstance(node, ast.Attribute):
-        parts.append(node.attr)
-        node = node.value
-    if isinstance(node, ast.Name):
-        parts.append(node.id)
-        return ".".join(reversed(parts))
-    return ""
+# canonical home is the (cycle-free) phase-1 index module — this side
+# of the package re-exports so every pass shares ONE implementation
+from deepspeed_tpu.analysis.index import (attr_chain,   # noqa: F401
+                                          is_jit_call)
 
 
 def call_name(node: ast.Call) -> str:
@@ -77,9 +68,3 @@ def in_loop(ancestors, *, stop_at: ast.AST = None) -> bool:
         if isinstance(a, (ast.For, ast.AsyncFor, ast.While)):
             return True
     return False
-
-
-def is_jit_call(node: ast.AST) -> bool:
-    """``jax.jit(...)`` / ``jit(...)`` / ``pjit(...)`` construction."""
-    return (isinstance(node, ast.Call)
-            and call_name(node) in ("jit", "pjit"))

@@ -1,0 +1,449 @@
+"""Shared Pallas/TPU AST helpers for the kernel-safety passes (ISSUE 15).
+
+The pallas-tile and vmem-budget passes reason about kernels WITHOUT
+executing them, so everything here is a conservative constant-evaluator
+over the kernel modules:
+
+  * :class:`Env` — fold integer/tuple expressions through module-level
+    and function-local single-assignment bindings (``_CHUNK_BUDGET``,
+    ``bs = csp * pair``...).  Anything data-dependent folds to ``None``
+    and the passes stay silent — they can miss, never hallucinate;
+  * dtype resolution — ``jnp.int8`` attr chains, names bound to them,
+    and ``x.astype(jnp.int8)`` operand wrappers, mapped to the
+    TPU-physical facts the paper's kernel layer lives by: itemsize, the
+    min HBM tile's sublane count (8 fp32 / 16 bf16 / 32 int8+fp8 — the
+    minor dim is always 128 lanes), and the window-RMW row quantum the
+    repo's kernels honor (8 rows for >=2-byte dtypes, whole 32-row
+    tiles for 1-byte payloads — PR 11 sidestepped exactly this with
+    whole-block windows);
+  * :class:`PallasCallInfo` — one ``pl.pallas_call(...)`` site with its
+    specs resolved (through ``grid_spec=PrefetchScalarGridSpec(...)``
+    indirection too) and, when the kernel is a plain flat-signature
+    function in the same module, the POSITIONAL mapping from kernel ref
+    params to in_specs / outputs / scratch entries — which is how a
+    ``pl.ds(..., 8)`` window over a ref can be traced back to an int8
+    scratch buffer or an ``.astype(jnp.int8)`` operand.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+from deepspeed_tpu.analysis.passes._ast_util import attr_chain, \
+    call_name as _call_tail
+
+# dtype -> (itemsize, min-tile sublane count, window-RMW row quantum).
+# The minor dim of every tile is 128 lanes regardless of dtype.
+DTYPES: Dict[str, Tuple[int, int, int]] = {
+    "float32": (4, 8, 8),
+    "int32": (4, 8, 8),
+    "uint32": (4, 8, 8),
+    "bfloat16": (2, 16, 8),
+    "float16": (2, 16, 8),
+    "int8": (1, 32, 32),
+    "uint8": (1, 32, 32),
+    "float8_e4m3fn": (1, 32, 32),
+    "float8_e4m3": (1, 32, 32),
+    "float8_e5m2": (1, 32, 32),
+}
+
+LANES = 128          # minor-dim tile width, every dtype
+UNIVERSAL_SUBLANE = 8    # weakest sublane quantum (fp32); used when the
+                         # dtype cannot be proven
+
+
+def is_call_named(node: ast.AST, name: str) -> bool:
+    """``name(...)`` or ``<anything>.name(...)`` — THE one predicate
+    every pallas pass keys call spellings on (tile's BlockSpec/VMEM,
+    dma's make_async_copy, vmem's scratch entries), so they can never
+    diverge on which calls they see."""
+    if not isinstance(node, ast.Call):
+        return False
+    f = node.func
+    return (isinstance(f, ast.Attribute) and f.attr == name) or \
+        (isinstance(f, ast.Name) and f.id == name)
+
+
+def collect_assigns(scope: ast.AST,
+                    deep: bool = False) -> Dict[str, Optional[ast.AST]]:
+    """``name -> value-expr`` for single-target assigns in ``scope``'s
+    own body (nested function/class scopes excluded unless ``deep`` —
+    Pallas kernels use nested closures as macros, so window analysis
+    folds through them).  A name assigned more than once maps to
+    ``None`` — the folder then refuses it."""
+    out: Dict[str, Optional[ast.AST]] = {}
+
+    def walk(node):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.Lambda, ast.ClassDef)):
+                if deep and isinstance(child, (ast.FunctionDef,
+                                               ast.AsyncFunctionDef)):
+                    walk(child)
+                continue
+            if isinstance(child, ast.Assign) and len(child.targets) == 1 \
+                    and isinstance(child.targets[0], ast.Name):
+                name = child.targets[0].id
+                out[name] = None if name in out else child.value
+            elif isinstance(child, ast.AnnAssign):
+                if isinstance(child.target, ast.Name):
+                    name = child.target.id
+                    out[name] = None if (name in out
+                                         or child.value is None) \
+                        else child.value
+            elif isinstance(child, (ast.AugAssign, ast.For, ast.AsyncFor)):
+                # EVERY name in the target is mutated — tuple for-
+                # targets (`for rows, v in ...`) too, not just bare
+                # names; a stale "constant" must fold to unknown
+                for n in ast.walk(child.target):
+                    if isinstance(n, ast.Name):
+                        out[n.id] = None
+                walk(child)
+                continue
+            walk(child)
+
+    walk(scope)
+    return out
+
+
+class Env:
+    """Layered constant environment (function locals over module
+    globals).  ``fold`` returns an int/float/str or None."""
+
+    def __init__(self, layers: List[Dict[str, Optional[ast.AST]]]):
+        self.layers = layers
+
+    def lookup(self, name: str) -> Optional[ast.AST]:
+        for layer in self.layers:
+            if name in layer:
+                return layer[name]
+        return None
+
+    def fold(self, node: Optional[ast.AST], _seen: frozenset = frozenset()):
+        if node is None:
+            return None
+        if isinstance(node, ast.Constant):
+            return node.value if isinstance(node.value,
+                                            (int, float, str)) else None
+        if isinstance(node, ast.Name):
+            if node.id in _seen:
+                return None
+            expr = self.lookup(node.id)
+            if expr is None:
+                return None
+            return self.fold(expr, _seen | {node.id})
+        if isinstance(node, ast.UnaryOp) and isinstance(node.op, ast.USub):
+            v = self.fold(node.operand, _seen)
+            return -v if isinstance(v, (int, float)) else None
+        if isinstance(node, ast.BinOp):
+            a = self.fold(node.left, _seen)
+            b = self.fold(node.right, _seen)
+            if not (isinstance(a, (int, float))
+                    and isinstance(b, (int, float))):
+                return None
+            try:
+                if isinstance(node.op, ast.Add):
+                    return a + b
+                if isinstance(node.op, ast.Sub):
+                    return a - b
+                if isinstance(node.op, ast.Mult):
+                    return a * b
+                if isinstance(node.op, ast.FloorDiv):
+                    return a // b
+                if isinstance(node.op, ast.Mod):
+                    return a % b
+                if isinstance(node.op, ast.Pow):
+                    return a ** b
+                if isinstance(node.op, ast.LShift):
+                    return a << b
+                if isinstance(node.op, ast.RShift):
+                    return a >> b
+            except (ZeroDivisionError, TypeError, ValueError):
+                return None
+        return None
+
+    def fold_dims(self, node: ast.AST) -> Optional[List[Optional[int]]]:
+        """Per-element fold of a literal shape tuple/list; ``None``
+        elements mark unprovable dims, ``None`` result a non-literal
+        shape.  ``None`` literals (BlockSpec squeezed dims) stay None
+        but the element count is preserved."""
+        if not isinstance(node, (ast.Tuple, ast.List)):
+            return None
+        out: List[Optional[int]] = []
+        for e in node.elts:
+            if isinstance(e, ast.Constant) and e.value is None:
+                out.append(None)
+                continue
+            v = self.fold(e)
+            out.append(v if isinstance(v, int) else None)
+        return out
+
+    # ------------------------------------------------------------ dtype
+    def resolve_dtype(self, node: Optional[ast.AST],
+                      _seen: frozenset = frozenset()) -> Optional[str]:
+        """Dtype NAME for an expression: ``jnp.int8``, a name bound to
+        one, ``jnp.dtype("int8")``, or a string literal."""
+        if node is None:
+            return None
+        if isinstance(node, ast.Constant) and isinstance(node.value, str):
+            return node.value if node.value in DTYPES else None
+        if isinstance(node, ast.Attribute):
+            tail = attr_chain(node).rsplit(".", 1)[-1] if attr_chain(node) \
+                else node.attr
+            return tail if tail in DTYPES else None
+        if isinstance(node, ast.Name):
+            if node.id in DTYPES:
+                return node.id
+            if node.id in _seen:
+                return None
+            expr = self.lookup(node.id)
+            return self.resolve_dtype(expr, _seen | {node.id}) \
+                if expr is not None else None
+        if isinstance(node, ast.Call):
+            # jnp.dtype("int8") / jnp.dtype(jnp.int8)
+            f = node.func
+            if isinstance(f, ast.Attribute) and f.attr == "dtype" \
+                    and node.args:
+                return self.resolve_dtype(node.args[0], _seen)
+        return None
+
+    def operand_dtype(self, node: Optional[ast.AST]) -> Optional[str]:
+        """Dtype of a pallas operand expression when provable:
+        ``q.astype(jnp.int8)``, ``jnp.zeros(shp, jnp.float32)``,
+        ``x.reshape(...)`` chains peeled down to those."""
+        while isinstance(node, ast.Call) \
+                and isinstance(node.func, ast.Attribute):
+            attr = node.func.attr
+            if attr == "astype" and node.args:
+                return self.resolve_dtype(node.args[0])
+            if attr in ("zeros", "ones", "full", "empty", "asarray"):
+                for kw in node.keywords:
+                    if kw.arg == "dtype":
+                        return self.resolve_dtype(kw.value)
+                if len(node.args) >= 2:
+                    return self.resolve_dtype(node.args[-1])
+                return None
+            if attr in ("reshape", "transpose", "at"):
+                node = node.func.value
+                continue
+            return None
+        return None
+
+
+@dataclass
+class BufferInfo:
+    """One kernel ref param's statically-known facts."""
+
+    kind: str                     # "prefetch" | "in" | "out" | "scratch"
+    dtype: Optional[str] = None   # DTYPES key, when provable
+    shape_node: Optional[ast.AST] = None      # scratch shape expr
+    spec_node: Optional[ast.AST] = None       # BlockSpec / VMEM / ... call
+
+
+@dataclass
+class PallasCallInfo:
+    """One ``pl.pallas_call`` site, specs resolved."""
+
+    node: ast.Call
+    enclosing: Optional[ast.AST]             # enclosing FunctionDef
+    kernel: Optional[ast.FunctionDef] = None
+    in_specs: List[ast.AST] = field(default_factory=list)
+    out_specs: List[ast.AST] = field(default_factory=list)
+    scratch: List[ast.AST] = field(default_factory=list)
+    out_count: int = 0
+    out_dtypes: List[Optional[ast.AST]] = field(default_factory=list)
+    num_prefetch: int = 0
+    operands: List[ast.AST] = field(default_factory=list)
+    vmem_limit_node: Optional[ast.AST] = None
+    params: Dict[str, BufferInfo] = field(default_factory=dict)
+
+
+def _kwarg(call: ast.Call, name: str) -> Optional[ast.AST]:
+    for kw in call.keywords:
+        if kw.arg == name:
+            return kw.value
+    return None
+
+
+def _list_elts(node: Optional[ast.AST]) -> Optional[List[ast.AST]]:
+    if isinstance(node, (ast.List, ast.Tuple)):
+        return list(node.elts)
+    return None
+
+
+def _resolve_kernel(expr: ast.AST, fn_assigns, module_defs
+                    ) -> Optional[ast.FunctionDef]:
+    """``kernel`` argument → the module-level FunctionDef it names,
+    through at most one local ``kernel = functools.partial(_k, ...)``
+    hop.  Returns None (no param mapping) for anything fancier."""
+    for _ in range(3):
+        if isinstance(expr, ast.Name):
+            if expr.id in module_defs:
+                return module_defs[expr.id]
+            nxt = fn_assigns.get(expr.id)
+            if nxt is None:
+                return None
+            expr = nxt
+            continue
+        if isinstance(expr, ast.Call) and _call_tail(expr) == "partial" \
+                and expr.args:
+            expr = expr.args[0]
+            continue
+        return None
+    return None
+
+
+def iter_pallas_calls(tree: ast.Module, env_module: Dict[str,
+                                                         Optional[ast.AST]]
+                      ) -> List[Tuple[PallasCallInfo, Env]]:
+    """Every ``pl.pallas_call`` site in a module, with its per-site Env
+    (function locals layered over module globals) and — when provable —
+    the kernel param → buffer mapping."""
+    module_defs = {n.name: n for n in tree.body
+                   if isinstance(n, ast.FunctionDef)}
+    out: List[Tuple[PallasCallInfo, Env]] = []
+    # parent map for (pallas_call(...))(operands) detection
+    parents: Dict[int, ast.AST] = {}
+    enclosing_fn: Dict[int, ast.AST] = {}
+
+    def walk(node, fn):
+        for child in ast.iter_child_nodes(node):
+            parents[id(child)] = node
+            f = fn
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                f = child
+            enclosing_fn[id(child)] = f
+            walk(child, f)
+
+    walk(tree, None)
+
+    for node in ast.walk(tree):
+        if not (isinstance(node, ast.Call)
+                and _call_tail(node) == "pallas_call"):
+            continue
+        fn = enclosing_fn.get(id(node))
+        fn_assigns = collect_assigns(fn) if fn is not None else {}
+        env = Env([fn_assigns, env_module])
+        info = PallasCallInfo(node=node, enclosing=fn)
+
+        parent = parents.get(id(node))
+        if isinstance(parent, ast.Call) and parent.func is node:
+            info.operands = list(parent.args)
+
+        in_specs = _kwarg(node, "in_specs")
+        scratch = _kwarg(node, "scratch_shapes")
+        out_specs = _kwarg(node, "out_specs")
+        num_prefetch = None
+        grid_spec = _kwarg(node, "grid_spec")
+        if grid_spec is not None:
+            gs = grid_spec
+            if isinstance(gs, ast.Name):
+                gs = fn_assigns.get(gs.id)
+            if isinstance(gs, ast.Call):
+                in_specs = in_specs or _kwarg(gs, "in_specs")
+                scratch = scratch or _kwarg(gs, "scratch_shapes")
+                out_specs = out_specs or _kwarg(gs, "out_specs")
+                num_prefetch = _kwarg(gs, "num_scalar_prefetch")
+        info.in_specs = _list_elts(in_specs) or []
+        info.out_specs = _list_elts(out_specs) or (
+            [out_specs] if out_specs is not None else [])
+        info.scratch = _list_elts(scratch) or []
+        npf = env.fold(num_prefetch) if num_prefetch is not None else 0
+        info.num_prefetch = npf if isinstance(npf, int) else 0
+
+        out_shape = _kwarg(node, "out_shape")
+        outs = _list_elts(out_shape)
+        if outs is not None:
+            info.out_count = len(outs)
+            info.out_dtypes = [
+                (o.args[1] if isinstance(o, ast.Call)
+                 and len(o.args) >= 2 else _kwarg(o, "dtype")
+                 if isinstance(o, ast.Call) else None) for o in outs]
+        elif out_shape is not None:
+            info.out_count = 1
+            info.out_dtypes = [
+                out_shape.args[1] if isinstance(out_shape, ast.Call)
+                and len(out_shape.args) >= 2 else None]
+        else:
+            specs = _list_elts(out_specs)
+            info.out_count = len(specs) if specs is not None else 1
+            info.out_dtypes = [None] * info.out_count
+
+        cp = _kwarg(node, "compiler_params")
+        if isinstance(cp, ast.Call):
+            info.vmem_limit_node = _kwarg(cp, "vmem_limit_bytes")
+
+        info.kernel = _resolve_kernel(node.args[0], fn_assigns,
+                                      module_defs) if node.args else None
+        _map_params(info, env)
+        out.append((info, env))
+    return out
+
+
+def _map_params(info: PallasCallInfo, env: Env) -> None:
+    """Positional kernel-param → buffer mapping.  Only attempted when
+    the kernel has a flat signature (no *args) and the param count
+    matches prefetch + inputs + outputs + scratch exactly — anything
+    else leaves ``params`` empty (no mapping beats a wrong mapping)."""
+    k = info.kernel
+    if k is None or k.args.vararg is not None:
+        return
+    names = [a.arg for a in (k.args.posonlyargs + k.args.args)]
+    n_expected = (info.num_prefetch + len(info.in_specs)
+                  + info.out_count + len(info.scratch))
+    if not info.in_specs or len(names) != n_expected:
+        return
+    i = 0
+    for _ in range(info.num_prefetch):
+        info.params[names[i]] = BufferInfo(kind="prefetch")
+        i += 1
+    for j, spec in enumerate(info.in_specs):
+        # operands align with prefetch + inputs at the outer call
+        op = info.operands[info.num_prefetch + j] \
+            if len(info.operands) == info.num_prefetch \
+            + len(info.in_specs) else None
+        dt = env.operand_dtype(op) if op is not None else None
+        info.params[names[i]] = BufferInfo(kind="in", dtype=dt,
+                                           spec_node=spec)
+        i += 1
+    for j in range(info.out_count):
+        dnode = info.out_dtypes[j] if j < len(info.out_dtypes) else None
+        spec = info.out_specs[j] if j < len(info.out_specs) else None
+        info.params[names[i]] = BufferInfo(
+            kind="out", dtype=env.resolve_dtype(dnode), spec_node=spec)
+        i += 1
+    for s in info.scratch:
+        bi = BufferInfo(kind="scratch", spec_node=s)
+        if isinstance(s, ast.Call) and _call_tail(s) == "VMEM" \
+                and len(s.args) >= 2:
+            bi.shape_node = s.args[0]
+            bi.dtype = env.resolve_dtype(s.args[1])
+        info.params[names[i]] = bi
+        i += 1
+
+
+def buffer_root(node: ast.AST, fn_assigns: Dict[str, Optional[ast.AST]],
+                _depth: int = 0) -> Optional[str]:
+    """Root buffer NAME of a ref expression: ``k_ref.at[...]`` → k_ref,
+    ``src`` where ``src = w_any.at[layer] if stacked else w_any`` →
+    w_any (both branches must agree).  None when untraceable."""
+    if _depth > 8:
+        return None
+    if isinstance(node, ast.Name):
+        expr = fn_assigns.get(node.id)
+        if expr is not None:
+            r = buffer_root(expr, fn_assigns, _depth + 1)
+            if r is not None:
+                return r
+        return node.id if expr is None else None
+    if isinstance(node, ast.Attribute):
+        return buffer_root(node.value, fn_assigns, _depth + 1)
+    if isinstance(node, ast.Subscript):
+        return buffer_root(node.value, fn_assigns, _depth + 1)
+    if isinstance(node, ast.IfExp):
+        a = buffer_root(node.body, fn_assigns, _depth + 1)
+        b = buffer_root(node.orelse, fn_assigns, _depth + 1)
+        return a if a == b else None
+    return None

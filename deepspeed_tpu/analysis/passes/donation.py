@@ -15,18 +15,23 @@ shape statically:
 
 with taint cleared on any rebinding of ``x`` (the canonical
 ``self.state, m = step(self.state, ...)`` pattern never taints).
-Cross-function donation (a jitted callable stored in ``__init__`` and
-called elsewhere) is out of static reach here; the dynamic
-bit-identity suites keep owning that half.
+
+The linearized scan itself lives in
+:mod:`deepspeed_tpu.analysis.taint` (shared with the interprocedural
+``sharding-contract`` pass, which follows donations ACROSS call
+boundaries via the phase-1 summaries — the half this per-scope pass
+cannot see).  ISSUE 15 fixed three false-negative shapes here, each
+pinned by a regression fixture: augmented-assignment reads after
+donate, reads in a ``finally`` body after a donating ``try`` returned,
+and donating callables bound through tuple unpacking.
 """
 
 from __future__ import annotations
 
 import ast
-from typing import Dict, List, Set, Tuple
 
 from deepspeed_tpu.analysis.core import FileContext, LintPass, register
-from deepspeed_tpu.analysis.passes._ast_util import (attr_chain, is_jit_call)
+from deepspeed_tpu.analysis.taint import scan_function
 
 SCOPES = (
     "deepspeed_tpu/serving/",
@@ -34,65 +39,6 @@ SCOPES = (
     "deepspeed_tpu/runtime/",
     "deepspeed_tpu/ops/",
 )
-
-
-def _donated_positions(call: ast.Call) -> Tuple[int, ...]:
-    for kw in call.keywords:
-        if kw.arg != "donate_argnums":
-            continue
-        v = kw.value
-        if isinstance(v, ast.Constant) and isinstance(v.value, int):
-            return (v.value,)
-        if isinstance(v, (ast.Tuple, ast.List)):
-            out = []
-            for e in v.elts:
-                if isinstance(e, ast.Constant) and isinstance(e.value, int):
-                    out.append(e.value)
-            return tuple(out)
-    return ()
-
-
-def _walk_scope(fn: ast.AST, _path: Tuple = ()):
-    """Walk one function's OWN body — never descending into nested
-    function/class scopes (each FunctionDef is analyzed exactly once by
-    check_file; descending here would double-report nested violations).
-
-    Yields ``(node, branch_path)`` where branch_path identifies the
-    chain of conditional arms the node sits in (``(id(if_node), arm),
-    ...``) — so a Return inside one arm can be scoped to clear only the
-    donations made in that same arm (see the exit handling below)."""
-    for field_name, value in ast.iter_fields(fn):
-        branches = ()
-        if isinstance(fn, (ast.If, ast.For, ast.AsyncFor, ast.While,
-                           ast.Try)) and field_name in (
-                "body", "orelse", "handlers", "finalbody"):
-            branches = ((id(fn), field_name),)
-        for child in (value if isinstance(value, list) else [value]):
-            if not isinstance(child, ast.AST):
-                continue
-            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
-                                  ast.Lambda, ast.ClassDef)):
-                continue
-            path = _path + branches
-            yield child, path
-            yield from _walk_scope(child, path)
-
-
-def _ref(node: ast.AST) -> str:
-    """Canonical dotted name for a Name / self-attribute chain ('' when
-    the expression is not a trackable reference)."""
-    chain = attr_chain(node)
-    if chain and (chain.count(".") == 0 or chain.startswith("self.")):
-        return chain
-    return ""
-
-
-class _Event:
-    __slots__ = ("pos", "kind", "name", "node", "path")
-
-    def __init__(self, pos, kind, name, node, path=()):
-        self.pos, self.kind, self.name = pos, kind, name
-        self.node, self.path = node, path
 
 
 @register
@@ -104,105 +50,5 @@ class DonationSafetyPass(LintPass):
     def check_file(self, ctx: FileContext):
         for node in ast.walk(ctx.tree):
             if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
-                yield from self._check_function(ctx, node)
-
-    def _check_function(self, ctx: FileContext, fn: ast.AST):
-        # donating-callable BINDINGS in this scope (position-aware: a
-        # call through the name before the binding — or after it is
-        # rebound to something else — must not taint)
-        binds = []          # (pos, name, donated positions)
-        for node, _ in _walk_scope(fn):
-            if (isinstance(node, ast.Assign) and is_jit_call(node.value)):
-                pos = _donated_positions(node.value)
-                if not pos:
-                    continue
-                for tgt in node.targets:
-                    name = _ref(tgt)
-                    if name:
-                        # 2.5: after the plain store event at the same
-                        # spot (which unbinds), so the bind wins
-                        binds.append(((node.lineno, 2.5,
-                                       tgt.col_offset), name, pos))
-        if not binds:
-            return
-
-        # Linearize loads / stores / donating calls by source position.
-        # Priority orders same-line events the way evaluation does:
-        # loads (RHS) -> the donating call -> stores (LHS binds last) ->
-        # function exits; `x = f(x)` therefore never taints x.
-        bindable = {name for _, name, _ in binds}
-        events: List[_Event] = [
-            _Event(pos, "bind", name, positions)
-            for pos, name, positions in binds]
-        for node, path in _walk_scope(fn):
-            if isinstance(node, ast.Call):
-                cname = _ref(node.func)
-                if cname in bindable:
-                    events.append(_Event(
-                        (node.lineno, 1, node.col_offset), "call",
-                        cname, node, path))
-            elif isinstance(node, (ast.Return, ast.Raise)):
-                # control leaves the function: code later in source order
-                # on the SAME branch never runs after this, so donations
-                # made in this exit's own branch subtree are dead — but a
-                # conditional early return must NOT launder a donation
-                # made on the fallthrough path
-                events.append(_Event(
-                    (getattr(node, "end_lineno", node.lineno), 3, 0),
-                    "exit", "", node, path))
-            elif isinstance(node, (ast.Name, ast.Attribute)):
-                name = _ref(node)
-                if not name:
-                    continue
-                if isinstance(node.ctx, ast.Store):
-                    events.append(_Event(
-                        (node.lineno, 2, node.col_offset), "store",
-                        name, node))
-                elif isinstance(node.ctx, ast.Load):
-                    events.append(_Event(
-                        (node.lineno, 0, node.col_offset), "load",
-                        name, node))
-        events.sort(key=lambda e: e.pos)
-
-        bound: Dict[str, Tuple[int, ...]] = {}   # name -> donated argnums
-        tainted: Dict[str, tuple] = {}   # ref -> (donating call, branch path)
-        reported: Set[Tuple[str, int]] = set()
-        for ev in events:
-            if ev.kind == "exit":
-                # clear only donations made in this exit's branch subtree
-                # (exit path is a prefix of the donor's path)
-                for name in [n for n, (_, dpath) in tainted.items()
-                             if dpath[:len(ev.path)] == ev.path]:
-                    tainted.pop(name)
-            elif ev.kind == "bind":
-                bound[ev.name] = ev.node   # node slot carries positions
-            elif ev.kind == "call" and ev.name in bound:
-                call = ev.node
-                for p in bound[ev.name]:
-                    if p < len(call.args):
-                        ref = _ref(call.args[p])
-                        if ref:
-                            tainted[ref] = (call, ev.path)
-            elif ev.kind == "store":
-                tainted.pop(ev.name, None)
-                bound.pop(ev.name, None)   # rebound to something else
-                # rebinding `self.state` also revives `self.state.params`
-                for t in [t for t in tainted if t.startswith(ev.name + ".")]:
-                    tainted.pop(t, None)
-            elif ev.kind == "load" and ev.name in tainted:
-                donor, _ = tainted[ev.name]
-                if ev.node.lineno <= getattr(donor, "end_lineno",
-                                             donor.lineno):
-                    continue   # load inside/before the donating call
-                               # statement (evaluated pre-donation)
-                key = (ev.name, ev.node.lineno)
-                if key in reported:
-                    continue
-                reported.add(key)
-                yield ctx.finding(
-                    self.id, ev.node,
-                    f"`{ev.name}` was donated to the jit call on line "
-                    f"{donor.lineno} (donate_argnums) and read here: the "
-                    "buffer may already be reused in place",
-                    suggestion="read the value BEFORE the donating call, "
-                    "use the call's outputs, or drop the donation")
+                yield from scan_function(ctx, node, pass_id=self.id,
+                                         track_local_binds=True)

@@ -1,0 +1,238 @@
+"""pallas-dma — every started async copy is awaited; no orphan waits
+(ISSUE 15).
+
+The manual-DMA kernels (ops/decode_step.py's double-buffered cache walk,
+ops/int8_matmul.py's weight streaming) are exactly as correct as their
+start/wait pairing: a ``make_async_copy(...).start()`` whose semaphore
+is never awaited lets the kernel return (or reuse the buffer slot)
+while the copy is in flight — silent corruption that only reproduces on
+real hardware timing — and an orphan ``.wait()`` deadlocks on a
+semaphore nobody signals.
+
+The repo spells DMA handles three ways, and the pass keys start/wait
+events so all three pair up across the whole kernel (nested closures
+are macros here, so the match domain is the outer kernel function with
+its closures flattened):
+
+  * **bound handles** — ``fk = pltpu.make_async_copy(...)`` then
+    ``fk.start()`` / ``fk.wait()``: keyed by name; a name bound to a
+    FACTORY result (``h = chunk_dma(0)``) keys like the call, so
+    ``h.start()`` pairs with ``chunk_dma(0).wait()`` (a name rebound
+    ambiguously — different streams on one name — goes untracked:
+    can miss, never hallucinate);
+  * **factory helpers** — ``def kdma(i): return pltpu.make_async_copy
+    (...)`` then ``kdma(i).start()`` / ``kdma(i).wait()``: keyed by the
+    factory name, refined by the trailing literal stream index when
+    EVERY call spells one (``chunk_dma(..., 0)`` K-stream vs
+    ``chunk_dma(..., 1)`` V-stream — dropping only the V wait is
+    caught);
+  * **inline** — ``pltpu.make_async_copy(a, b, sem).start()``: keyed by
+    the normalized semaphore expression, so the write-back started in
+    ``finish_write`` pairs with the drain ``.wait()`` at kernel exit.
+
+Matching is whole-function (not path-sensitive): a start with no wait
+ANYWHERE is flagged, which catches the dropped-wait mutation class the
+tier-1 seeds pin; per-path gaps stay owned by the dynamic suites.
+``.start()``/``.wait()`` on anything not traceable to a
+``make_async_copy`` (threads, timers) is ignored.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from deepspeed_tpu.analysis.core import FileContext, LintPass, register
+from deepspeed_tpu.analysis.passes._pallas_util import is_call_named
+
+SCOPES = ("deepspeed_tpu/ops/",)
+
+
+def _is_make_async_copy(node: ast.AST) -> bool:
+    return is_call_named(node, "make_async_copy")
+
+
+def _flat_walk(fn: ast.AST):
+    """Every node under ``fn`` INCLUDING nested function bodies (the
+    kernels' closure-as-macro idiom), excluding nested classes."""
+    for child in ast.iter_child_nodes(fn):
+        if isinstance(child, ast.ClassDef):
+            continue
+        yield child
+        yield from _flat_walk(child)
+
+
+def _norm(node: ast.AST) -> str:
+    """Position-independent structural dump for semaphore matching."""
+    return ast.dump(node, annotate_fields=False)
+
+
+class _Events:
+    def __init__(self) -> None:
+        self.starts: Dict[tuple, List[ast.AST]] = {}
+        self.waits: Dict[tuple, List[ast.AST]] = {}
+
+    def add(self, kind: str, key: tuple, node: ast.AST) -> None:
+        side = self.starts if kind == "start" else self.waits
+        side.setdefault(key, []).append(node)
+
+
+@register
+class PallasDmaPass(LintPass):
+    id = "pallas-dma"
+    title = "every async-copy start has a wait; no orphan waits"
+    scope = SCOPES
+
+    def check_file(self, ctx: FileContext) -> Iterable:
+        if "make_async_copy" not in ctx.source:
+            return
+        # module-level defs AND class methods are kernel roots; nested
+        # defs are NOT re-scanned (the flattened walk already covers
+        # them inside their root, so they would double-report)
+        roots = [n for n in ctx.tree.body
+                 if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))]
+        roots += [m for n in ctx.tree.body if isinstance(n, ast.ClassDef)
+                  for m in n.body
+                  if isinstance(m, (ast.FunctionDef, ast.AsyncFunctionDef))]
+        for node in roots:
+            yield from self._check_kernel(ctx, node)
+
+    def _check_kernel(self, ctx, fn: ast.AST) -> Iterable:
+        nodes = list(_flat_walk(fn))
+        if not any(_is_make_async_copy(n) for n in nodes):
+            return
+        # DMA-handle provenance inside this kernel: factories first, so
+        # a name bound BEFORE the factory's def in the flat walk still
+        # resolves
+        factories: Set[str] = set()      # local defs returning a copy
+        for n in nodes:
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                for sub in ast.walk(n):
+                    if isinstance(sub, ast.Return) \
+                            and sub.value is not None \
+                            and _is_make_async_copy(sub.value):
+                        factories.add(n.name)
+
+        # name -> every handle-producing value bound to it: None for a
+        # direct ``make_async_copy(...)`` (keyed by name), or the
+        # factory ``ast.Call`` (keyed like the call, so ``h.start()``
+        # pairs with ``chunk_dma(0).wait()``)
+        bound: Dict[str, List[Optional[ast.Call]]] = {}
+        for n in nodes:
+            if not isinstance(n, ast.Assign):
+                continue
+            val = n.value
+            if _is_make_async_copy(val):
+                entry: Optional[ast.Call] = None
+            elif isinstance(val, ast.Call) \
+                    and isinstance(val.func, ast.Name) \
+                    and val.func.id in factories:
+                entry = val
+            else:
+                continue
+            for tgt in n.targets:
+                if isinstance(tgt, ast.Name):
+                    bound.setdefault(tgt.id, []).append(entry)
+
+        # factory stream refinement: use the trailing literal arg as a
+        # sub-key only when EVERY call of that factory (as a
+        # start/wait receiver OR a handle bind) spells one
+        const_last: Dict[str, bool] = {}
+
+        def note(call: ast.Call) -> None:
+            f = call.func
+            if isinstance(f, ast.Name) and f.id in factories:
+                is_const = bool(call.args) and isinstance(
+                    call.args[-1], ast.Constant)
+                const_last[f.id] = const_last.get(f.id, True) and is_const
+
+        for n in nodes:
+            call = self._handle_call(n)
+            if call is not None:
+                note(call)
+            if isinstance(n, ast.Assign) and isinstance(n.value, ast.Call):
+                note(n.value)
+
+        ev = _Events()
+        for n in nodes:
+            if not (isinstance(n, ast.Call)
+                    and isinstance(n.func, ast.Attribute)
+                    and n.func.attr in ("start", "wait")
+                    and not n.args and not n.keywords):
+                continue
+            key = self._key(n.func.value, bound, factories, const_last)
+            if key is not None:
+                ev.add(n.func.attr, key, n)
+
+        for key, sites in sorted(ev.starts.items(),
+                                 key=lambda kv: kv[1][0].lineno):
+            if key not in ev.waits:
+                yield ctx.finding(
+                    self.id, sites[0],
+                    f"async copy {self._describe(key)} is started but "
+                    "never awaited in this kernel: the DMA may still be "
+                    "in flight when its buffer slot is reused or the "
+                    "kernel returns",
+                    suggestion="await the same handle/semaphore "
+                    "(`.wait()`) on every path before buffer reuse and "
+                    "before the kernel exits")
+        for key, sites in sorted(ev.waits.items(),
+                                 key=lambda kv: kv[1][0].lineno):
+            if key not in ev.starts:
+                yield ctx.finding(
+                    self.id, sites[0],
+                    f"unpaired wait: {self._describe(key)} is awaited "
+                    "but no matching start exists in this kernel — the "
+                    "semaphore is never signaled (deadlock on device)",
+                    suggestion="start the copy on every path that "
+                    "reaches this wait, or delete the stale wait")
+
+    @staticmethod
+    def _handle_call(n: ast.AST) -> Optional[ast.Call]:
+        """The ``factory(...)`` receiver of a ``.start()``/``.wait()``."""
+        if isinstance(n, ast.Call) and isinstance(n.func, ast.Attribute) \
+                and n.func.attr in ("start", "wait") \
+                and isinstance(n.func.value, ast.Call):
+            return n.func.value
+        return None
+
+    @staticmethod
+    def _factory_key(call: ast.Call, factories: Set[str],
+                     const_last: Dict[str, bool]) -> Optional[tuple]:
+        name = call.func.id
+        if name not in factories:
+            return None
+        if const_last.get(name) and call.args:
+            return ("call", name, repr(call.args[-1].value))
+        return ("call", name, None)
+
+    @classmethod
+    def _key(cls, recv: ast.AST, bound: Dict[str, list],
+             factories: Set[str],
+             const_last: Dict[str, bool]) -> Optional[tuple]:
+        if isinstance(recv, ast.Name):
+            binds = bound.get(recv.id)
+            if not binds:
+                return None
+            keys = {("name", recv.id) if b is None
+                    else cls._factory_key(b, factories, const_last)
+                    for b in binds}
+            # ambiguous rebinds (different streams / mixed spellings
+            # on one name) go untracked: can miss, never hallucinate
+            return keys.pop() if len(keys) == 1 else None
+        if _is_make_async_copy(recv):
+            sem = recv.args[2] if len(recv.args) >= 3 else None
+            return ("sem", _norm(sem) if sem is not None
+                    else _norm(recv))
+        if isinstance(recv, ast.Call) and isinstance(recv.func, ast.Name):
+            return cls._factory_key(recv, factories, const_last)
+        return None
+
+    @staticmethod
+    def _describe(key: tuple) -> str:
+        if key[0] == "name":
+            return f"handle `{key[1]}`"
+        if key[0] == "call":
+            stream = f" (stream {key[2]})" if key[2] is not None else ""
+            return f"`{key[1]}(...)`{stream}"
+        return "with this semaphore"

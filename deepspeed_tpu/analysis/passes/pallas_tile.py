@@ -1,0 +1,195 @@
+"""pallas-tile — dtype-dependent TPU tile quanta on constant kernel
+shapes (ISSUE 15).
+
+The TPU stores arrays in HBM/VMEM tiles whose minor dim is ALWAYS 128
+lanes and whose sublane count depends on itemsize: 8×128 for fp32,
+16×128 for bf16, 32×128 for int8/fp8.  The repo has paid for this class
+of bug at runtime twice — PR 11's int8 path had to "sidestep int8's
+32-row HBM tile quantum" with whole-block windows, and PR 2's decode
+kernel RMWs "the 8-aligned pair-row window" because HBM tiling forbids
+single-row writes.  This pass proves the statically-provable half:
+
+  * **T1 — VMEM scratch tiling**: a ``pltpu.VMEM(shape, dtype)``
+    scratch whose minor dim folds to a constant must tile to the
+    128-lane quantum (1 is sanctioned — flash keeps rank-2 ``(bq, 1)``
+    online-softmax state); a 1-byte scratch (int8/fp8) whose sublane
+    dim folds must cover whole 32-row tiles.
+  * **T2 — DMA window alignment**: a ``pl.ds(start, n)`` slice in the
+    sublane position of a ``make_async_copy`` ref with constant ``n``
+    must be a multiple of the buffer dtype's window quantum (8 rows for
+    >=2-byte dtypes, 32 for int8/fp8 — resolved through the kernel's
+    positional param map when provable, the universal 8 otherwise); a
+    constant ``pl.ds`` in the MINOR position must move whole 128-lane
+    groups.
+  * **T3 — BlockSpec block shapes**: constant block dims must respect
+    the same quanta (minor: None/1/128-multiple; sublane: 8-multiple).
+
+Everything is evaluated from constant BlockSpec/slice arithmetic
+(module constants and single-assignment locals folded); data-dependent
+shapes fold to "unknown" and stay silent — the pass can miss, never
+hallucinate.  The seeded-mutation tier-1 tests pin the teeth: shrinking
+the int8 weight-tile DMA window in ops/int8_matmul.py to 8 rows fails
+this pass, and therefore tier-1.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable
+
+from deepspeed_tpu.analysis.core import FileContext, LintPass, register
+from deepspeed_tpu.analysis.passes._pallas_util import (
+    DTYPES, LANES, UNIVERSAL_SUBLANE, Env, PallasCallInfo, buffer_root,
+    collect_assigns, is_call_named as _is_call_named, iter_pallas_calls)
+
+SCOPES = ("deepspeed_tpu/ops/",)
+
+_TILE_HINT = ("tile to the dtype quantum (8x128 fp32, 16x128 bf16, "
+              "32x128 int8/fp8) or keep the dim data-dependent and "
+              "validated by the plan resolver")
+
+
+def _is_ds(node: ast.AST) -> bool:
+    return (isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr == "ds")
+
+
+@register
+class PallasTilePass(LintPass):
+    id = "pallas-tile"
+    title = "constant kernel shapes respect dtype-dependent TPU tile " \
+            "quanta"
+    scope = SCOPES
+
+    def check_file(self, ctx: FileContext) -> Iterable:
+        if "pallas" not in ctx.source:
+            return
+        module_assigns = collect_assigns(ctx.tree)
+        calls = list(iter_pallas_calls(ctx.tree, module_assigns))
+        for info, env in calls:
+            yield from self._check_scratch(ctx, info, env)
+            yield from self._check_blockspecs(ctx, info, env)
+        # windows once per kernel FUNCTION, with buffer dtypes merged
+        # across all of its call sites — a param whose callers disagree
+        # folds to unknown (silent), so no single caller is ever
+        # authoritative for a shared kernel
+        by_kernel: Dict[int, list] = {}
+        for info, _ in calls:
+            if info.kernel is not None:
+                by_kernel.setdefault(id(info.kernel), []).append(info)
+        for infos in by_kernel.values():
+            primary = infos[0]
+            for other in infos[1:]:
+                for name, bi in primary.params.items():
+                    ob = other.params.get(name)
+                    if ob is None or ob.dtype != bi.dtype:
+                        bi.dtype = None
+            yield from self._check_windows(ctx, primary, module_assigns)
+
+    # ------------------------------------------------- T1 VMEM scratch
+    def _check_scratch(self, ctx, info: PallasCallInfo, env: Env):
+        for s in info.scratch:
+            if not _is_call_named(s, "VMEM") or not s.args:
+                continue
+            dims = env.fold_dims(s.args[0])
+            if not dims:
+                continue
+            dtype = env.resolve_dtype(s.args[1]) if len(s.args) > 1 \
+                else None
+            minor = dims[-1]
+            if isinstance(minor, int) and minor != 1 and minor % LANES:
+                yield ctx.finding(
+                    self.id, s,
+                    f"VMEM scratch minor dim {minor} is not 128-lane "
+                    "tiled (every TPU tile is <sublanes>x128; "
+                    "off-quantum scratch pads to a full tile per row)",
+                    suggestion=_TILE_HINT)
+            if len(dims) >= 2 and dtype in DTYPES \
+                    and DTYPES[dtype][0] == 1:
+                sub = dims[-2]
+                if isinstance(sub, int) and sub != 1 \
+                        and sub % DTYPES[dtype][1]:
+                    yield ctx.finding(
+                        self.id, s,
+                        f"{dtype} VMEM scratch sublane dim {sub} does "
+                        f"not cover whole {DTYPES[dtype][1]}-row tiles "
+                        "(1-byte dtypes tile 32x128; partial tiles "
+                        "corrupt neighboring rows on write-back)",
+                        suggestion=_TILE_HINT)
+
+    # -------------------------------------------------- T3 block specs
+    def _check_blockspecs(self, ctx, info: PallasCallInfo, env: Env):
+        # out_specs come straight off the call site — unlike the param
+        # map they need no flat-signature kernel to be checkable
+        for spec in info.in_specs + info.out_specs:
+            if not _is_call_named(spec, "BlockSpec") or not spec.args:
+                continue
+            dims = env.fold_dims(spec.args[0])
+            if not dims or len(dims) < 2:
+                continue
+            minor, sub = dims[-1], dims[-2]
+            if isinstance(minor, int) and minor != 1 and minor % LANES:
+                yield ctx.finding(
+                    self.id, spec,
+                    f"BlockSpec minor block dim {minor} is not 128-lane "
+                    "tiled — each grid step moves partial lane groups",
+                    suggestion=_TILE_HINT)
+            if isinstance(sub, int) and sub != 1 \
+                    and sub % UNIVERSAL_SUBLANE:
+                yield ctx.finding(
+                    self.id, spec,
+                    f"BlockSpec sublane block dim {sub} is not a "
+                    "multiple of 8 (the weakest sublane tile quantum)",
+                    suggestion=_TILE_HINT)
+
+    # ------------------------------------------------- T2 DMA windows
+    def _check_windows(self, ctx, info: PallasCallInfo, module_assigns):
+        kernel = info.kernel
+        deep = collect_assigns(kernel, deep=True)
+        env = Env([deep, module_assigns])
+        for node in ast.walk(kernel):
+            if not _is_call_named(node, "make_async_copy"):
+                continue
+            for operand in node.args[:2]:
+                yield from self._check_ref_slices(ctx, info, env, deep,
+                                                 operand)
+
+    def _check_ref_slices(self, ctx, info: PallasCallInfo, env: Env,
+                          deep, operand: ast.AST):
+        sub = operand
+        # peel `X.at[...]` / plain subscripts down to the slice tuple
+        if not isinstance(sub, ast.Subscript):
+            return
+        idx = sub.slice
+        elems = list(idx.elts) if isinstance(idx, ast.Tuple) else [idx]
+        if len(elems) < 2:
+            return       # leading-dim picks only: no tile-edge motion
+        root = buffer_root(operand, deep)
+        dtype = None
+        if root is not None and root in info.params:
+            dtype = info.params[root].dtype
+        wq = DTYPES[dtype][2] if dtype in DTYPES else UNIVERSAL_SUBLANE
+        minor_e, sub_e = elems[-1], elems[-2]
+        if _is_ds(minor_e) and len(minor_e.args) >= 2:
+            size = env.fold(minor_e.args[1])
+            if isinstance(size, int) and size % LANES:
+                yield ctx.finding(
+                    self.id, minor_e,
+                    f"DMA slice of the minor dim moves {size} lanes — "
+                    "Mosaic requires 128-aligned minor-dim slices "
+                    f"(buffer `{root or '?'}`)",
+                    suggestion=_TILE_HINT)
+        if _is_ds(sub_e) and len(sub_e.args) >= 2:
+            size = env.fold(sub_e.args[1])
+            if isinstance(size, int) and size % wq:
+                what = f"{dtype} " if dtype else ""
+                yield ctx.finding(
+                    self.id, sub_e,
+                    f"DMA window covers {size} sublane rows of "
+                    f"{what}buffer `{root or '?'}` — HBM tiling "
+                    f"requires whole {wq}-row windows (a partial-tile "
+                    "RMW corrupts the neighboring rows)",
+                    suggestion="widen the window to the "
+                    f"{wq}-row quantum (whole-block windows for 1-byte "
+                    "payloads — the PR 11 idiom) or realign the start")

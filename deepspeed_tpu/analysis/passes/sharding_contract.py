@@ -1,0 +1,171 @@
+"""sharding-contract — interprocedural donation taint + one mesh-axis
+registry (ISSUE 15).
+
+Two contracts, both invisible to per-file scans:
+
+**Donation across call boundaries.**  The per-scope ``donation-safety``
+pass goes blind the moment a donated array crosses a call: a helper
+that donates its argument (``def consume(state): return _step(state)``
+with ``_step = jax.jit(..., donate_argnums=(0,))``), or a donating
+callable bound on ``self`` in ``__init__`` and invoked from another
+method.  Phase 1 (:mod:`deepspeed_tpu.analysis.index`) summarizes every
+function — params donated directly, via ``self``-attribute donating
+callables, via module-level jit binds, or TRANSITIVELY through calls —
+and this pass replays the same linearized read-after-donate scan
+(:mod:`deepspeed_tpu.analysis.taint`) with those summaries as the
+taint sources.  The two source sets are disjoint (local binds belong
+to donation-safety), so one read is never double-reported.  The
+acceptance fixture: fn A passes a buffer to helper B whose summary
+donates it, then A reads the buffer → flagged; the safe twin (helper
+consumes and returns fresh, caller rebinds) stays silent.
+
+**Mesh axis names.**  ``P("dta")`` inside a 4-D mesh program shards
+onto a nonexistent axis and fails at trace time — on the LAST
+machine-size config you test, not the first.  The repo declares ONE
+axis registry (``parallel/topology.py``'s ``MESH_AXES``); every string
+literal used as a mesh axis — in ``P(...)``/``PartitionSpec``,
+``shard_map``'s ``axis_names``, ``Mesh(devices, (...))``, an
+``axis_name=`` kwarg, or a collective's axis argument — must name a
+registered axis.  Variables pass through unchecked (ring attention
+takes its axis as a parameter); only provable literals are held to the
+registry, which is parsed from the corpus so the lint tracks the code,
+not a copy of it.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, Optional, Set, Tuple
+
+from deepspeed_tpu.analysis.core import Corpus, FileContext, LintPass, \
+    register
+from deepspeed_tpu.analysis.index import CorpusIndex, ensure_index, \
+    module_name
+from deepspeed_tpu.analysis.passes._ast_util import call_name
+from deepspeed_tpu.analysis.passes.donation import SCOPES as DONATION_SCOPES
+from deepspeed_tpu.analysis.taint import scan_function
+
+AXIS_REGISTRY_PATH = "deepspeed_tpu/parallel/topology.py"
+
+#: fallback when a (synthetic) tree ships no topology module — mirrors
+#: parallel/topology.py's MESH_AXES and is pinned against it by test
+DEFAULT_AXES = ("pipe", "data", "expert", "seq", "model")
+
+_SPEC_CALLS = ("P", "PartitionSpec")
+_COLLECTIVES = ("psum", "pmean", "pmax", "pmin", "all_gather",
+                "psum_scatter", "all_to_all", "axis_index", "pswapaxes",
+                "pcast_varying", "ppermute")
+_AXIS_KWARGS = ("axis_name", "axis_names")
+
+
+def _axis_literals(node: ast.AST) -> Iterable[Tuple[str, ast.AST]]:
+    """String literals inside an axis-bearing expression (tuples/sets/
+    lists recursed; anything else skipped)."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        yield node.value, node
+    elif isinstance(node, (ast.Tuple, ast.List, ast.Set)):
+        for e in node.elts:
+            yield from _axis_literals(e)
+
+
+@register
+class ShardingContractPass(LintPass):
+    id = "sharding-contract"
+    title = "donations hold across call boundaries; mesh axes come " \
+            "from the declared registry"
+    scope = ()            # axis literals are checked corpus-wide
+
+    def __init__(self) -> None:
+        self._index: Optional[CorpusIndex] = None
+        self._axes: Set[str] = set(DEFAULT_AXES)
+
+    # ------------------------------------------------------- phase 1
+    def begin(self, corpus: Corpus) -> None:
+        self._index = ensure_index(corpus)
+        self._axes = self._load_registry(corpus)
+
+    @staticmethod
+    def _load_registry(corpus: Corpus) -> Set[str]:
+        ctx = corpus.by_relpath(AXIS_REGISTRY_PATH)
+        if ctx is None or ctx.tree is None:
+            return set(DEFAULT_AXES)
+        axes: Set[str] = set()
+        for node in ctx.tree.body:
+            if not isinstance(node, ast.Assign):
+                continue
+            for tgt in node.targets:
+                if not isinstance(tgt, ast.Name):
+                    continue
+                if tgt.id.endswith("_AXIS") \
+                        and isinstance(node.value, ast.Constant) \
+                        and isinstance(node.value.value, str):
+                    axes.add(node.value.value)
+                elif tgt.id == "MESH_AXES":
+                    axes.update(v for v, _ in _axis_literals(node.value))
+        return axes or set(DEFAULT_AXES)
+
+    # ------------------------------------------------------- phase 2
+    def check_file(self, ctx: FileContext) -> Iterable:
+        yield from self._check_axes(ctx)
+        if any(ctx.relpath.startswith(s) for s in DONATION_SCOPES):
+            yield from self._check_donation(ctx)
+
+    def _check_donation(self, ctx: FileContext) -> Iterable:
+        idx = self._index
+        if idx is None:
+            return
+        module = module_name(ctx.relpath)
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                continue
+            qual = ctx.symbol(node) or node.name
+
+            def resolve(call: ast.Call, _qual=qual):
+                return idx.summary_for_call(module, _qual, call)
+
+            def resolve_alias(call: ast.Call, _qual=qual):
+                return idx.alias_positions_for_call(module, _qual, call)
+
+            yield from scan_function(
+                ctx, node, pass_id=self.id, resolve_call=resolve,
+                resolve_alias=resolve_alias,
+                track_local_binds=False,
+                suggestion="use the callee's outputs (rebind the "
+                "reference), read before the donating call, or make "
+                "the helper consume-and-return-fresh")
+
+    def _check_axes(self, ctx: FileContext) -> Iterable:
+        if not self._axes:
+            return
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = call_name(node)
+            sites = []
+            if name in _SPEC_CALLS:
+                for a in node.args:
+                    sites.extend(_axis_literals(a))
+            elif name == "Mesh" and len(node.args) >= 2:
+                sites.extend(_axis_literals(node.args[1]))
+            elif name in _COLLECTIVES:
+                # axis position: `axis_index(axis)` takes it first,
+                # every other collective takes (value, axis)
+                p = 0 if name == "axis_index" else 1
+                for a in node.args[p:p + 1]:
+                    sites.extend(_axis_literals(a))
+            for kw in node.keywords:
+                if kw.arg in _AXIS_KWARGS:
+                    sites.extend(_axis_literals(kw.value))
+            for axis, site in sites:
+                if axis not in self._axes:
+                    yield ctx.finding(
+                        self.id, site,
+                        f"mesh axis `{axis}` is not in the declared "
+                        "axis registry "
+                        f"({', '.join(sorted(self._axes))}) — sharding "
+                        "onto an undeclared axis fails at trace time "
+                        "on the first multi-axis mesh",
+                        suggestion="use a registered axis from "
+                        "parallel/topology.py MESH_AXES (or register "
+                        "the new axis there, once, with its meaning)")

@@ -1,0 +1,243 @@
+"""vmem-budget — kernels and committed kernel plans fit VMEM (ISSUE 15).
+
+A Pallas kernel that over-subscribes VMEM fails at Mosaic lowering — on
+the TPU, at serving-rollout time, long after the plan that caused it
+was committed.  This pass moves that failure into the lint, sharing ONE
+capacity table with the kernels themselves
+(``ops/autotune.py``: ``DEFAULT_VMEM_MB`` per generation,
+``SCOPED_VMEM_MAX_MB`` for kernels that raise Mosaic's scoped limit —
+the same constants ``decode_step._entry_vmem_mha`` clamps with):
+
+  * **per-kernel scratch audit**: for every ``pl.pallas_call``, the
+    constant-foldable ``pltpu.VMEM(shape, dtype)`` scratch entries are
+    summed (a PARTIAL sum is a lower bound, so exceeding the budget on
+    provable entries alone is already a certain violation).  The budget
+    is the call's own ``vmem_limit_bytes`` when it folds (clamped to
+    the scoped max), else the per-generation default.  A declared
+    ``vmem_limit_bytes`` above the scoped max is flagged outright.
+  * **committed-plan audit** (finalize): every entry in
+    ``AUTOTUNE_KERNELS_MEASURED.json`` must fit — ``vmem_mb`` within
+    the scoped clamp, and the plan's own resident footprint (4 chunk
+    double-buffers for ``decode_step``'s ``bg``/``cs``, 2 int8 weight
+    slots for ``int8_matmul_dma``'s ``bd``/``be``) inside the VMEM it
+    declares.  A hand-edited or stale plan that cannot fit fails the
+    LINT instead of the first TPU run.
+
+Data-dependent scratch shapes fold to unknown and stay silent — the
+dynamic plan resolvers (``_resolve_plan`` re-validation) own those.
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+import os
+import re
+from typing import Iterable, Optional
+
+from deepspeed_tpu.analysis.core import Corpus, FileContext, Finding, \
+    LintPass, register
+from deepspeed_tpu.analysis.passes._pallas_util import (
+    DTYPES, Env, collect_assigns, is_call_named, iter_pallas_calls)
+
+SCOPES = ("deepspeed_tpu/ops/",)
+
+ARTIFACT_NAME = "AUTOTUNE_KERNELS_MEASURED.json"
+
+_DECODE_KEY = re.compile(
+    r"^b(?P<b>\d+)_hkv(?P<hkv>\d+)_s(?P<s>\d+)_dh(?P<dh>\d+)_e(?P<e>\d+)$")
+_MATMUL_KEY = re.compile(r"^d(?P<d>\d+)_e(?P<e>\d+)$")
+
+
+AUTOTUNE_PATH = "deepspeed_tpu/ops/autotune.py"
+
+
+def _budget_constants(corpus: Optional[Corpus] = None):
+    """The one shared capacity table, read from the ANALYZED corpus's
+    ``ops/autotune.py`` when it ships one (the lint tracks the code
+    under ``--root``, not the installed copy — same convention as the
+    sharding-contract axis registry, and the reason ``autotune.py`` is
+    a cache ``GLOBAL_INPUT``); synthetic trees without the file fall
+    back to the installed constants."""
+    if corpus is not None:
+        vals = {}
+        for ctx in corpus.files:
+            if ctx.relpath != AUTOTUNE_PATH or ctx.tree is None:
+                continue
+            for node in ctx.tree.body:
+                if isinstance(node, ast.Assign) \
+                        and len(node.targets) == 1 \
+                        and isinstance(node.targets[0], ast.Name) \
+                        and isinstance(node.value, ast.Constant) \
+                        and isinstance(node.value.value, int):
+                    vals[node.targets[0].id] = node.value.value
+        if "DEFAULT_VMEM_MB" in vals and "SCOPED_VMEM_MAX_MB" in vals:
+            return vals["DEFAULT_VMEM_MB"], vals["SCOPED_VMEM_MAX_MB"]
+    from deepspeed_tpu.ops import autotune
+
+    return autotune.DEFAULT_VMEM_MB, autotune.SCOPED_VMEM_MAX_MB
+
+
+def _is_vmem(node: ast.AST) -> bool:
+    return is_call_named(node, "VMEM")
+
+
+@register
+class VmemBudgetPass(LintPass):
+    id = "vmem-budget"
+    title = "kernel scratch and committed kernel plans fit the VMEM " \
+            "table"
+    scope = SCOPES
+
+    def begin(self, corpus: Corpus) -> None:
+        self._table = _budget_constants(corpus)
+
+    # ----------------------------------------------- per-kernel audit
+    def check_file(self, ctx: FileContext) -> Iterable:
+        if "pallas" not in ctx.source:
+            return
+        default_mb, max_mb = getattr(self, "_table", None) \
+            or _budget_constants()
+        module_assigns = collect_assigns(ctx.tree)
+        for info, env in iter_pallas_calls(ctx.tree, module_assigns):
+            budget = default_mb << 20
+            declared = env.fold(info.vmem_limit_node) \
+                if info.vmem_limit_node is not None else None
+            if isinstance(declared, int):
+                if declared > (max_mb << 20):
+                    yield ctx.finding(
+                        self.id, info.vmem_limit_node,
+                        f"vmem_limit_bytes {declared} exceeds the "
+                        f"scoped-VMEM max ({max_mb} MB) from the "
+                        "ops/autotune.py capacity table",
+                        suggestion="lower the scoped limit or split "
+                        "the kernel's residency")
+                budget = min(declared, max_mb << 20)
+            elif info.vmem_limit_node is not None:
+                # a declared-but-unfoldable limit (plan-resolved, e.g.
+                # `plan.vmem_mb << 20`) may legitimately raise the
+                # scope: budget at the scoped MAX, never the default —
+                # the pass can miss, never hallucinate
+                budget = max_mb << 20
+            provable = 0
+            for s in info.scratch:
+                if not _is_vmem(s) or len(s.args) < 2:
+                    continue
+                dims = env.fold_dims(s.args[0])
+                dtype = env.resolve_dtype(s.args[1])
+                if not dims or dtype not in DTYPES \
+                        or any(not isinstance(d, int) for d in dims):
+                    continue
+                n = DTYPES[dtype][0]
+                for d in dims:
+                    n *= d
+                provable += n
+            if provable > budget:
+                yield ctx.finding(
+                    self.id, info.node,
+                    f"constant-foldable VMEM scratch alone totals "
+                    f"{provable} bytes against a "
+                    f"{budget >> 20} MB budget — this kernel cannot "
+                    "lower on any generation in the table",
+                    suggestion="shrink the scratch tiles or raise "
+                    "vmem_limit_bytes within the scoped max "
+                    "(ops/autotune.py SCOPED_VMEM_MAX_MB)")
+
+    # -------------------------------------------- committed-plan audit
+    def finalize(self, corpus: Corpus) -> Iterable:
+        path = os.path.join(corpus.root, ARTIFACT_NAME)
+        if not os.path.exists(path):
+            return
+        default_mb, max_mb = _budget_constants(corpus)
+        try:
+            with open(path, "r", encoding="utf-8") as f:
+                art = json.load(f)
+            plans = art.get("plans", {})
+            if not isinstance(plans, dict):
+                raise ValueError("plans is not an object")
+        except (OSError, ValueError) as e:
+            yield Finding(self.id, ARTIFACT_NAME, 1, 0,
+                          f"unreadable kernel-plan artifact: {e}",
+                          suggestion="regenerate with "
+                          "scripts/autotune_kernels.py")
+            return
+        for kind, entries in sorted(plans.items()):
+            if not isinstance(entries, dict):
+                continue
+            for key, ent in sorted(entries.items()):
+                if not isinstance(ent, dict):
+                    continue
+                yield from self._check_entry(kind, key, ent,
+                                             default_mb, max_mb)
+
+    def _check_entry(self, kind: str, key: str, ent: dict,
+                     default_mb: int, max_mb: int) -> Iterable:
+        loc = f"plans.{kind}.{key}"
+        vmem_mb = ent.get("vmem_mb")
+        # the clamp must MATCH decode_step._entry_vmem_mha:
+        # max(DEFAULT_VMEM_MB, min(vmem_mb, SCOPED_VMEM_MAX_MB)) — a
+        # plan below the floor is re-clamped UP on device just as one
+        # above the ceiling is re-clamped down
+        if isinstance(vmem_mb, (int, float)) \
+                and not default_mb <= vmem_mb <= max_mb:
+            yield Finding(
+                self.id, ARTIFACT_NAME, 1, 0,
+                f"{loc}: vmem_mb={vmem_mb} outside the scoped clamp "
+                f"[{default_mb}, {max_mb}] (ops/autotune.py) — the "
+                "kernel would silently re-clamp and the measurement "
+                "lies",
+                symbol=loc,
+                suggestion="re-measure with a plan inside the clamp")
+            return
+        if kind == "decode_step":
+            m = _DECODE_KEY.match(key)
+            if not m:
+                yield Finding(self.id, ARTIFACT_NAME, 1, 0,
+                              f"{loc}: malformed decode_step shape key",
+                              severity="warning", symbol=loc,
+                              suggestion="keys come from "
+                              "autotune.decode_key(...)")
+                return
+            bg, cs = ent.get("bg"), ent.get("cs")
+            if not (isinstance(bg, int) and isinstance(cs, int)):
+                return
+            hkv = int(m.group("hkv"))
+            dh = int(m.group("dh"))
+            itemsize = int(m.group("e"))
+            # 2 slots x {K, V} chunk double-buffers resident at once
+            resident = 4 * bg * hkv * cs * dh * itemsize
+            budget_mb = vmem_mb if isinstance(vmem_mb, (int, float)) \
+                else max_mb
+            if resident > int(budget_mb) << 20:
+                yield Finding(
+                    self.id, ARTIFACT_NAME, 1, 0,
+                    f"{loc}: committed plan (bg={bg}, cs={cs}) needs "
+                    f"{resident} bytes of chunk double-buffers but "
+                    f"declares only {budget_mb} MB of scoped VMEM — "
+                    "this plan cannot fit; it would fail Mosaic "
+                    "lowering on the first TPU run",
+                    symbol=loc,
+                    suggestion="re-measure; the harness must reject "
+                    "candidates whose chunks outgrow vmem_mb")
+        elif kind == "int8_matmul_dma":
+            if not _MATMUL_KEY.match(key):
+                yield Finding(self.id, ARTIFACT_NAME, 1, 0,
+                              f"{loc}: malformed int8_matmul_dma key",
+                              severity="warning", symbol=loc,
+                              suggestion="keys come from "
+                              "autotune.matmul_key(d, e)")
+                return
+            bd, be = ent.get("bd"), ent.get("be")
+            if isinstance(bd, int) and isinstance(be, int):
+                resident = 2 * bd * be       # two int8 weight slots
+                if resident > default_mb << 20:
+                    yield Finding(
+                        self.id, ARTIFACT_NAME, 1, 0,
+                        f"{loc}: committed tile plan (bd={bd}, "
+                        f"be={be}) streams {resident} bytes of weight "
+                        f"slots against the {default_mb} MB default "
+                        "VMEM scope (int8_matmul_dma raises no scoped "
+                        "limit) — this plan cannot fit",
+                        symbol=loc,
+                        suggestion="re-measure under the tile cap "
+                        "(_hand_dma_plan's VMEM budget)")

@@ -1,0 +1,267 @@
+"""SARIF 2.1.0 output for dstpu-lint (ISSUE 15).
+
+One run object, one result per unsuppressed finding, pass id → ruleId,
+severity → level — the shape CI annotators (GitHub code scanning et
+al.) ingest to pin findings onto diff lines.  Baseline drift is
+reported too (stale entries / over-budget as ``baseline`` rule
+results), so a SARIF consumer sees exactly what makes the CLI exit
+non-zero.
+
+:func:`validate_sarif` is a structural validator for the subset of the
+SARIF 2.1.0 schema this emitter uses; the unit tests run every emitted
+document through it (and through ``jsonschema`` against the embedded
+subset schema when the library is available — the full 2.1.0 schema is
+not vendored).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List
+
+SARIF_VERSION = "2.1.0"
+SARIF_SCHEMA = ("https://raw.githubusercontent.com/oasis-tcs/"
+                "sarif-spec/master/Schemata/sarif-schema-2.1.0.json")
+
+#: synthetic rule ids the framework itself can emit (no LintPass object)
+_FRAMEWORK_RULES = {
+    "lint-directive": "suppression directives are well-formed and live",
+    "lint-parse": "every in-scope file parses",
+    "baseline": "the committed baseline matches the tree and its budget",
+}
+
+_LEVELS = {"error": "error", "warning": "warning"}
+
+
+def to_sarif(result, passes: Dict[str, object], tool_version: str = "15"
+             ) -> dict:
+    """``LintResult`` → SARIF 2.1.0 document (one run)."""
+    rule_ids: List[str] = []
+    rules = []
+    for pid in result.passes_run:
+        p = passes.get(pid)
+        rule_ids.append(pid)
+        rules.append({
+            "id": pid,
+            "shortDescription": {
+                "text": getattr(p, "title", "") or pid},
+        })
+    for pid, text in _FRAMEWORK_RULES.items():
+        rule_ids.append(pid)
+        rules.append({"id": pid, "shortDescription": {"text": text}})
+    rule_index = {pid: i for i, pid in enumerate(rule_ids)}
+
+    results = []
+    for f in result.findings:
+        msg = f.message + (f"\nfix: {f.suggestion}" if f.suggestion
+                           else "")
+        results.append({
+            "ruleId": f.pass_id,
+            "ruleIndex": rule_index.get(f.pass_id, -1),
+            "level": _LEVELS.get(f.severity, "error"),
+            "message": {"text": msg},
+            "locations": [{
+                "physicalLocation": {
+                    "artifactLocation": {"uri": f.path,
+                                         "uriBaseId": "SRCROOT"},
+                    "region": {"startLine": max(f.line, 1),
+                               "startColumn": f.col + 1},
+                },
+            }],
+        })
+    for e in result.stale_baseline:
+        results.append({
+            "ruleId": "baseline",
+            "ruleIndex": rule_index["baseline"],
+            "level": "error",
+            "message": {"text": f"stale baseline entry [{e.pass_id}] "
+                                f"{e.message!r} matches nothing — "
+                                "remove it (burn-down)"},
+            "locations": [{
+                "physicalLocation": {
+                    "artifactLocation": {"uri": e.path or
+                                         "LINT_BASELINE.json",
+                                         "uriBaseId": "SRCROOT"},
+                    "region": {"startLine": 1, "startColumn": 1},
+                },
+            }],
+        })
+    if result.over_budget:
+        results.append({
+            "ruleId": "baseline",
+            "ruleIndex": rule_index["baseline"],
+            "level": "error",
+            "message": {"text": f"{result.over_budget} baseline "
+                                "entr(ies) over the committed budget — "
+                                "the baseline only burns down"},
+            "locations": [{
+                "physicalLocation": {
+                    "artifactLocation": {"uri": "LINT_BASELINE.json",
+                                         "uriBaseId": "SRCROOT"},
+                    "region": {"startLine": 1, "startColumn": 1},
+                },
+            }],
+        })
+
+    return {
+        "$schema": SARIF_SCHEMA,
+        "version": SARIF_VERSION,
+        "runs": [{
+            "tool": {"driver": {
+                "name": "dstpu-lint",
+                "informationUri":
+                    "README.md#static-analysis-dstpu-lint",
+                "version": tool_version,
+                "rules": rules,
+            }},
+            "columnKind": "utf16CodeUnits",
+            "results": results,
+        }],
+    }
+
+
+#: JSON-Schema for the emitted subset — used with ``jsonschema`` in the
+#: unit tests when available, mirrored by :func:`validate_sarif` below
+SARIF_SUBSET_SCHEMA = {
+    "type": "object",
+    "required": ["$schema", "version", "runs"],
+    "properties": {
+        "version": {"const": "2.1.0"},
+        "$schema": {"type": "string"},
+        "runs": {
+            "type": "array", "minItems": 1,
+            "items": {
+                "type": "object",
+                "required": ["tool", "results"],
+                "properties": {
+                    "tool": {
+                        "type": "object", "required": ["driver"],
+                        "properties": {"driver": {
+                            "type": "object", "required": ["name"],
+                            "properties": {
+                                "name": {"type": "string"},
+                                "rules": {
+                                    "type": "array",
+                                    "items": {
+                                        "type": "object",
+                                        "required": ["id"],
+                                        "properties": {
+                                            "id": {"type": "string"}},
+                                    }},
+                            }}},
+                    },
+                    "results": {
+                        "type": "array",
+                        "items": {
+                            "type": "object",
+                            "required": ["ruleId", "level", "message",
+                                         "locations"],
+                            "properties": {
+                                "ruleId": {"type": "string"},
+                                "level": {"enum": ["none", "note",
+                                                   "warning", "error"]},
+                                "message": {
+                                    "type": "object",
+                                    "required": ["text"],
+                                    "properties": {"text": {
+                                        "type": "string"}}},
+                                "locations": {
+                                    "type": "array", "minItems": 1,
+                                    "items": {
+                                        "type": "object",
+                                        "required": ["physicalLocation"],
+                                        "properties": {
+                                            "physicalLocation": {
+                                                "type": "object",
+                                                "required": [
+                                                    "artifactLocation"],
+                                                "properties": {
+                                                    "artifactLocation": {
+                                                        "type": "object",
+                                                        "required": [
+                                                            "uri"],
+                                                    },
+                                                    "region": {
+                                                        "type": "object",
+                                                        "properties": {
+                                                            "startLine": {
+                                                                "type":
+                                                                "integer",
+                                                                "minimum":
+                                                                1},
+                                                            "startColumn":
+                                                            {"type":
+                                                             "integer",
+                                                             "minimum":
+                                                             1},
+                                                        }},
+                                                }},
+                                        }},
+                                },
+                            }},
+                    },
+                }},
+        },
+    },
+}
+
+
+def validate_sarif(doc) -> List[str]:
+    """Structural problems in ``doc`` against the SARIF 2.1.0 subset
+    this tool emits (empty list == valid).  Dependency-free mirror of
+    :data:`SARIF_SUBSET_SCHEMA` for environments without jsonschema."""
+    probs: List[str] = []
+
+    def need(obj, key, typ, where):
+        if not isinstance(obj, dict) or key not in obj:
+            probs.append(f"{where}: missing {key!r}")
+            return None
+        if typ is not None and not isinstance(obj[key], typ):
+            probs.append(f"{where}.{key}: expected {typ.__name__}")
+            return None
+        return obj[key]
+
+    if not isinstance(doc, dict):
+        return ["document is not an object"]
+    if doc.get("version") != SARIF_VERSION:
+        probs.append(f"version: expected {SARIF_VERSION!r}")
+    need(doc, "$schema", str, "$")
+    runs = need(doc, "runs", list, "$") or []
+    if not runs:
+        probs.append("runs: must have at least one run")
+    for i, run in enumerate(runs):
+        where = f"runs[{i}]"
+        tool = need(run, "tool", dict, where) or {}
+        driver = need(tool, "driver", dict, f"{where}.tool") or {}
+        need(driver, "name", str, f"{where}.tool.driver")
+        for j, rule in enumerate(driver.get("rules", []) or []):
+            need(rule, "id", str, f"{where}...rules[{j}]")
+        results = need(run, "results", list, where) or []
+        for j, r in enumerate(results):
+            rw = f"{where}.results[{j}]"
+            need(r, "ruleId", str, rw)
+            lvl = need(r, "level", str, rw)
+            if lvl is not None and lvl not in ("none", "note", "warning",
+                                               "error"):
+                probs.append(f"{rw}.level: invalid {lvl!r}")
+            msg = need(r, "message", dict, rw) or {}
+            need(msg, "text", str, f"{rw}.message")
+            locs = need(r, "locations", list, rw) or []
+            if not locs:
+                probs.append(f"{rw}.locations: empty")
+            for k, loc in enumerate(locs):
+                pl = need(loc, "physicalLocation", dict,
+                          f"{rw}.locations[{k}]") or {}
+                al = need(pl, "artifactLocation", dict,
+                          f"{rw}.locations[{k}].physicalLocation") or {}
+                need(al, "uri", str,
+                     f"{rw}.locations[{k}]...artifactLocation")
+                region = pl.get("region")
+                if isinstance(region, dict):
+                    for fld in ("startLine", "startColumn"):
+                        v = region.get(fld)
+                        if v is not None and (not isinstance(v, int)
+                                              or v < 1):
+                            probs.append(
+                                f"{rw}...region.{fld}: must be a "
+                                f"positive integer, got {v!r}")
+    return probs

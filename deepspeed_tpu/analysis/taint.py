@@ -1,0 +1,292 @@
+"""Shared donated-buffer taint engine (ISSUE 15).
+
+One linearized read-after-donate scan used by BOTH donation passes:
+
+  * ``donation-safety`` (per-scope): taint sources are donating
+    callables BOUND IN THE SAME FUNCTION (``f = jax.jit(g,
+    donate_argnums=...)``) — PR 13's pass, now with the known
+    false-negative shapes fixed (below);
+  * ``sharding-contract`` (interprocedural): taint sources are resolved
+    through the phase-1 index — a call to a helper whose summary says
+    it donates, or to a donating callable stored on ``self`` in another
+    method / bound at module level.  The two source sets are disjoint
+    by construction, so the passes never double-report one read.
+
+Semantics (ported from PR 13's donation pass, behavior-pinned by its
+tests): events (loads, donating calls, stores, function exits) are
+linearized by source position with same-line priority ordering loads →
+call → stores → exits, so ``x = f(x)`` never taints; stores clear taint
+(and a store of ``self.state`` revives ``self.state.params``); a
+Return/Raise clears only donations made in its own branch subtree, so a
+conditional early return cannot launder the fallthrough path.
+
+ISSUE 15 regression fixes (each pinned by a fixture):
+
+  * **augmented assignment reads** — ``x += 1`` after donating ``x`` is
+    a READ of the stale buffer before the store; the old pass saw only
+    the Store ctx and silently cleared the taint;
+  * **try/finally** — a ``return`` inside a ``try`` that has a
+    ``finally`` defers its taint-clear until AFTER the last finally
+    line: the finally body still runs (a donated read there must flag)
+    but the post-try fallthrough of the returning branch is dead and
+    must not false-positive;
+  * **tuple-bound donating callables** — ``f, g = jax.jit(a,
+    donate_argnums=(0,)), jax.jit(b)`` now registers ``f`` as a donor
+    (the old pass only looked at single-target assigns).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Callable, Dict, Iterable, List, Optional, Set, Tuple
+
+# one canonical copy of the jit/donate-argnums parsing (index.py is the
+# cycle-free home; a drift between the per-scope pass and the
+# interprocedural summaries would silently desynchronize the two)
+from deepspeed_tpu.analysis.index import (attr_chain,   # noqa: F401
+                                          donated_positions, is_jit_call)
+
+#: resolve a call node to (donated call-arg positions, provenance text);
+#: return ((), "") when the call is not a known donor
+CallResolver = Callable[[ast.Call], Tuple[Tuple[int, ...], str]]
+
+#: resolve a call node to the call-arg positions its return value
+#: aliases (returns-alias-of-arg); () when unknown
+AliasResolver = Callable[[ast.Call], Tuple[int, ...]]
+
+
+def walk_scope(fn: ast.AST, _path: Tuple = (),
+               _trys: Optional[Dict[int, ast.Try]] = None):
+    """Walk one function's OWN body — never descending into nested
+    function/class scopes.  Yields ``(node, branch_path)`` where
+    branch_path identifies the chain of conditional arms the node sits
+    in (``(id(stmt), arm), ...``).  ``_trys`` (shared dict) collects
+    Try nodes so exit handling can see ``finalbody``."""
+    for field_name, value in ast.iter_fields(fn):
+        branches = ()
+        if isinstance(fn, (ast.If, ast.For, ast.AsyncFor, ast.While,
+                           ast.Try)) and field_name in (
+                "body", "orelse", "handlers", "finalbody"):
+            branches = ((id(fn), field_name),)
+            if isinstance(fn, ast.Try) and _trys is not None:
+                _trys[id(fn)] = fn
+        for child in (value if isinstance(value, list) else [value]):
+            if not isinstance(child, ast.AST):
+                continue
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.Lambda, ast.ClassDef)):
+                continue
+            path = _path + branches
+            yield child, path
+            yield from walk_scope(child, path, _trys)
+
+
+def ref_of(node: ast.AST) -> str:
+    """Canonical dotted name for a Name / self-attribute chain ('' when
+    the expression is not a trackable reference)."""
+    chain = attr_chain(node)
+    if chain and (chain.count(".") == 0 or chain.startswith("self.")):
+        return chain
+    return ""
+
+
+class _Event:
+    __slots__ = ("pos", "kind", "name", "node", "path", "extra")
+
+    def __init__(self, pos, kind, name, node, path=(), extra=None):
+        self.pos, self.kind, self.name = pos, kind, name
+        self.node, self.path, self.extra = node, path, extra
+
+
+def scan_function(ctx, fn: ast.AST, *, pass_id: str,
+                  resolve_call: Optional[CallResolver] = None,
+                  resolve_alias: Optional[AliasResolver] = None,
+                  track_local_binds: bool = True,
+                  suggestion: str = "read the value BEFORE the donating "
+                  "call, use the call's outputs, or drop the donation",
+                  ) -> Iterable:
+    """Yield read-after-donate findings for one function scope.
+
+    ``resolve_alias`` (interprocedural only) consumes the phase-1
+    ``returns_args`` summaries: ``y = view(x)`` where ``view`` returns
+    its argument links ``y`` and ``x`` to ONE buffer, so a later
+    donation of either taints both — the alias-laundering shape no
+    per-name scan can see."""
+    trys: Dict[int, ast.Try] = {}
+    events: List[_Event] = []
+    binds: List[Tuple[tuple, str, Tuple[int, ...]]] = []
+
+    if track_local_binds:
+        for node, _ in walk_scope(fn, _trys={}):
+            if not isinstance(node, ast.Assign):
+                continue
+            pairs: List[Tuple[ast.AST, ast.AST]] = []
+            for tgt in node.targets:
+                if isinstance(tgt, (ast.Tuple, ast.List)) \
+                        and isinstance(node.value, (ast.Tuple, ast.List)) \
+                        and len(tgt.elts) == len(node.value.elts):
+                    pairs += list(zip(tgt.elts, node.value.elts))
+                else:
+                    pairs.append((tgt, node.value))
+            for tgt, val in pairs:
+                if not is_jit_call(val):
+                    continue
+                pos = donated_positions(val)
+                if not pos:
+                    continue
+                name = ref_of(tgt)
+                if name:
+                    # 2.5: after the plain store event at the same spot
+                    # (which unbinds), so the bind wins
+                    binds.append(((tgt.lineno, 2.5, tgt.col_offset),
+                                  name, pos))
+    bindable = {name for _, name, _ in binds}
+    if track_local_binds and not binds and resolve_call is None:
+        return
+    for pos, name, positions in binds:
+        events.append(_Event(pos, "bind", name, positions))
+
+    # Linearize loads / stores / donating calls by source position.
+    # Priority orders same-line events the way evaluation does: loads
+    # (RHS) -> the donating call -> stores (LHS binds last) -> exits;
+    # `x = f(x)` therefore never taints x.
+    for node, path in walk_scope(fn, _trys=trys):
+        if isinstance(node, ast.Call):
+            cname = ref_of(node.func)
+            if cname and cname in bindable:
+                events.append(_Event((node.lineno, 1, node.col_offset),
+                                     "call", cname, node, path))
+            elif resolve_call is not None:
+                positions, via = resolve_call(node)
+                if positions:
+                    events.append(_Event(
+                        (node.lineno, 1, node.col_offset), "xcall", "",
+                        node, path, extra=(positions, via)))
+        elif isinstance(node, (ast.Return, ast.Raise)):
+            # control leaves the function: donations made in this exit's
+            # own branch subtree are dead for later source lines — but a
+            # conditional early return must NOT launder the fallthrough
+            # path.  A return inside try-with-finally must not launder
+            # the finally body (it still runs), yet it DOES kill the
+            # post-try fallthrough of its own branch — so the clear is
+            # DEFERRED to just after the last finally line instead of
+            # dropped entirely.
+            finals = [trys[id_] for id_, fld in path
+                      if fld != "finalbody" and id_ in trys
+                      and trys[id_].finalbody]
+            if finals:
+                end = max(getattr(stmt, "end_lineno", stmt.lineno)
+                          for t in finals for stmt in t.finalbody)
+                pos = (end, 3.5, 0)
+            else:
+                pos = (getattr(node, "end_lineno", node.lineno), 3, 0)
+            events.append(_Event(pos, "exit", "", node, path))
+        elif isinstance(node, ast.Assign) and resolve_alias is not None \
+                and isinstance(node.value, ast.Call):
+            srcs = ()
+            positions = resolve_alias(node.value)
+            if positions:
+                srcs = {ref_of(node.value.args[p]) for p in positions
+                        if p < len(node.value.args)} - {""}
+            if srcs:
+                for tgt in node.targets:
+                    name = ref_of(tgt)
+                    # 2.25: after the store event (which unbinds the
+                    # target), so the alias link wins for later lines;
+                    # `x = view(x)` stays the canonical clean rebind
+                    if name and name not in srcs:
+                        events.append(_Event(
+                            (node.lineno, 2.25, tgt.col_offset),
+                            "alias", name, node, path, extra=srcs))
+        elif isinstance(node, ast.AugAssign):
+            # `x += 1` READS x before rebinding it: the read of a
+            # donated buffer must flag even though the ctx is Store
+            name = ref_of(node.target)
+            if name:
+                events.append(_Event(
+                    (node.lineno, 0, node.target.col_offset), "load",
+                    name, node.target))
+        elif isinstance(node, (ast.Name, ast.Attribute)):
+            name = ref_of(node)
+            if not name:
+                continue
+            if isinstance(node.ctx, ast.Store):
+                events.append(_Event((node.lineno, 2, node.col_offset),
+                                     "store", name, node))
+            elif isinstance(node.ctx, ast.Load):
+                events.append(_Event((node.lineno, 0, node.col_offset),
+                                     "load", name, node))
+    events.sort(key=lambda e: e.pos)
+
+    bound: Dict[str, Tuple[int, ...]] = {}   # name -> donated argnums
+    tainted: Dict[str, tuple] = {}   # ref -> (donor call, branch path, via)
+    aliases: Dict[str, Set[str]] = {}   # ref -> SHARED alias group set
+    reported: Set[Tuple[str, int]] = set()
+
+    def _taint(ref: str, info: tuple) -> None:
+        # donating one name stales every alias of the same buffer
+        for n in aliases.get(ref, {ref}):
+            tainted[n] = info
+
+    for ev in events:
+        if ev.kind == "exit":
+            for name in [n for n, (_, dpath, _) in tainted.items()
+                         if dpath[:len(ev.path)] == ev.path]:
+                tainted.pop(name)
+        elif ev.kind == "bind":
+            bound[ev.name] = ev.node   # node slot carries positions
+        elif ev.kind == "call" and ev.name in bound:
+            call = ev.node
+            for p in bound[ev.name]:
+                if p < len(call.args):
+                    ref = ref_of(call.args[p])
+                    if ref:
+                        _taint(ref, (
+                            call, ev.path,
+                            f"donated to the jit call on line "
+                            f"{call.lineno} (donate_argnums)"))
+        elif ev.kind == "xcall":
+            positions, via = ev.extra
+            call = ev.node
+            for p in positions:
+                if p < len(call.args):
+                    ref = ref_of(call.args[p])
+                    if ref:
+                        _taint(ref, (
+                            call, ev.path,
+                            f"donated by the call on line {call.lineno} "
+                            f"— {via}"))
+        elif ev.kind == "alias":
+            group: Set[str] = {ev.name}
+            for m in {ev.name} | set(ev.extra):
+                group |= aliases.get(m, {m})
+            for m in group:
+                aliases[m] = group
+            for m in ev.extra:      # alias OF a donated buffer is stale
+                if m in tainted:
+                    tainted[ev.name] = tainted[m]
+                    break
+        elif ev.kind == "store":
+            tainted.pop(ev.name, None)
+            bound.pop(ev.name, None)   # rebound to something else
+            grp = aliases.pop(ev.name, None)
+            if grp is not None:        # rebinding detaches from the group
+                grp.discard(ev.name)
+            # rebinding `self.state` also revives `self.state.params`
+            for t in [t for t in tainted if t.startswith(ev.name + ".")]:
+                tainted.pop(t, None)
+        elif ev.kind == "load" and ev.name in tainted:
+            donor, _, via = tainted[ev.name]
+            if ev.node.lineno <= getattr(donor, "end_lineno",
+                                         donor.lineno):
+                continue   # load inside/before the donating call
+                           # statement (evaluated pre-donation)
+            key = (ev.name, ev.node.lineno)
+            if key in reported:
+                continue
+            reported.add(key)
+            yield ctx.finding(
+                pass_id, ev.node,
+                f"`{ev.name}` was {via} and read here: the buffer may "
+                "already be reused in place",
+                suggestion=suggestion)

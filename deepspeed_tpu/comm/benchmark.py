@@ -28,11 +28,12 @@ def run_collective_bench(op: str = "all_reduce", sizes: List[int] = None,
     import jax.numpy as jnp
     from jax.sharding import Mesh, PartitionSpec as P
 
+    from deepspeed_tpu.parallel.topology import DATA_AXIS
     from deepspeed_tpu.utils.jax_compat import shard_map
 
     devices = jax.devices()
     n = len(devices)
-    mesh = Mesh(devices, ("x",))
+    mesh = Mesh(devices, (DATA_AXIS,))
     dtype = getattr(jnp, dtype_str)
     sizes = sizes or [2 ** p for p in range(12, 27, 2)]  # 4KB..512MB elems/4
     results = []
@@ -40,18 +41,18 @@ def run_collective_bench(op: str = "all_reduce", sizes: List[int] = None,
         x = jnp.ones((n, numel // n if op != "all_gather" else numel), dtype)
 
         if op == "all_reduce":
-            fn = shard_map(lambda a: jax.lax.psum(a, "x"), mesh=mesh,
-                           in_specs=P("x"), out_specs=P("x"))
+            fn = shard_map(lambda a: jax.lax.psum(a, DATA_AXIS), mesh=mesh,
+                           in_specs=P(DATA_AXIS), out_specs=P(DATA_AXIS))
         elif op == "all_gather":
-            fn = shard_map(lambda a: jax.lax.all_gather(a, "x", tiled=True),
-                           mesh=mesh, in_specs=P("x"), out_specs=P())
+            fn = shard_map(lambda a: jax.lax.all_gather(a, DATA_AXIS, tiled=True),
+                           mesh=mesh, in_specs=P(DATA_AXIS), out_specs=P())
         elif op == "reduce_scatter":
-            fn = shard_map(lambda a: jax.lax.psum_scatter(a, "x", tiled=True),
-                           mesh=mesh, in_specs=P("x"), out_specs=P("x"))
+            fn = shard_map(lambda a: jax.lax.psum_scatter(a, DATA_AXIS, tiled=True),
+                           mesh=mesh, in_specs=P(DATA_AXIS), out_specs=P(DATA_AXIS))
         elif op == "all_to_all":
             fn = shard_map(lambda a: jax.lax.all_to_all(
-                a.reshape(n, -1), "x", 0, 0, tiled=False).reshape(a.shape),
-                mesh=mesh, in_specs=P("x"), out_specs=P("x"))
+                a.reshape(n, -1), DATA_AXIS, 0, 0, tiled=False).reshape(a.shape),
+                mesh=mesh, in_specs=P(DATA_AXIS), out_specs=P(DATA_AXIS))
         else:
             raise ValueError(f"unknown op '{op}'")
         jfn = jax.jit(fn)

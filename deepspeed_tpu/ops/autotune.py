@@ -51,6 +51,18 @@ from typing import Optional
 
 ENV_PATH = "DSTPU_KERNEL_PLANS"   # artifact path override; "" disables
 
+# ---------------------------------------------------------- VMEM budget
+# Per-generation VMEM capacity table, shared between the kernels'
+# scoped-limit plumbing and the `vmem-budget` lint pass (ISSUE 15): a
+# committed kernel plan that cannot fit fails the LINT instead of the
+# first TPU run.  Every shipped generation exposes ~16 MB of VMEM per
+# core by default; Mosaic's scoped limit (vmem_limit_bytes) can be
+# raised for kernels that manage their own residency — decode_step runs
+# at 40 MB — but never past SCOPED_VMEM_MAX_MB, which is also the clamp
+# `_entry_vmem_mha` applies to artifact entries.
+DEFAULT_VMEM_MB = 16
+SCOPED_VMEM_MAX_MB = 128
+
 _REPO_ARTIFACT = os.path.join(
     os.path.dirname(os.path.abspath(__file__)), os.pardir, os.pardir,
     "AUTOTUNE_KERNELS_MEASURED.json")

@@ -133,8 +133,14 @@ def _entry_vmem_mha(ent: dict, vmem: int, mha: str):
     """Shared artifact-entry parsing for the per-kernel tunables both
     decode resolvers honor: the clamped VMEM scope and the rep==1
     score/PV engine (one implementation, so the two kernels can never
-    diverge in how they read the same schema)."""
-    vmem = max(16, min(int(ent.get("vmem_mb", vmem >> 20)), 128)) << 20
+    diverge in how they read the same schema).  The clamp bounds come
+    from the per-generation table in ops/autotune.py — the same table
+    the `vmem-budget` lint pass checks committed plans against."""
+    from deepspeed_tpu.ops import autotune
+
+    vmem = max(autotune.DEFAULT_VMEM_MB,
+               min(int(ent.get("vmem_mb", vmem >> 20)),
+                   autotune.SCOPED_VMEM_MAX_MB)) << 20
     if ent.get("mha") in ("mxu", "vpu"):
         mha = ent["mha"]
     return vmem, mha

@@ -44,6 +44,10 @@ EXPERT_AXIS = "expert"
 SEQ_AXIS = "seq"
 MODEL_AXIS = "model"
 
+# THE axis registry: every mesh axis name used as a literal anywhere in
+# the tree — P(...), shard_map axis_names, Mesh(...), collective axis
+# args — must come from here (machine-enforced by the sharding-contract
+# lint pass; register new axes in this tuple, once, with their meaning).
 MESH_AXES = (PIPE_AXIS, DATA_AXIS, EXPERT_AXIS, SEQ_AXIS, MODEL_AXIS)
 
 # Axes over which the *batch* dimension is sharded for dense computation.

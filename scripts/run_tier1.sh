@@ -61,16 +61,30 @@ grep -qs "def test_" tests/unit/serving/test_slo_plane.py || { echo "tier-1: slo
 # good twin, suppression/baseline round-trips, and the repo-clean
 # end-to-end pin ride `-m 'not slow'` through tests/unit/analysis/
 grep -qs "def test_" tests/unit/analysis/test_lint.py || { echo "tier-1: lint tests missing"; exit 1; }
-# dstpu-lint (ISSUE 14): machine-enforce the static contracts — zero
-# unsuppressed findings across host-sync (a reintroduced hot-path
-# device_get fails here), recompile-hazard (unbucketed jit keys),
-# typed-error (bare raises in serving/), jax-compat (direct
-# version-gated imports), donation-safety, metric-names (ISSUE 11
-# satellite, migrated: README drift), and slo-rules (ISSUE 13
-# satellite, migrated: DEFAULT_SLO_CONFIG validity). Exit codes:
-# 1 findings / 2 usage / 3 internal. The committed LINT_BASELINE.json
-# budget is the growth guard: the baseline only burns down.
-JAX_PLATFORMS=cpu python scripts/dstpu_lint.py || { echo "tier-1: dstpu-lint findings"; exit 1; }
+# dstpu-lint (ISSUE 14; prove upgrade ISSUE 15): machine-enforce the
+# static contracts — zero unsuppressed findings across host-sync (a
+# reintroduced hot-path device_get fails here), recompile-hazard
+# (unbucketed jit keys), typed-error (bare raises in serving/),
+# jax-compat (direct version-gated imports), donation-safety,
+# metric-names, slo-rules, plus the ISSUE 15 TPU-native families:
+# pallas-tile (dtype tile quanta — an int8 window off the 32-row
+# quantum fails here), pallas-dma (a dropped DMA .wait() fails here),
+# vmem-budget (committed kernel plans must fit the ops/autotune.py
+# VMEM table), and sharding-contract (interprocedural donation taint +
+# the mesh-axis registry). Exit codes: 1 findings / 2 usage /
+# 3 internal. Incremental mode first (per-file finding cache keyed on
+# content hashes — byte-identical output to a full run, pinned by
+# test); full-corpus fallback on usage/internal errors so a corrupt
+# cache or missing git can never mask findings. LINT_BASELINE.json's
+# committed budget stays the growth guard: the baseline only burns
+# down. Wall-clock stays under 60 s (pinned by
+# tests/unit/analysis/test_prove.py).
+JAX_PLATFORMS=cpu python scripts/dstpu_lint.py --changed-only; lint_rc=$?
+if [ "$lint_rc" -eq 2 ] || [ "$lint_rc" -eq 3 ]; then
+  echo "tier-1: incremental lint unavailable (rc=$lint_rc), full run"
+  JAX_PLATFORMS=cpu python scripts/dstpu_lint.py; lint_rc=$?
+fi
+[ "$lint_rc" -eq 0 ] || { echo "tier-1: dstpu-lint findings"; exit 1; }
 # bench-trajectory smoke (ISSUE 13 satellite): the markdown trend
 # report must render over the checked-in BENCH_r*.json round files
 python scripts/bench_trajectory.py --markdown > /dev/null || { echo "tier-1: bench trajectory markdown"; exit 1; }

@@ -303,14 +303,29 @@ _LATE_MODULES = _OBSERVABILITY_MODULES + (
     "unit/serving/test_tracing",
     "unit/serving/test_kv_quant",
     "unit/telemetry/test_slo_plane",
-    "unit/serving/test_slo_plane",
-    "unit/analysis/",)
+    "unit/serving/test_slo_plane",)
+
+# Dead-last group, AFTER even the torch modules: pure-AST, device-free
+# suites (the dstpu-lint/prove analysis tests never launch a collective,
+# so the torch-starvation hazard above cannot touch them). These are
+# also the newest modules — under the budget-bound 870s tier-1 timeout
+# they must spend only leftover budget, after every seed test
+# (including the torch-last parity group) has reported its dot.
+_POST_TORCH_MODULES = ("unit/analysis/",)
+
+
+def _order_rank(it):
+    if any(m in it.nodeid for m in _POST_TORCH_MODULES):
+        return 3
+    if any(m in it.nodeid for m in _TORCH_MODULES):
+        return 2
+    if any(m in it.nodeid for m in _LATE_MODULES):
+        return 1
+    return 0
 
 
 def pytest_collection_modifyitems(config, items):
-    items.sort(key=lambda it: (
-        any(m in it.nodeid for m in _TORCH_MODULES),
-        any(m in it.nodeid for m in _LATE_MODULES)))
+    items.sort(key=_order_rank)
     for it in items:
         if any(m in it.nodeid for m in _QUICK_MODULES):
             it.add_marker(pytest.mark.quick)

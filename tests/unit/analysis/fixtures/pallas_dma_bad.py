@@ -1,0 +1,26 @@
+"""pallas-dma BAD twin: unawaited starts in all three handle spellings
+plus an orphan wait (install at deepspeed_tpu/ops/fx.py)."""
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+
+def _kernel(x_ref, y_ref, o_ref, xbuf, ybuf, sem, wsem):
+    # BAD (bound handle): started, never awaited
+    fk = pltpu.make_async_copy(x_ref.at[pl.ds(0, 8), :], xbuf,
+                               sem.at[0])
+    fk.start()
+
+    # factory helper: K stream (0) paired, V stream (1) started only
+    def dma(slot, t):
+        return pltpu.make_async_copy(y_ref.at[pl.ds(slot, 8), :], ybuf,
+                                     sem.at[t])
+
+    dma(0, 0).start()
+    dma(0, 1).start()          # BAD: stream 1 wait was dropped
+    dma(0, 0).wait()
+
+    # BAD (inline): wait on a semaphore nobody signals
+    pltpu.make_async_copy(x_ref.at[pl.ds(0, 8), :], xbuf,
+                          wsem.at[1]).wait()
+    o_ref[...] = xbuf[...] + ybuf[...]

@@ -1,0 +1,39 @@
+"""pallas-dma GOOD twin: the same three spellings, every start awaited
+(the wait may live in a nested closure — the repo's macro idiom), and a
+``.start()`` on a non-DMA object the pass must ignore."""
+import threading
+
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+
+def _kernel(x_ref, y_ref, o_ref, xbuf, ybuf, sem, wsem):
+    fk = pltpu.make_async_copy(x_ref.at[pl.ds(0, 8), :], xbuf,
+                               sem.at[0])
+    fk.start()
+
+    def dma(slot, t):
+        return pltpu.make_async_copy(y_ref.at[pl.ds(slot, 8), :], ybuf,
+                                     sem.at[t])
+
+    dma(0, 0).start()
+    dma(0, 1).start()
+
+    def finish():
+        fk.wait()
+        dma(0, 0).wait()
+        dma(0, 1).wait()
+
+    pltpu.make_async_copy(x_ref.at[pl.ds(0, 8), :], xbuf,
+                          wsem.at[1]).start()
+    finish()
+    pltpu.make_async_copy(x_ref.at[pl.ds(0, 8), :], xbuf,
+                          wsem.at[1]).wait()
+    o_ref[...] = xbuf[...] + ybuf[...]
+
+
+def launcher(fn):
+    t = threading.Thread(target=fn)
+    t.start()          # not a DMA handle: ignored
+    return t

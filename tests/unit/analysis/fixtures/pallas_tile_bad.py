@@ -1,0 +1,43 @@
+"""pallas-tile BAD twin: every constant shape here violates a TPU tile
+quantum (install at deepspeed_tpu/ops/fx.py in a synthetic tree)."""
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+ROWS = 8          # folds through module constants into the checks
+
+
+def _kernel(x_ref, w_ref, o_ref, wbuf, acc_ref, sem):
+    # BAD: 8-row window over an int8 buffer (32-row HBM tile quantum)
+    pltpu.make_async_copy(w_ref.at[pl.ds(0, ROWS), :], wbuf,
+                          sem).start()
+    pltpu.make_async_copy(w_ref.at[pl.ds(0, ROWS), :], wbuf, sem).wait()
+    # BAD: minor-dim DMA slice moves 64 lanes (128 required)
+    pltpu.make_async_copy(x_ref.at[:, pl.ds(0, 64)], acc_ref,
+                          sem).start()
+    pltpu.make_async_copy(x_ref.at[:, pl.ds(0, 64)], acc_ref, sem).wait()
+    o_ref[...] = acc_ref[...]
+
+
+def run(x, w):
+    kernel = functools.partial(_kernel)
+    return pl.pallas_call(
+        kernel,
+        in_specs=[
+            # BAD: 64-lane minor block dim
+            pl.BlockSpec((8, 64), lambda i: (i, 0)),
+            pl.BlockSpec(memory_space=pl.ANY),
+        ],
+        out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((8, 128), jnp.float32),
+        scratch_shapes=[
+            # BAD: int8 scratch with an 8-row sublane dim (quantum 32)
+            pltpu.VMEM((ROWS, 128), jnp.int8),
+            # BAD: 96-lane minor dim (pads to a full 128-lane tile)
+            pltpu.VMEM((8, 96), jnp.float32),
+            pltpu.SemaphoreType.DMA,
+        ],
+    )(x, w.astype(jnp.int8))

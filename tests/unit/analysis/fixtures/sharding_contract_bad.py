@@ -1,0 +1,46 @@
+"""sharding-contract BAD twin (install at deepspeed_tpu/runtime/fx.py):
+interprocedural donations read after the fact, and mesh axis literals
+outside the declared registry."""
+import jax
+from jax.sharding import Mesh, PartitionSpec as P
+
+
+def helper_consume(state, batch):
+    # the donation happens HERE — invisible to any per-scope pass
+    step = jax.jit(train_step, donate_argnums=(0,))
+    return step(state, batch)
+
+
+def caller(state, batch):
+    out = helper_consume(state, batch)
+    return state.params          # BAD: state donated inside the helper
+
+
+def two_hop(state, batch):
+    mid = lambda s, b: None      # placeholder; real hop is below
+    _ = wrapped(state, batch)
+    return state.params          # BAD: donated two calls deep
+
+
+def wrapped(state, batch):
+    return helper_consume(state, batch)
+
+
+class Engine:
+    def __init__(self, fn):
+        self._step = jax.jit(fn, donate_argnums=(0,))
+
+    def run(self, state, batch):
+        new = self._step(state, batch)
+        return state.params      # BAD: donated via the attr callable
+
+
+def shard(x, devices):
+    mesh = Mesh(devices, ("dta",))            # BAD: unregistered axis
+    spec = P("dta", None)                     # BAD
+    y = jax.lax.psum(x, "q")                  # BAD: unknown collective axis
+    return mesh, spec, y
+
+
+def train_step(state, batch):
+    return state

@@ -1,0 +1,41 @@
+"""sharding-contract GOOD twin: helpers consume-and-return-fresh with
+rebinding callers, registered axis names, variable axes left alone."""
+import jax
+from jax.sharding import Mesh, PartitionSpec as P
+
+
+def helper_fresh(state, batch):
+    step = jax.jit(train_step, donate_argnums=(0,))
+    new_state = step(state, batch)
+    return new_state
+
+
+def caller(state, batch):
+    state = helper_fresh(state, batch)   # rebind: taint cleared
+    return state.params
+
+
+def read_before(state, batch):
+    loss = state.params.sum()            # read BEFORE the donation
+    state = helper_fresh(state, batch)
+    return state, loss
+
+
+class Engine:
+    def __init__(self, fn):
+        self._step = jax.jit(fn, donate_argnums=(0,))
+
+    def run(self, state, batch):
+        state = self._step(state, batch)   # canonical rebind
+        return state.params
+
+
+def shard(x, devices, axis):
+    mesh = Mesh(devices, ("data", "model"))   # registered axes
+    spec = P("data", None)
+    y = jax.lax.psum(x, axis)                 # variable axis: unchecked
+    return mesh, spec, y
+
+
+def train_step(state, batch):
+    return state

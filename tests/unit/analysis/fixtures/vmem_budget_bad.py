@@ -1,0 +1,44 @@
+"""vmem-budget BAD twin: constant-foldable scratch that cannot fit the
+default 16 MB scope, and a scoped limit past the hardware max."""
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+BIG = 4096
+
+
+def _kernel(x_ref, o_ref, a_ref, b_ref):
+    o_ref[...] = a_ref[...] + b_ref[...]
+
+
+def run(x):
+    # BAD: 2 x (4096 x 1024 x f32) = 32 MB of provable scratch vs the
+    # 16 MB default scope (no vmem_limit_bytes declared)
+    return pl.pallas_call(
+        _kernel,
+        in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM)],
+        out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((8, 128), jnp.float32),
+        scratch_shapes=[
+            pltpu.VMEM((BIG, 1024), jnp.float32),
+            pltpu.VMEM((BIG, 1024), jnp.float32),
+        ],
+    )(x)
+
+
+def _kernel2(x_ref, o_ref, a_ref):
+    o_ref[...] = a_ref[...]
+
+
+def run2(x):
+    # BAD: scoped limit above SCOPED_VMEM_MAX_MB (128 MB)
+    return pl.pallas_call(
+        _kernel2,
+        in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM)],
+        out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((8, 128), jnp.float32),
+        scratch_shapes=[pltpu.VMEM((8, 128), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            vmem_limit_bytes=256 * 1024 * 1024),
+    )(x)

@@ -1,0 +1,45 @@
+"""vmem-budget GOOD twin: the same kernels inside budget — scratch fits
+the default scope, a raised-but-legal scoped limit covers bigger
+scratch, and data-dependent shapes stay silent."""
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+
+def _kernel(x_ref, o_ref, a_ref, b_ref):
+    o_ref[...] = a_ref[...] + b_ref[...]
+
+
+def run(x):
+    return pl.pallas_call(
+        _kernel,
+        in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM)],
+        out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((8, 128), jnp.float32),
+        scratch_shapes=[
+            pltpu.VMEM((512, 1024), jnp.float32),      # 2 MB
+            pltpu.VMEM((512, 1024), jnp.float32),      # 2 MB
+        ],
+    )(x)
+
+
+def _kernel2(x_ref, o_ref, a_ref, b_ref):
+    o_ref[...] = a_ref[...] + b_ref[...]
+
+
+def run2(x, chunk):
+    # 32 MB of scratch under an explicitly raised 40 MB scope (the
+    # decode_step idiom), plus a data-dependent buffer (not provable)
+    return pl.pallas_call(
+        _kernel2,
+        in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM)],
+        out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((8, 128), jnp.float32),
+        scratch_shapes=[
+            pltpu.VMEM((4096, 1024), jnp.float32),     # 16 MB
+            pltpu.VMEM((chunk, 1024), jnp.float32),    # data-dependent
+        ],
+        compiler_params=pltpu.CompilerParams(
+            vmem_limit_bytes=40 * 1024 * 1024),
+    )(x)

@@ -461,22 +461,43 @@ def test_cli_list_passes(capsys):
     out = capsys.readouterr().out
     for pid in ("host-sync", "recompile-hazard", "typed-error",
                 "jax-compat", "donation-safety", "metric-names",
-                "slo-rules"):
+                "slo-rules", "pallas-tile", "pallas-dma",
+                "vmem-budget", "sharding-contract"):
         assert pid in out
 
 
 # -------------------------------------------------------- the real tree
-def test_repo_lints_clean_end_to_end():
+def test_repo_lints_clean_end_to_end(repo_full_lint):
     """THE pin: the framework lands already having paid for itself —
     every true positive in the current tree is fixed or carries a
-    justified suppression, so the repo lints clean."""
-    res = run_lint(REPO, baseline=Baseline.load(
-        os.path.join(REPO, "LINT_BASELINE.json")))
+    justified suppression, so the repo lints clean.  (The run itself
+    is the shared session fixture — one cold full lint feeds every
+    whole-repo pin.)"""
+    res = repo_full_lint.result
     assert res.clean, "\n".join(f.format() for f in res.findings)
     assert res.files_scanned > 100
     # the fence inventory is non-trivial: the contract is DECLARED syncs
     assert len(res.suppressed) >= 30
     assert all(d.reason for _, d in res.suppressed)
+
+
+def test_vmem_budget_committed_repo_artifact_is_clean(repo_full_lint):
+    """ISSUE 15: the committed AUTOTUNE_KERNELS_MEASURED.json plans all
+    fit the capacity table the vmem-budget pass shares with autotune."""
+    res = repo_full_lint.result
+    assert "vmem-budget" in res.passes_run
+    vmem = [f for f in res.findings if f.pass_id == "vmem-budget"]
+    assert vmem == [], [f.format() for f in vmem]
+
+
+def test_full_lint_wall_clock_under_budget(repo_full_lint):
+    """ISSUE 15 S6: the phase-1 index must not regress tier-1 — a cold
+    full run over the repo (build corpus + index + all passes, the
+    CLI's whole hot path, timed once in the shared session fixture)
+    stays under 60 s on this sandbox."""
+    assert repo_full_lint.result.clean
+    assert repo_full_lint.elapsed < 60.0, \
+        f"full lint took {repo_full_lint.elapsed:.1f}s"
 
 
 def test_typed_error_hierarchy_compat():
@@ -507,13 +528,17 @@ def test_typed_error_hierarchy_compat():
         normalize_kv_dtype("int3")
 
 
-def test_jaxcompat_report_matches_committed_artifact(tmp_path):
+def test_jaxcompat_report_matches_committed_artifact(tmp_path,
+                                                     repo_full_lint):
     """LINT_JAXCOMPAT.md is generated, committed, and pinned: the
-    work-list burns down in the same diff that changes the call sites."""
+    work-list burns down in the same diff that changes the call sites.
+    Uses the CLI's own writer over the shared session run's corpus, so
+    the artifact bytes stay pinned without a second full lint."""
     mod = _load_script("dstpu_lint")
     out = tmp_path / "LINT_JAXCOMPAT.md"
-    rc = mod.main(["--root", REPO, "--jaxcompat-report", str(out)])
-    assert rc == EXIT_CLEAN
+    assert repo_full_lint.result.clean
+    rows = load_passes()["jax-compat"].inventory(repo_full_lint.corpus)
+    mod._write_jaxcompat_report(str(out), rows, REPO)
     generated = out.read_text()
     committed = open(os.path.join(REPO, "LINT_JAXCOMPAT.md")).read()
     assert generated == committed, (
