@@ -1183,6 +1183,112 @@ def _bench_fabric_serving(on_tpu: bool):
     }
 
 
+def _bench_fabric_autoscale(on_tpu: bool):
+    """ISSUE-16 acceptance bench: elastic autoscaling under a
+    deadline-bounded overload burst, run through the deterministic
+    fleet twin. A fixed minimal pool (one replica, autoscaler pinned
+    min=max=1) is hammered with a 40-request burst whose requests carry
+    a completion deadline — congestion sheds the queue tail. The
+    elastic pool starts from the same single replica but may scale to 4
+    on page-severity burn-rate alerts, flattening the queue before
+    deadlines expire. Headline: shed reduction vs the fixed pool, SLO
+    attainment for the fabric_queue objective on both sides, zero
+    recompiles across every pool size (each replica wraps the ONE
+    compiled engine), the lossless check (every request the elastic run
+    served decodes bit-identically to a fault-free fixed-large-pool
+    oracle), and a bit-identical twin replay."""
+    import deepspeed_tpu
+    from deepspeed_tpu.models.gpt2 import GPT2Config, GPT2Model
+    from deepspeed_tpu.serving.fabric.twin import (run_twin,
+                                                   synthetic_tenant_trace)
+    from deepspeed_tpu.utils import groups
+
+    groups.reset()
+    if on_tpu:
+        cfg = GPT2Config.gpt2_125m()
+        dtype = "bf16"
+    else:
+        cfg = GPT2Config.tiny()
+        dtype = "fp32"
+    engine = deepspeed_tpu.init_inference(GPT2Model(cfg), dtype=dtype,
+                                          max_out_tokens=128)
+    # twin physics: auto_dt is fake seconds per clock read, so the burst
+    # stays congested for whole SLO evaluation windows and the 1s
+    # deadline bites a single replica but not a scaled-out pool
+    auto_dt, deadline_s = 3e-3, 1.0
+    max_replicas = 4
+
+    def make_trace(deadline):
+        tenants = [
+            {"name": "bots", "kind": "bursty", "n": 40, "rate": 2000.0,
+             "burst_size": 40, "prompt_lens": (4, 12), "max_new": (6, 10)},
+            {"name": "web", "kind": "bimodal", "n": 10, "rate": 100.0,
+             "short_lens": (4, 8), "long_lens": (12, 16), "long_frac": 0.3,
+             "short_new": (4, 6), "long_new": (8, 12)},
+        ]
+        trace = synthetic_tenant_trace(7, cfg.vocab_size, tenants=tenants)
+        if deadline is not None:
+            for r in trace:
+                r.deadline = r.arrival_time + deadline
+        return trace
+
+    n_requests = len(make_trace(None))
+    pinned = dict(queue_high=10_000, queue_low=0)
+    fixed = run_twin(engine, make_trace(deadline_s), initial_replicas=1,
+                     autoscaler_kw=dict(min_replicas=1, max_replicas=1,
+                                        **pinned),
+                     auto_dt=auto_dt)
+    elastic_kw = dict(min_replicas=1, max_replicas=max_replicas,
+                      scale_out_cooldown_s=0.25, scale_in_cooldown_s=1.0,
+                      idle_stable_s=0.5, **pinned)
+    elastic = run_twin(engine, make_trace(deadline_s), initial_replicas=1,
+                       autoscaler_kw=elastic_kw, auto_dt=auto_dt)
+    replay = run_twin(engine, make_trace(deadline_s), initial_replicas=1,
+                      autoscaler_kw=elastic_kw, auto_dt=auto_dt)
+    # fault-free fixed-large-pool oracle (no deadlines: serves all)
+    oracle = run_twin(engine, make_trace(None),
+                      initial_replicas=max_replicas,
+                      autoscaler_kw=dict(min_replicas=max_replicas,
+                                         max_replicas=max_replicas,
+                                         **pinned),
+                      auto_dt=auto_dt)
+    match = all(elastic.tokens[rid] == oracle.tokens[rid]
+                for rid in elastic.tokens)
+    outs = [d for d in elastic.scale_timeline if d[1] == "scale_out"]
+    ins = [d for d in elastic.scale_timeline if d[1] == "scale_in"]
+    return {
+        "trace": "bursty_multi_tenant_deadline",
+        "n_requests": n_requests,
+        "deadline_s": deadline_s,
+        "fixed_pool": {
+            "replicas": 1,
+            "served": fixed.served, "shed": fixed.shed,
+            "slo_attainment_fabric_queue":
+                fixed.slo_attainment.get("fabric_queue"),
+            "recompiles": fixed.recompiles,
+        },
+        "elastic_pool": {
+            "min_replicas": 1, "max_replicas": max_replicas,
+            "served": elastic.served, "shed": elastic.shed,
+            "peak_pool_size": max(p for _, p in elastic.pool_sizes),
+            "scale_outs": len(outs), "scale_ins": len(ins),
+            "scale_out_reasons": sorted({d[2] for d in outs}),
+            "page_alerts_fired": sum(a[3] == "fired" and a[2] == "page"
+                                     for a in elastic.alert_timeline),
+            "slo_attainment_fabric_queue":
+                elastic.slo_attainment.get("fabric_queue"),
+            "recompiles": elastic.recompiles,
+        },
+        "shed_reduction": fixed.shed - elastic.shed,
+        "lossless_greedy_match": match,
+        "zero_recompiles_all_pool_sizes": (fixed.recompiles == 0
+                                           and elastic.recompiles == 0
+                                           and oracle.recompiles == 0),
+        "replay_bit_identical":
+            elastic.fingerprint() == replay.fingerprint(),
+    }
+
+
 def _bench_observability_overhead(on_tpu: bool):
     """ISSUE-3 acceptance: instrumented vs bare train step and serving
     decode step (2% overhead budget), plus p50/p95 serving latencies from
@@ -1858,6 +1964,15 @@ def main():
         print(json.dumps(_bench_fabric_serving(on_tpu), indent=2))
         return
 
+    if "fabric_autoscale" in sys.argv[1:]:
+        # standalone ISSUE-16 mode: elastic autoscaling fabric vs a
+        # fixed minimal pool under a deadline-bounded overload burst,
+        # run through the deterministic twin, one JSON object
+        on_tpu = any(d.platform in ("tpu", "axon")
+                     or "TPU" in str(d.device_kind) for d in jax.devices())
+        print(json.dumps(_bench_fabric_autoscale(on_tpu), indent=2))
+        return
+
     if "training_resilience" in sys.argv[1:]:
         # standalone ISSUE-10 mode: sentinel/guard overhead vs bare
         # training + recovery latency through one injected spike
@@ -2011,6 +2126,10 @@ def main():
     except Exception as e:
         serving_fabric = {"error": f"{type(e).__name__}: {e}"}
     try:
+        fabric_autoscale = _bench_fabric_autoscale(on_tpu)
+    except Exception as e:
+        fabric_autoscale = {"error": f"{type(e).__name__}: {e}"}
+    try:
         longseq = _bench_zero_flash_longseq(on_tpu)
     except Exception as e:
         longseq = {"error": f"{type(e).__name__}: {e}"}
@@ -2089,6 +2208,12 @@ def main():
         # the crash, lossless greedy vs a fault-free single-replica
         # run, zero recompiles, goodput >= 0.7x chaos-off)
         "serving_fabric": serving_fabric,
+        # elastic autoscaling fabric vs fixed minimal pool under a
+        # deadline-bounded overload burst, via the deterministic twin
+        # (ISSUE 16 acceptance: shed reduction, SLO attainment recovery,
+        # lossless greedy vs a fixed-large-pool oracle, zero recompiles
+        # across all pool sizes, bit-identical twin replay)
+        "fabric_autoscale": fabric_autoscale,
         "train_zero2_flash_longseq": longseq,  # seq_len inside the value
         # ISSUE-3 acceptance: instrumented vs bare train/decode steps (2%
         # budget) + telemetry-histogram p50/p95 vs direct measurement

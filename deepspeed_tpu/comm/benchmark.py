@@ -37,24 +37,25 @@ def run_collective_bench(op: str = "all_reduce", sizes: List[int] = None,
     dtype = getattr(jnp, dtype_str)
     sizes = sizes or [2 ** p for p in range(12, 27, 2)]  # 4KB..512MB elems/4
     results = []
+    # one local function + out_specs per collective, one shard_map site
+    local_fns = {
+        "all_reduce": (lambda a: jax.lax.psum(a, DATA_AXIS), P(DATA_AXIS)),
+        "all_gather": (lambda a: jax.lax.all_gather(a, DATA_AXIS, tiled=True),
+                       P()),
+        "reduce_scatter": (lambda a: jax.lax.psum_scatter(a, DATA_AXIS,
+                                                          tiled=True),
+                           P(DATA_AXIS)),
+        "all_to_all": (lambda a: jax.lax.all_to_all(
+            a.reshape(n, -1), DATA_AXIS, 0, 0,
+            tiled=False).reshape(a.shape), P(DATA_AXIS)),
+    }
+    if op not in local_fns:
+        raise ValueError(f"unknown op '{op}'")
+    local_fn, out_specs = local_fns[op]
     for numel in sizes:
         x = jnp.ones((n, numel // n if op != "all_gather" else numel), dtype)
-
-        if op == "all_reduce":
-            fn = shard_map(lambda a: jax.lax.psum(a, DATA_AXIS), mesh=mesh,
-                           in_specs=P(DATA_AXIS), out_specs=P(DATA_AXIS))
-        elif op == "all_gather":
-            fn = shard_map(lambda a: jax.lax.all_gather(a, DATA_AXIS, tiled=True),
-                           mesh=mesh, in_specs=P(DATA_AXIS), out_specs=P())
-        elif op == "reduce_scatter":
-            fn = shard_map(lambda a: jax.lax.psum_scatter(a, DATA_AXIS, tiled=True),
-                           mesh=mesh, in_specs=P(DATA_AXIS), out_specs=P(DATA_AXIS))
-        elif op == "all_to_all":
-            fn = shard_map(lambda a: jax.lax.all_to_all(
-                a.reshape(n, -1), DATA_AXIS, 0, 0, tiled=False).reshape(a.shape),
-                mesh=mesh, in_specs=P(DATA_AXIS), out_specs=P(DATA_AXIS))
-        else:
-            raise ValueError(f"unknown op '{op}'")
+        fn = shard_map(local_fn, mesh=mesh, in_specs=P(DATA_AXIS),
+                       out_specs=out_specs)
         jfn = jax.jit(fn)
         jax.block_until_ready(jfn(x))  # compile + warm
         t0 = time.perf_counter()

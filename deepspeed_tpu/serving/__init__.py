@@ -24,17 +24,23 @@ from deepspeed_tpu.serving.errors import (EmptyPromptError,
                                           InvalidMaxNewTokensError,
                                           InvalidRequestError,
                                           KVLifecycleError,
+                                          LastReplicaError,
                                           NoHealthyReplicaError,
                                           PromptTooLongError,
+                                          ReplicaAdmissionError,
                                           ReplicaCrashedError,
                                           RetriesExhaustedError,
                                           RouterOverloadedError, ServingError,
                                           SlotCapacityError,
                                           SwapCapacityError,
-                                          TransientReplicaError)
-from deepspeed_tpu.serving.fabric import (CircuitBreaker, FabricRouter,
-                                          InProcessReplica, Replica,
-                                          ReplicaHealth, ReplicaSupervisor)
+                                          TransientReplicaError,
+                                          UnknownReplicaError)
+from deepspeed_tpu.serving.fabric import (CircuitBreaker, ElasticAutoscaler,
+                                          FabricRouter, InProcessReplica,
+                                          Replica, ReplicaHealth,
+                                          ReplicaSupervisor, ScaleDecision,
+                                          TwinReport, run_twin,
+                                          synthetic_tenant_trace)
 from deepspeed_tpu.serving.kv_blocks import BlockKVPool
 from deepspeed_tpu.serving.kv_slots import SlotKVCache
 from deepspeed_tpu.serving.radix import PrefixCache
@@ -57,6 +63,11 @@ __all__ = ["ServingEngine", "SlotKVCache", "BlockKVPool", "PrefixCache",
            # fabric (ISSUE 9)
            "CircuitBreaker", "FabricRouter", "InProcessReplica", "Replica",
            "ReplicaHealth", "ReplicaSupervisor",
+           # elastic autoscaling + digital twin (ISSUE 16)
+           "ElasticAutoscaler", "ScaleDecision", "TwinReport", "run_twin",
+           "synthetic_tenant_trace",
+           "ReplicaAdmissionError", "LastReplicaError",
+           "UnknownReplicaError",
            # typed errors (ISSUE 9)
            "ServingError", "InvalidRequestError", "EmptyPromptError",
            "InvalidMaxNewTokensError", "PromptTooLongError",

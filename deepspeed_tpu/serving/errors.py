@@ -123,3 +123,26 @@ class TransientReplicaError(FabricError):
     """A retryable replica-level hiccup (flaky step, failed health
     probe): the replica is still alive, the operation may be retried.
     Repeated transients trip the replica's circuit breaker."""
+
+
+# ------------------------------------------------------- elastic pool (PR 16)
+class ReplicaAdmissionError(FabricError):
+    """A joining replica failed its warm admission probe (or its name
+    collides with a pool member): it never entered the dispatch set, so
+    no request can have been routed to it — the scale-out is refused,
+    the pool is unchanged, and the caller (typically the autoscaler)
+    may retry with a fresh replica."""
+
+
+class LastReplicaError(FabricError):
+    """Refusing to remove the LAST healthy replica: a scale-down that
+    empties the serving set would strand the queue forever — the
+    autoscaler's ``min_replicas`` floor should have prevented the ask,
+    and a manual drain of the final replica needs a replacement added
+    first."""
+
+
+class UnknownReplicaError(FabricError):
+    """The named replica is not a member of the pool (never added, or
+    already drained out) — a caller-side bookkeeping error, not a
+    health condition."""

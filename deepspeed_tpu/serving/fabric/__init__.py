@@ -11,11 +11,18 @@ real multi-host transport plugs into later. Chaos seams live in
 ``deepspeed_tpu/testing/fault_injection.py``.
 """
 
+from deepspeed_tpu.serving.fabric.autoscaler import (ElasticAutoscaler,
+                                                     ScaleDecision)
 from deepspeed_tpu.serving.fabric.health import CircuitBreaker
 from deepspeed_tpu.serving.fabric.replica import (InProcessReplica, Replica,
                                                   ReplicaHealth)
 from deepspeed_tpu.serving.fabric.router import FabricRouter
 from deepspeed_tpu.serving.fabric.supervisor import ReplicaSupervisor
+from deepspeed_tpu.serving.fabric.twin import (TWIN_SLO_CONFIG, TwinReport,
+                                               run_twin,
+                                               synthetic_tenant_trace)
 
-__all__ = ["CircuitBreaker", "FabricRouter", "InProcessReplica", "Replica",
-           "ReplicaHealth", "ReplicaSupervisor"]
+__all__ = ["CircuitBreaker", "ElasticAutoscaler", "FabricRouter",
+           "InProcessReplica", "Replica", "ReplicaHealth",
+           "ReplicaSupervisor", "ScaleDecision", "TWIN_SLO_CONFIG",
+           "TwinReport", "run_twin", "synthetic_tenant_trace"]

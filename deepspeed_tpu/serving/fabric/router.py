@@ -36,11 +36,22 @@ otherwise the arrival is refused with a typed
 Requests whose deadline expires while queued are shed before they
 waste prefill compute they can no longer use.
 
+**Elastic pool (ISSUE 16).** The replica set is no longer fixed at
+construction: :meth:`FabricRouter.add_replica` admits a newcomer after
+a warm health probe (it wraps the SHARED InferenceEngine, so scale-out
+compiles nothing), :meth:`FabricRouter.remove_replica` drains one out —
+no new dispatches, in-flight work finishes or is re-dispatched from the
+committed-token record at the drain deadline, so scale-down drops
+nothing. The :class:`~deepspeed_tpu.serving.fabric.autoscaler.ElasticAutoscaler`
+drives both off SLO burn-rate alerts and load gauges.
+
 **Chaos-tested.** Everything runs against in-process replicas in
 virtual time; the scripted fault seams live in
 ``testing/fault_injection.py`` and the acceptance suite drives the
 PR 7 adversarial traces through a 3-replica fabric under mid-trace
-crash schedules, asserting losslessness and zero recompiles.
+crash schedules, asserting losslessness and zero recompiles — the
+ISSUE 16 digital twin (fabric/twin.py) extends this to full incident
+timelines with autoscaling in the loop.
 """
 
 from __future__ import annotations
@@ -53,10 +64,13 @@ from deepspeed_tpu.elasticity.elastic_agent import backoff_delay
 from deepspeed_tpu.serving.errors import (EngineConfigError,
                                           EngineInvariantError,
                                           InvalidRequestError,
+                                          LastReplicaError,
                                           NoHealthyReplicaError,
+                                          ReplicaAdmissionError,
                                           ReplicaCrashedError,
                                           RouterOverloadedError,
-                                          TransientReplicaError)
+                                          TransientReplicaError,
+                                          UnknownReplicaError)
 from deepspeed_tpu.serving.fabric.health import (CLOSED, STATE_GAUGE,
                                                  CircuitBreaker)
 from deepspeed_tpu.serving.fabric.replica import Replica
@@ -65,9 +79,12 @@ from deepspeed_tpu.serving.scheduler import Request, RequestResult
 from deepspeed_tpu.utils.logging import log_dist
 
 # breaker states 0..2 (health.STATE_GAUGE); the router extends the
-# scale with its own terminal/parking states
+# scale with its own terminal/parking states (draining/removed are the
+# elastic-pool lifecycle states, past the health scale's ordering)
 _STATE_RESTARTING = 3.0
 _STATE_DEAD = 4.0
+_STATE_DRAINING = 5.0
+_STATE_REMOVED = 6.0
 
 
 class _Tracked:
@@ -139,6 +156,12 @@ class FabricRouter:
     request_timeout_s: per-ATTEMPT timeout: an in-flight request with
         no finish after this long is cancelled on its replica and
         re-dispatched elsewhere (straggler mitigation). None disables.
+    drain_timeout_s: default grace a draining replica gets to finish
+        its in-flight work before the drain ESCALATES to failover
+        (cancel + committed-token re-dispatch on a survivor, exactly
+        the crash resume path — so even a timed-out drain drops
+        nothing). None = wait indefinitely; ``remove_replica`` can
+        override per call.
     time_fn: clock (virtual in tests); defaults to time.monotonic.
     telemetry: like ServingEngine — True = global registry, a
         MetricsRegistry = private, False/None = bare.
@@ -177,6 +200,7 @@ class FabricRouter:
                  retry_max_delay_s: float = 1.0,
                  retry_jitter: float = 0.0,
                  request_timeout_s: Optional[float] = None,
+                 drain_timeout_s: Optional[float] = None,
                  time_fn: Optional[Callable[[], float]] = None,
                  telemetry=True, seed: int = 0, tracer=None,
                  slo=None, flight_recorder=None,
@@ -205,6 +229,7 @@ class FabricRouter:
         self.retry_max_delay_s = retry_max_delay_s
         self.retry_jitter = retry_jitter
         self.request_timeout_s = request_timeout_s
+        self.drain_timeout_s = drain_timeout_s
         self._rng = random.Random(seed)
         self._time = time_fn or time.monotonic
         self._real_clock = self._time in (time.monotonic, time.time,
@@ -219,6 +244,15 @@ class FabricRouter:
         self._done: List[RequestResult] = []
         self._restarting: Dict[str, float] = {}   # name -> resurrect-at
         self._dead: set = set()                   # permanently abandoned
+        # elastic pool (ISSUE 16): draining members still step their
+        # in-flight work but take no new dispatches; {"since": t,
+        # "deadline": t|None} per name. Removed replicas leave every
+        # dict — _retired_recompiles keeps their recompile history so
+        # the zero-recompile pin survives pool churn.
+        self._draining: Dict[str, dict] = {}
+        self._retired_recompiles = 0
+        self._next_replica_id = 0
+        self.autoscaler = None                    # attach_autoscaler()
         # consecutive per-attempt timeouts per replica: a straggler's
         # steps SUCCEED (so the breaker's error path never sees it) —
         # failure_threshold strikes without a completion in between
@@ -235,6 +269,9 @@ class FabricRouter:
         self.replica_restarts = 0
         self.quarantines = 0
         self.completed = 0
+        self.replicas_added = 0       # elastic scale-out admissions
+        self.replicas_removed = 0     # elastic scale-in completions
+        self.drain_redispatches = 0   # drain-timeout failovers
         if telemetry is True:
             from deepspeed_tpu.telemetry import get_registry
 
@@ -244,6 +281,12 @@ class FabricRouter:
         self.tracer = tracer
         # ---- SLO control plane (ISSUE 13)
         self.slo = slo
+        if self.slo is not None and self.supervisor is not None:
+            # fabric construction wires the alert fan-out (ISSUE 16):
+            # the supervisor subscribes here, the autoscaler adds
+            # itself on attach — no manual set_alert_callback dance,
+            # and add_alert_callback is idempotent for re-wiring
+            self.slo.add_alert_callback(self.supervisor.on_slo_alert)
         self.flight_recorder = flight_recorder
         self.shed_burst_threshold = shed_burst_threshold
         self.shed_burst_window_s = shed_burst_window_s
@@ -279,9 +322,19 @@ class FabricRouter:
             v = _STATE_DEAD
         elif name in self._restarting:
             v = _STATE_RESTARTING
+        elif name in self._draining:
+            v = _STATE_DRAINING
         else:
             v = STATE_GAUGE[self.breakers[name].state]
         self._gauge(f"fabric/replica_state/{name}", v)
+
+    def _pool_gauge(self) -> None:
+        """``fabric/pool_size`` is SERVING capacity: alive members not
+        on their way out (draining replicas finish work but take no new
+        dispatches, so they are not capacity)."""
+        self._gauge("fabric/pool_size",
+                    sum(self._alive(n) and n not in self._draining
+                        for n in self.replicas))
 
     # ----------------------------------------------------------------- clock
     def _now(self) -> float:
@@ -410,10 +463,16 @@ class FabricRouter:
             self.slo.maybe_evaluate(now)
         self._maybe_resurrect(now)
         self._maybe_heartbeat(now)
+        if self.autoscaler is not None:
+            # scale decisions act on fresh health gauges, BEFORE this
+            # step's dispatch — a scale-out admitted here takes work
+            # this very iteration (ISSUE 16)
+            self.autoscaler.tick(now)
         self._shed_expired(now)
         self._check_timeouts(now)
         self._dispatch(now)
         self._step_replicas(now)
+        self._advance_drains(now)
         done, self._done = self._done, []
         return done
 
@@ -476,8 +535,15 @@ class FabricRouter:
                             health.free_blocks)
             self._state_gauge(name)
         self._gauge("fabric/healthy_replicas",
-                    sum(self._alive(n) and self.breakers[n].state == CLOSED
+                    sum(self._alive(n) and n not in self._draining
+                        and self.breakers[n].state == CLOSED
                         for n in self.replicas))
+        # refresh the queue gauge on the periodic path too: dispatch
+        # drains the queue without writing the gauge, so a submit-only
+        # gauge reads stale-high forever once traffic goes idle (and a
+        # gauge_ceiling SLI sampling it would never resolve its alert).
+        self._gauge("fabric/queue_depth", len(self._queue))
+        self._pool_gauge()
 
     def _quarantine(self, name: str, now: float) -> None:
         """The breaker tripped OPEN on a still-alive replica: stop
@@ -523,6 +589,13 @@ class FabricRouter:
         for rid, tr in sorted(self._inflight.items()):
             if tr.replica == name:
                 self._requeue(tr, now, crashed=True)
+        if name in self._draining:
+            # a replica that dies MID-DRAIN was leaving anyway: its
+            # in-flight work just failed over (above) — complete the
+            # removal instead of asking the supervisor to resurrect
+            # a member the pool no longer wants
+            self._finalize_removal(name, now, outcome="crashed")
+            return
         if self.supervisor is not None and self.replica_factory is not None:
             at = self.supervisor.on_failure(name, now)
         else:
@@ -622,10 +695,220 @@ class FabricRouter:
                 self.breakers[name].trip(now)
                 self._quarantine(name, now)
 
+    # ------------------------------------------------- elastic pool (ISSUE 16)
+    @property
+    def draining(self) -> List[str]:
+        """Names currently draining out (sorted)."""
+        return sorted(self._draining)
+
+    def pool_size(self) -> int:
+        """Serving capacity right now: alive, non-draining members."""
+        return sum(self._alive(n) and n not in self._draining
+                   for n in self.replicas)
+
+    def add_replica(self, replica: Optional[Replica] = None, *,
+                    name: Optional[str] = None,
+                    now: Optional[float] = None,
+                    warmup: bool = True) -> str:
+        """Admit a replica into the pool (scale-out). With ``replica``
+        None the router builds one through ``replica_factory`` —
+        typically a fresh ServingEngine over the SHARED InferenceEngine,
+        so the newcomer reuses every compiled program (zero recompiles
+        by construction). Admission is gated on a WARM health probe:
+        the replica warms its executables and answers one probe before
+        it can ever be a dispatch target; a failure refuses the whole
+        scale-out with :class:`ReplicaAdmissionError` and leaves the
+        pool untouched. An admitted replica inherits the fabric
+        machinery cleanly — fresh circuit breaker, next heartbeat round
+        probes it, supervisor restart budgets start unspent under its
+        name. Returns the admitted name."""
+        now = self._now() if now is None else now
+        if replica is None:
+            if self.replica_factory is None:
+                raise EngineConfigError(
+                    "add_replica() without a replica needs a "
+                    "replica_factory")
+            if name is None:
+                while True:
+                    name = f"scale-{self._next_replica_id}"
+                    self._next_replica_id += 1
+                    if name not in self.replicas:
+                        break
+            replica = self.replica_factory(name)
+        else:
+            if name is not None and name != replica.name:
+                raise EngineConfigError(
+                    f"name {name!r} != replica.name {replica.name!r}")
+            name = replica.name
+        if name in self.replicas:
+            raise ReplicaAdmissionError(
+                f"replica name {name!r} already in the pool "
+                f"(state: {'dead' if name in self._dead else 'draining' if name in self._draining else 'restarting' if name in self._restarting else self.breakers[name].state})")
+        try:
+            if warmup:
+                replica.warmup()
+            health = replica.probe(now)
+        except (ReplicaCrashedError, TransientReplicaError) as e:
+            raise ReplicaAdmissionError(
+                f"replica {name!r} failed its warm admission probe: "
+                f"{e}") from e
+        self.replicas[name] = replica
+        self.breakers[name] = CircuitBreaker(
+            failure_threshold=self._failure_threshold,
+            cooldown_s=self._breaker_cooldown_s)
+        self.replicas_added += 1
+        self._count("fabric/replicas_added")
+        if self.telemetry is not None:
+            self.telemetry.event(
+                "fabric/replica_added", replica=name, t=now,
+                pool_size=self.pool_size(),
+                probe_free_slots=health.free_slots,
+                probe_queue_depth=health.queue_depth)
+        self._state_gauge(name)
+        self._pool_gauge()
+        log_dist(f"fabric: replica {name} admitted at t={now:.3f} "
+                 f"(pool={self.pool_size()})", ranks=[0])
+        return name
+
+    def remove_replica(self, name: str, *, drain: bool = True,
+                       drain_timeout_s: Optional[float] = ...,
+                       now: Optional[float] = None) -> None:
+        """Retire a replica (scale-in). ``drain=True`` (the default)
+        is graceful: the member immediately stops receiving dispatches
+        but keeps stepping its in-flight requests to completion; once
+        empty (or at the drain deadline, when every leftover is
+        cancelled and re-dispatched on a survivor via the committed-
+        token resume path) it leaves the pool. ``drain=False`` skips
+        the grace entirely — cancel + re-dispatch now. Either way no
+        request is ever dropped by a scale-down. Removing the LAST
+        healthy replica is refused with :class:`LastReplicaError`;
+        an unknown name raises :class:`UnknownReplicaError`; repeating
+        a remove on an already-draining member is a no-op."""
+        now = self._now() if now is None else now
+        if name not in self.replicas:
+            raise UnknownReplicaError(
+                f"replica {name!r} is not a pool member "
+                f"(members: {sorted(self.replicas)})")
+        if name in self._draining:
+            return   # idempotent: the drain is already underway
+        if self._alive(name):
+            others = [n for n in self.replicas
+                      if n != name and self._alive(n)
+                      and n not in self._draining]
+            if not others:
+                raise LastReplicaError(
+                    f"refusing to remove {name!r}: it is the last "
+                    f"healthy replica (add a replacement first)")
+        if drain_timeout_s is ...:
+            drain_timeout_s = self.drain_timeout_s
+        deadline = None
+        if not drain:
+            deadline = now
+        elif drain_timeout_s is not None:
+            deadline = now + drain_timeout_s
+        self._draining[name] = {"since": now, "deadline": deadline}
+        inflight = sum(tr.replica == name
+                       for tr in self._inflight.values())
+        if self.telemetry is not None:
+            self.telemetry.event(
+                "fabric/replica_draining", replica=name, t=now,
+                inflight=inflight, drain=drain,
+                deadline=deadline)
+        self._state_gauge(name)
+        self._pool_gauge()
+        log_dist(f"fabric: replica {name} draining at t={now:.3f} "
+                 f"(inflight={inflight}, deadline={deadline})", ranks=[0])
+        # an empty drain (or drain=False) completes synchronously —
+        # callers see the member gone on return
+        self._advance_drains(now)
+
+    def _advance_drains(self, now: float) -> None:
+        """Drive every in-progress drain one notch: finalize the empty
+        ones, escalate the expired ones (cancel each straggler on the
+        draining member, then re-dispatch it from the router's
+        committed-token record — the cancel MUST succeed first, same
+        no-duplicates argument as the timeout path)."""
+        for name in sorted(self._draining):
+            if name not in self._draining:
+                continue   # a crash escalation below finalized it
+            if not self._alive(name):
+                # died (or was abandoned) before remove_replica was
+                # called on it: nothing in flight, just bookkeeping
+                self._finalize_removal(name, now, outcome="dead")
+                continue
+            inflight = sorted(
+                (tr for tr in self._inflight.values()
+                 if tr.replica == name),
+                key=lambda tr: tr.request.rid)
+            if not inflight:
+                self._finalize_removal(name, now, outcome="drained")
+                continue
+            deadline = self._draining[name]["deadline"]
+            if deadline is None or now < deadline:
+                continue   # grace period still running
+            replica = self.replicas[name]
+            crashed = False
+            for tr in inflight:
+                try:
+                    replica.cancel(tr.request.rid)
+                except ReplicaCrashedError:
+                    # degrade into the crash path: it requeues the
+                    # rest AND finalizes the removal (draining branch)
+                    self._on_crash(name, now)
+                    crashed = True
+                    break
+                self.drain_redispatches += 1
+                self._count("fabric/drain_redispatches")
+                self._requeue(tr, now, crashed=False)
+            if not crashed:
+                self._finalize_removal(name, now, outcome="timeout")
+
+    def _finalize_removal(self, name: str, now: float, *,
+                          outcome: str) -> None:
+        """The replica leaves every router structure. Its recompile
+        history is retired into a cumulative counter so the fabric-wide
+        zero-recompile pin survives pool churn."""
+        info = self._draining.pop(name, None)
+        replica = self.replicas.pop(name, None)
+        self.breakers.pop(name, None)
+        self._restarting.pop(name, None)
+        self._dead.discard(name)
+        self._timeout_strikes.pop(name, None)
+        if replica is not None:
+            try:
+                self._retired_recompiles += replica.recompile_count()
+            except ReplicaCrashedError:
+                pass   # a remote incarnation's counters died with it
+        duration_ms = None
+        if info is not None:
+            duration_ms = max(now - info["since"], 0.0) * 1e3
+            self._observe("fabric/drain_duration_ms", duration_ms)
+        self.replicas_removed += 1
+        self._count("fabric/replicas_removed")
+        if self.telemetry is not None:
+            self.telemetry.event(
+                "fabric/replica_removed", replica=name, t=now,
+                outcome=outcome, duration_ms=duration_ms,
+                pool_size=self.pool_size())
+        self._gauge(f"fabric/replica_state/{name}", _STATE_REMOVED)
+        self._pool_gauge()
+        log_dist(f"fabric: replica {name} removed at t={now:.3f} "
+                 f"({outcome}, pool={self.pool_size()})", ranks=[0])
+
+    def attach_autoscaler(self, autoscaler) -> None:
+        """Wire an :class:`ElasticAutoscaler`: ticked once per fabric
+        iteration (on the router's clock, before dispatch) and — when
+        an SLO engine is present — subscribed to its alert fan-out."""
+        self.autoscaler = autoscaler
+        if self.slo is not None:
+            self.slo.add_alert_callback(autoscaler.on_slo_alert)
+
     # --------------------------------------------------------------- dispatch
     def _dispatch_targets(self) -> List[str]:
         out = []
         for name in sorted(self.replicas):
+            if name in self._draining:
+                continue   # a draining member finishes, it never receives
             if not self._alive(name) or not self.breakers[name].dispatchable:
                 continue
             if self.max_dispatch_depth is not None and \
@@ -828,6 +1111,11 @@ class FabricRouter:
                     b.opened_at -= shift
             self._restarting = {n: at - shift
                                 for n, at in self._restarting.items()}
+            self._draining = {
+                n: {"since": d["since"] - shift,
+                    "deadline": (None if d["deadline"] is None
+                                 else d["deadline"] - shift)}
+                for n, d in self._draining.items()}
             for tr in self._queue:
                 tr.not_before -= shift
             for tr in list(self._queue) + list(self._inflight.values()):
@@ -893,14 +1181,17 @@ class FabricRouter:
     # ------------------------------------------------------------- inspection
     def recompile_count(self) -> int:
         """Sum of post-warmup recompiles across the LIVING replica set
-        (the chaos suites pin this at zero — crash/failover/resume must
-        never change a compiled program's operand signature)."""
-        return sum(self.replicas[n].recompile_count()
-                   for n in self.replicas if self._alive(n))
+        plus every retired member's history (the chaos suites pin this
+        at zero — crash/failover/resume/scale churn must never change a
+        compiled program's operand signature)."""
+        return self._retired_recompiles + sum(
+            self.replicas[n].recompile_count()
+            for n in self.replicas if self._alive(n))
 
     def __repr__(self):
         states = {n: ("dead" if n in self._dead else
                       "restarting" if n in self._restarting else
+                      "draining" if n in self._draining else
                       self.breakers[n].state)
                   for n in sorted(self.replicas)}
         return (f"FabricRouter(replicas={states}, queue={len(self._queue)}, "

@@ -30,8 +30,11 @@ engines pass the same virtual ``now`` their serving loops run on, so a
 FakeClock chaos run replays its alert timeline bit-for-bit — the
 acceptance suite pins the fired/resolved sequence, not just counts.
 Alerts emit typed events into the telemetry JSONL stream and a
-registered-callback seam (:meth:`SLOEngine.set_alert_callback`) that
-``ReplicaSupervisor`` / a future autoscaler can subscribe to.
+subscriber-list seam (:meth:`SLOEngine.add_alert_callback`; the older
+``set_alert_callback`` remains as a replace-all shim) that
+``ReplicaSupervisor`` and the ISSUE 16 ``ElasticAutoscaler`` both
+subscribe to — each subscriber individually immune to the others'
+exceptions.
 
 Window math: each :meth:`SLOEngine.evaluate` samples every SLI's
 CUMULATIVE (good, total) counts from the registry and keeps a bounded
@@ -411,20 +414,48 @@ class SLOEngine:
         self._max_window = max((r.long_s for r in rules),
                                default=0.0) * 1.05
         self._firing: Dict[str, bool] = {r.name: False for r in rules}
-        self._callback: Optional[Callable[[SLOAlert], None]] = None
+        self._callbacks: List[Callable[[SLOAlert], None]] = []
         self._last_eval: Optional[float] = None
         self.evaluations = 0
         self.alerts: List[SLOAlert] = []     # full fired/resolved history
 
     # -------------------------------------------------------------- seams
+    def add_alert_callback(self,
+                           cb: Callable[[SLOAlert], None]) -> None:
+        """Subscribe ``cb`` to every alert transition (ISSUE 16: the
+        supervisor AND the autoscaler both listen — fan-out lives here,
+        not in the callers). Delivery order is subscription order;
+        duplicate subscriptions are idempotent. Each subscriber's
+        exceptions are swallowed INDIVIDUALLY: one broken pager must
+        neither take down the serving loop nor starve the subscribers
+        behind it."""
+        if cb not in self._callbacks:
+            self._callbacks.append(cb)
+
+    def remove_alert_callback(self,
+                              cb: Callable[[SLOAlert], None]) -> None:
+        """Unsubscribe; unknown callbacks are ignored."""
+        if cb in self._callbacks:
+            self._callbacks.remove(cb)
+
     def set_alert_callback(self,
                            cb: Optional[Callable[[SLOAlert], None]]) -> None:
-        """Register the subscriber every alert transition is delivered
-        to (``ReplicaSupervisor.on_slo_alert``, a future autoscaler's
-        scale-out hook, a paging shim). One subscriber — compose
-        fan-out outside if needed. Exceptions are swallowed: a broken
-        pager must not take down the serving loop."""
-        self._callback = cb
+        """Pre-ISSUE-16 single-subscriber shim: REPLACES the whole
+        subscriber list (``None`` clears it), preserving the original
+        set-and-overwrite semantics for existing call sites. New code
+        uses :meth:`add_alert_callback`."""
+        self._callbacks = [] if cb is None else [cb]
+
+    def inject_alert(self, alert: SLOAlert) -> None:
+        """Chaos seam (ISSUE 16): deliver a SYNTHETIC alert transition
+        through the same emit path real evaluations use — events,
+        subscriber fan-out, flight-recorder trigger — without touching
+        the burn-rate state machine (``firing()`` is unaffected, and a
+        later real evaluation is not confused by the injection). The
+        twin's alert-storm injector drives this to prove autoscaler
+        hysteresis/cooldown survive pathological alert flapping."""
+        self.alerts.append(alert)
+        self._emit(alert)
 
     # ----------------------------------------------------------- sampling
     def _cumulative(self, st: _SliState) -> Tuple[float, float]:
@@ -571,11 +602,11 @@ class SLOEngine:
             self.registry.event("slo/alert_fired", **fields)
         else:
             self.registry.event("slo/alert_resolved", **fields)
-        if self._callback is not None:
+        for cb in list(self._callbacks):
             try:
-                self._callback(alert)
+                cb(alert)
             except Exception:  # a broken subscriber must not stop serving
-                pass
+                pass           # — nor starve the subscribers after it
         if self.flight_recorder is not None and alert.kind == "fired" \
                 and alert.severity == "page":
             self.flight_recorder.trigger(

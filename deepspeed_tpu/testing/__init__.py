@@ -1,4 +1,5 @@
 from deepspeed_tpu.testing.fault_injection import (
+    AlertStormPlan,
     FakeClock,
     FaultInjector,
     ReplicaFaultPlan,
@@ -6,5 +7,5 @@ from deepspeed_tpu.testing.fault_injection import (
     SimulatedCrash,
 )
 
-__all__ = ["FakeClock", "FaultInjector", "ReplicaFaultPlan",
-           "ScriptedWorkerGroup", "SimulatedCrash"]
+__all__ = ["AlertStormPlan", "FakeClock", "FaultInjector",
+           "ReplicaFaultPlan", "ScriptedWorkerGroup", "SimulatedCrash"]

@@ -61,6 +61,9 @@ class FaultInjector:
         # plain scripted state, not monkey-patches, so restore() does
         # not apply — they die with the injector.
         self._replica_plans: Dict[str, "ReplicaFaultPlan"] = {}
+        # alert-storm seams (ISSUE 16): scripted synthetic SLO alert
+        # transitions, drained by the twin into SLOEngine.inject_alert
+        self._alert_storms: List["AlertStormPlan"] = []
 
     # ------------------------------------------------------------ lifecycle
     def __enter__(self) -> "FaultInjector":
@@ -211,6 +214,35 @@ class FaultInjector:
         steps keep working — health-check flap the breaker must absorb
         or act on."""
         self.replica_plan(name).failing_probes += count
+
+    # ---------------------------------------------- alert seams (ISSUE 16)
+    def alert_storm(self, *, start_s: float, count: int = 10,
+                    period_s: float = 0.1, severity: str = "page",
+                    rule: str = "injected:storm", sli: str = "availability",
+                    flap: bool = True) -> "AlertStormPlan":
+        """Script a storm of SYNTHETIC SLO alert transitions: ``count``
+        fires starting at ``start_s``, one per ``period_s``; with
+        ``flap=True`` each fire resolves half a period later — the
+        pathological flapping shape an autoscaler's hysteresis and
+        cooldowns must absorb without thrashing the pool. The twin
+        drains :meth:`due_alerts` each iteration into
+        ``SLOEngine.inject_alert``, which fans the alerts to every
+        subscriber through the REAL emit path without perturbing the
+        burn-rate state machine."""
+        plan = AlertStormPlan(start_s=start_s, count=count,
+                              period_s=period_s, severity=severity,
+                              rule=rule, sli=sli, flap=flap)
+        self._alert_storms.append(plan)
+        return plan
+
+    def due_alerts(self, now: float) -> List:
+        """Pop every scripted alert transition due at/before ``now``
+        (across all storms), in time order."""
+        out = []
+        for plan in self._alert_storms:
+            out.extend(plan.pop_due(now))
+        out.sort(key=lambda a: a.t)
+        return out
 
     def crash_on_replace(self, nth: int = 1):
         """Process dies at the publish step: the tmp file is complete but
@@ -371,6 +403,40 @@ class ReplicaFaultPlan:
             raise TransientReplicaError(
                 f"replica {self.name}: scripted probe failure "
                 f"#{self.probe_calls}")
+
+
+class AlertStormPlan:
+    """Scripted synthetic-alert schedule (ISSUE 16): a deterministic
+    sequence of ``(t, "fired"/"resolved")`` transitions for one rule
+    name. Builds real :class:`~deepspeed_tpu.telemetry.slo.SLOAlert`
+    objects lazily (keeps this module import-light)."""
+
+    def __init__(self, *, start_s: float, count: int, period_s: float,
+                 severity: str, rule: str, sli: str, flap: bool):
+        self.rule = rule
+        self.sli = sli
+        self.severity = severity
+        self.delivered = 0
+        self._schedule: List = []   # (t, transition) pending, time-ordered
+        for i in range(count):
+            t = start_s + i * period_s
+            self._schedule.append((t, "fired"))
+            if flap:
+                self._schedule.append((t + period_s / 2.0, "resolved"))
+        self._schedule.sort(key=lambda x: x[0])
+
+    def pop_due(self, now: float) -> List:
+        from deepspeed_tpu.telemetry.slo import SLOAlert
+
+        out = []
+        while self._schedule and self._schedule[0][0] <= now:
+            t, transition = self._schedule.pop(0)
+            self.delivered += 1
+            out.append(SLOAlert(
+                rule=self.rule, sli=self.sli, severity=self.severity,
+                kind=transition, t=t, burn_short=99.0, burn_long=99.0,
+                budget_consumed=1.0))
+        return out
 
 
 class FakeClock:

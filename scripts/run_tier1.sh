@@ -4,6 +4,9 @@
 # serving tests (tests/unit/serving, marker `serving`), so tier-1
 # exercises the scheduler/kv-slot/no-recompile path; the explicit check
 # afterwards fails the script if that suite was ever emptied out.
+# conftest.py prints a "module wall-clock (child subprocess)" section at
+# the end of the run — the per-module duration summary that shows where
+# the 870s budget goes when deciding which modules to demote to `slow`.
 set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=${PIPESTATUS[0]}; echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c)
 # the serving suite must exist and be non-empty (it rides the
 # `-m 'not slow'` selection above; a second pytest invocation here was

@@ -82,6 +82,7 @@ def aggregate(records, n_bad_lines=0, postmortem=None):
     spans = []                # raw span records, arrival order
     attributions = OrderedDict()   # scope -> last program table
     slo_evals = []            # SLO-engine burn-rate timeline (ISSUE 13)
+    elastic_events = []       # autoscaler + pool-membership events (ISSUE 16)
     for rec in records:
         kind = rec.get("kind")
         if kind == "snapshot":
@@ -113,6 +114,10 @@ def aggregate(records, n_bad_lines=0, postmortem=None):
             e["count"] += 1
             e["last"] = {k: v for k, v in rec.items()
                          if k not in ("kind", "name", "ts")}
+            if name in ("fabric/autoscale", "fabric/replica_added",
+                        "fabric/replica_draining",
+                        "fabric/replica_removed"):
+                elastic_events.append(rec)
     for s in scalars.values():
         s["mean"] = s["sum"] / s["count"] if s["count"] else 0.0
     metrics = (last_snapshot or {}).get("metrics", {})
@@ -128,6 +133,7 @@ def aggregate(records, n_bad_lines=0, postmortem=None):
         "slo": _slo_summary(metrics, slo_evals, events),
         "tenants": _tenants_summary(metrics),
         "fabric": _fabric_summary(metrics),
+        "autoscaler": _autoscaler_summary(metrics, elastic_events),
         "resilience": _resilience_summary(metrics),
         "spans": _spans_summary(spans),
         "attribution": _attribution_summary(attributions),
@@ -471,6 +477,69 @@ def _fabric_summary(metrics):
     return out
 
 
+def _autoscaler_summary(metrics, elastic_events):
+    """Derived elastic-autoscaling view (ISSUE 16) pinned from the twin
+    (or live) JSONL stream: the full scale-decision timeline WITH the
+    evidence that justified each decision, the pool-size series, and
+    the graceful-drain duration tail. Crash-tolerant like everything
+    else here: torn or field-less event records degrade to '-' cells,
+    never to a raised exception. Empty dict when the run never used
+    the elastic pool."""
+    counters = {k: v for k, v in metrics.get("counters", {}).items()
+                if k.startswith("fabric/autoscale")
+                or k in ("fabric/replicas_added", "fabric/replicas_removed",
+                         "fabric/drain_redispatches")}
+    if not counters and not elastic_events:
+        return {}
+    out = {}
+    for k, v in sorted(counters.items()):
+        out[k.split("/", 1)[1]] = v
+
+    def _num(v):
+        try:
+            return float(v)
+        except (TypeError, ValueError):
+            return None
+
+    decisions, pool_series, drains = [], [], []
+    for rec in elastic_events:
+        name, t = rec.get("name"), _num(rec.get("t"))
+        if name == "fabric/autoscale":
+            evidence = {k: rec[k] for k in
+                        ("queue_depth", "shed_delta", "firing_pages",
+                         "firing_warns", "budget_spent") if k in rec}
+            decisions.append({
+                "t": t, "action": rec.get("action", "?"),
+                "reason": rec.get("reason", "?"),
+                "replica": rec.get("replica"),
+                "pool": f"{rec.get('pool_before', '?')}"
+                        f"->{rec.get('pool_after', '?')}",
+                "evidence": evidence})
+            continue
+        pool = _num(rec.get("pool_size"))
+        if pool is not None and t is not None and \
+                name in ("fabric/replica_added", "fabric/replica_removed"):
+            pool_series.append((t, int(pool)))
+        if name == "fabric/replica_removed":
+            d = _num(rec.get("duration_ms"))
+            if d is not None:
+                drains.append(d)
+    if decisions:
+        out["decisions"] = decisions
+    if pool_series:
+        out["pool_size_series"] = sorted(pool_series)
+    if drains:
+        drains.sort()
+
+        def pct(p):
+            return round(drains[min(int(len(drains) * p),
+                                    len(drains) - 1)], 3)
+
+        out["drain_ms"] = {"count": len(drains), "p50": pct(0.5),
+                           "p95": pct(0.95), "max": round(drains[-1], 3)}
+    return out
+
+
 def _resilience_summary(metrics):
     """Derived training-resilience view (ISSUE 10) over the engine's raw
     counters/histograms: anomalies by class (nonfinite/overflow/spike/
@@ -572,6 +641,22 @@ def render(agg):
            [(k, _fmt(v) if not isinstance(v, dict) else
              " ".join(f"{kk}={_fmt(vv)}" for kk, vv in v.items()))
             for k, v in agg.get("fabric", {}).items()], out)
+    asc = dict(agg.get("autoscaler", {}))
+    asc_decisions = asc.pop("decisions", [])
+    asc_pool = asc.pop("pool_size_series", [])
+    if asc_pool:
+        asc["pool_size_series"] = " ".join(
+            f"{_fmt(t)}:{n}" for t, n in asc_pool)
+    _table("autoscaler", ("metric", "value"),
+           [(k, _fmt(v) if not isinstance(v, dict) else
+             " ".join(f"{kk}={_fmt(vv)}" for kk, vv in v.items()))
+            for k, v in asc.items()], out)
+    _table("autoscaler decisions",
+           ("t", "action", "reason", "replica", "pool", "evidence"),
+           [(_fmt(d.get("t")), d.get("action", "?"), d.get("reason", "?"),
+             d.get("replica") or "-", d.get("pool", "?"),
+             json.dumps(d.get("evidence", {}), default=str)[:70])
+            for d in asc_decisions], out)
     _table("resilience", ("metric", "value"),
            [(k, _fmt(v) if not isinstance(v, dict) else
              " ".join(f"{kk}={_fmt(vv)}" for kk, vv in v.items()))

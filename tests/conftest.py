@@ -164,6 +164,27 @@ def _synthesize_failure(session, item, message):
         nodeid=item.nodeid, location=item.location)
 
 
+# module path -> cumulative child wall-clock seconds (all attempts), so
+# tier-1 output shows where the 870s budget actually goes — the basis
+# for deciding which modules to demote to `slow` when the cap bites
+_MODULE_WALLS = {}
+
+
+def pytest_terminal_summary(terminalreporter, exitstatus, config):
+    if not _MODULE_WALLS or os.environ.get("DSTPU_TEST_CHILD"):
+        return
+    terminalreporter.section("module wall-clock (child subprocess)")
+    ranked = sorted(_MODULE_WALLS.items(), key=lambda kv: -kv[1])
+    total = sum(_MODULE_WALLS.values())
+    for mod, wall in ranked[:15]:
+        terminalreporter.write_line(f"{wall:8.1f}s  {mod}")
+    if len(ranked) > 15:
+        rest = sum(w for _, w in ranked[15:])
+        terminalreporter.write_line(
+            f"{rest:8.1f}s  ({len(ranked) - 15} more modules)")
+    terminalreporter.write_line(f"{total:8.1f}s  total")
+
+
 def _run_module_child(session, items):
     """Run `items` (all from one module) in child subprocesses, retrying on
     crash/timeout.  Returns when every item has been reported."""
@@ -245,11 +266,18 @@ def pytest_runtestloop(session):
     if getattr(session.config.option, "usepdb", False):
         return None  # debugging needs in-process execution
     # Group by module, preserving the (torch-last) collection order.
+    import time as _time
+
     groups_ = {}
     for it in session.items:
         groups_.setdefault(it.nodeid.split("::")[0], []).append(it)
-    for mod_items in groups_.values():
-        _run_module_child(session, mod_items)
+    for mod_path, mod_items in groups_.items():
+        t0 = _time.perf_counter()
+        try:
+            _run_module_child(session, mod_items)
+        finally:
+            _MODULE_WALLS[mod_path] = (_MODULE_WALLS.get(mod_path, 0.0)
+                                       + _time.perf_counter() - t0)
         if session.shouldfail:
             raise session.Failed(session.shouldfail)
         if session.shouldstop:
@@ -303,7 +331,8 @@ _LATE_MODULES = _OBSERVABILITY_MODULES + (
     "unit/serving/test_tracing",
     "unit/serving/test_kv_quant",
     "unit/telemetry/test_slo_plane",
-    "unit/serving/test_slo_plane",)
+    "unit/serving/test_slo_plane",
+    "unit/serving/test_autoscale",)
 
 # Dead-last group, AFTER even the torch modules: pure-AST, device-free
 # suites (the dstpu-lint/prove analysis tests never launch a collective,

@@ -1,0 +1,586 @@
+"""Elastic autoscaling fabric tests (ISSUE 16).
+
+Covers the elastic replica pool (warm-probed admission, graceful drain
+with committed-token failover, last-replica refusal), the SLO-alert
+fan-out (per-subscriber broken-subscriber immunity), the
+ElasticAutoscaler policy guards (hysteresis, cooldown, rolling budget
+vs an injected alert storm), and the fleet-scale chaos twin acceptance:
+an overload burst plus a mid-scale crash storm must scale out on page
+burn, fail over + restart under supervision, drain back in losslessly,
+and serve token streams bit-identical to a fault-free fixed-large-pool
+oracle — with zero recompiles across every pool size and a bit-identical
+full-run replay.
+
+All virtual time (FakeClock); every scenario is deterministic.
+"""
+
+import numpy as np
+import pytest
+
+import deepspeed_tpu
+from deepspeed_tpu.models.gpt2 import GPT2Config, GPT2Model
+from deepspeed_tpu.serving import (FabricRouter, InProcessReplica,
+                                   LastReplicaError, ReplicaAdmissionError,
+                                   ReplicaSupervisor, Request, ServingEngine,
+                                   UnknownReplicaError)
+from deepspeed_tpu.serving.fabric.autoscaler import ElasticAutoscaler
+from deepspeed_tpu.serving.fabric.twin import (run_twin,
+                                               synthetic_tenant_trace)
+from deepspeed_tpu.telemetry.registry import MetricsRegistry
+from deepspeed_tpu.telemetry.slo import SLOAlert, SLOEngine
+from deepspeed_tpu.testing import FakeClock, FaultInjector
+from deepspeed_tpu.utils import groups
+
+pytestmark = [pytest.mark.fabric, pytest.mark.serving, pytest.mark.quick]
+
+_ENGINE = {}
+
+
+def _inference_engine():
+    """One shared InferenceEngine per module run (the production
+    single-host shape): every replica — including ones admitted
+    mid-run by the autoscaler — reuses the same compiled programs,
+    which is what makes the zero-recompile pins below meaningful."""
+    if "eng" not in _ENGINE:
+        groups.reset()
+        cfg = GPT2Config.tiny()
+        _ENGINE["cfg"] = cfg
+        _ENGINE["eng"] = deepspeed_tpu.init_inference(
+            GPT2Model(cfg), dtype="fp32", max_out_tokens=128)
+    return _ENGINE["cfg"], _ENGINE["eng"]
+
+
+def _serving(clock, **kw):
+    _, eng = _inference_engine()
+    kw.setdefault("num_slots", 4)
+    kw.setdefault("max_len", 64)
+    kw.setdefault("buckets", (16, 64))
+    kw.setdefault("telemetry", False)
+    return ServingEngine(eng, time_fn=clock.time, **kw)
+
+
+def _make_factory(clock, inj=None, chaos_for=(), engine_kw=None):
+    def factory(name):
+        srv = _serving(clock, **(engine_kw or {}))
+        chaos = inj.replica_plan(name) \
+            if inj is not None and name in chaos_for else None
+        return InProcessReplica(name, srv, chaos=chaos, clock=clock)
+    return factory
+
+
+def _baseline_tokens(trace, engine_kw=None):
+    """Fault-free single-replica greedy run — the oracle every drain /
+    failover path must match bit-identically."""
+    clock = FakeClock(auto_dt=0.001)
+    srv = _serving(clock, **(engine_kw or {}))
+    return {r.rid: r.tokens for r in srv.run(trace)}
+
+
+def _stream_trace(n, prompt_len, max_new, streamed):
+    cfg, _ = _inference_engine()
+    rng = np.random.RandomState(17)
+
+    def cb(rid):
+        streamed[rid] = []
+        return lambda t: streamed[rid].append(t)
+
+    return [Request(rid=i,
+                    prompt=[int(v) for v in
+                            rng.randint(1, cfg.vocab_size, size=prompt_len)],
+                    max_new_tokens=max_new, arrival_time=0.0,
+                    on_token=cb(i))
+            for i in range(n)]
+
+
+def _plain(trace):
+    return [Request(rid=r.rid, prompt=list(r.prompt),
+                    max_new_tokens=r.max_new_tokens,
+                    arrival_time=r.arrival_time) for r in trace]
+
+
+def _drain_all(router, clock, out, max_iters=200_000):
+    for _ in range(max_iters):
+        if not router._queue and not router._inflight \
+                and not router._draining:
+            return out
+        out.extend(router.step(clock.time()))
+    raise AssertionError("router failed to drain the scenario")
+
+
+# ----------------------------------------------------------- pool membership
+def test_add_replica_warm_admission_gate():
+    """A joiner is admitted only after a warm probe; a probe-blackout
+    joiner is refused with a typed error and the pool is untouched."""
+    clock = FakeClock(auto_dt=0.001)
+    inj = FaultInjector()
+    inj.fail_replica_probes("sick", count=3)
+    factory = _make_factory(clock, inj, chaos_for=("sick",))
+    router = FabricRouter([factory("r0")], replica_factory=factory,
+                          time_fn=clock.time, telemetry=False)
+    assert router.pool_size() == 1
+
+    with pytest.raises(ReplicaAdmissionError):
+        router.add_replica(factory("sick"))
+    assert router.pool_size() == 1 and "sick" not in router.replicas
+
+    name = router.add_replica()          # factory-built, auto-named
+    assert name == "scale-0"
+    assert router.pool_size() == 2
+    # duplicate names are an admission error, not silent replacement
+    with pytest.raises(ReplicaAdmissionError):
+        router.add_replica(factory("r0"))
+    # the joiner serves immediately, sharing the compiled programs
+    trace = _plain(_stream_trace(4, 6, 4, {}))
+    oracle = _baseline_tokens(_plain(trace))
+    results = router.run(trace)
+    assert {r.rid: r.tokens for r in results} == oracle
+    assert router.recompile_count() == 0
+
+
+def test_remove_last_replica_refused_and_unknown_typed():
+    clock = FakeClock(auto_dt=0.001)
+    factory = _make_factory(clock)
+    router = FabricRouter([factory("r0"), factory("r1")],
+                          time_fn=clock.time, telemetry=False)
+    with pytest.raises(UnknownReplicaError):
+        router.remove_replica("nope")
+    router.remove_replica("r1", drain=True)      # empty drain: synchronous
+    assert "r1" not in router.replicas
+    with pytest.raises(LastReplicaError):
+        router.remove_replica("r0")
+    assert router.pool_size() == 1               # refusal left it serving
+
+
+def test_remove_replica_idempotent_while_draining():
+    clock = FakeClock(auto_dt=0.001)
+    factory = _make_factory(clock)
+    router = FabricRouter([factory(n) for n in ("r0", "r1")],
+                          time_fn=clock.time, telemetry=False)
+    router.submit(Request(rid=0, prompt=[3, 5, 7], max_new_tokens=6),
+                  now=clock.time())
+    out = [r for r in router.step(clock.time())]
+    assert router.replicas["r0"].pending or router.replicas["r1"].pending
+    busy = "r0" if router.replicas["r0"].pending else "r1"
+    router.remove_replica(busy, drain=True)      # inflight: stays draining
+    assert busy in router.draining
+    router.remove_replica(busy, drain=True)      # second call: no-op
+    assert router.replicas_removed == 0
+    _drain_all(router, clock, out)
+    assert busy not in router.replicas and len(out) == 1
+
+
+# ------------------------------------------------------------- drain paths
+def test_drain_mid_chunked_prefill_graceful_completion():
+    """remove_replica(drain=True) while a long prompt is mid-chunked-
+    prefill: the draining member stops receiving dispatches but
+    finishes its chunks; streams never duplicate; outcome 'drained'."""
+    streamed = {}
+    trace = _stream_trace(6, 40, 6, streamed)
+    engine_kw = {"prefill_token_budget": 16}
+    oracle = _baseline_tokens(_plain(trace), engine_kw)
+    clock = FakeClock(auto_dt=0.001)
+    factory = _make_factory(clock, engine_kw=engine_kw)
+    router = FabricRouter([factory(n) for n in ("r0", "r1")],
+                          time_fn=clock.time, telemetry=False)
+    for r in trace:
+        router.submit(r, now=clock.time())
+    out = []
+    for _ in range(50):
+        out.extend(router.step(clock.time()))
+        srv = router.replicas["r0"].serving
+        mid_prefill = (srv.prefill_chunks > 0 and any(
+            tr.replica == "r0" and not tr.committed
+            for tr in router._inflight.values()))
+        if mid_prefill:
+            break
+    assert mid_prefill, "never caught r0 mid-chunked-prefill"
+    router.remove_replica("r0", drain=True)      # no deadline: full grace
+    assert "r0" in router.draining
+    _drain_all(router, clock, out)
+    assert "r0" not in router.replicas
+    assert router.drain_redispatches == 0        # everything finished local
+    assert len(out) == len(trace)
+    for r in out:
+        assert streamed[r.rid] == r.tokens == oracle[r.rid]
+    assert router.recompile_count() == 0
+
+
+def test_drain_timeout_fails_over_mid_chunked_prefill():
+    """An expired drain deadline cancels the mid-prefill stragglers and
+    re-dispatches them from the committed-token record — with zero
+    tokens committed the resume is a clean restart on a survivor, and
+    the client stream is still exactly RequestResult.tokens."""
+    streamed = {}
+    trace = _stream_trace(6, 40, 6, streamed)
+    engine_kw = {"prefill_token_budget": 16}
+    oracle = _baseline_tokens(_plain(trace), engine_kw)
+    clock = FakeClock(auto_dt=0.001)
+    factory = _make_factory(clock, engine_kw=engine_kw)
+    router = FabricRouter([factory(n) for n in ("r0", "r1")],
+                          time_fn=clock.time, telemetry=False,
+                          retry_base_delay_s=0.0, retry_max_delay_s=0.0)
+    for r in trace:
+        router.submit(r, now=clock.time())
+    out = []
+    for _ in range(50):
+        out.extend(router.step(clock.time()))
+        srv = router.replicas["r0"].serving
+        if srv.prefill_chunks > 0 and any(
+                tr.replica == "r0" and not tr.committed
+                for tr in router._inflight.values()):
+            break
+    else:
+        raise AssertionError("never caught r0 mid-chunked-prefill")
+    # zero grace: the synchronous escalation cancels + re-dispatches NOW
+    router.remove_replica("r0", drain=True, drain_timeout_s=0.0)
+    assert "r0" not in router.replicas
+    assert router.drain_redispatches >= 1
+    _drain_all(router, clock, out)
+    assert len(out) == len(trace)
+    for r in out:
+        assert streamed[r.rid] == r.tokens == oracle[r.rid]
+        assert r.finish_reason in ("eos", "length")
+    assert router.recompile_count() == 0
+
+
+def test_drain_timeout_fails_over_mid_speculation():
+    """Drain-deadline failover while the draining member is mid-
+    speculative-decode: every token the fabric already committed rides
+    in the resumed request's prompt, so the survivor continues the
+    stream without re-emitting a single token."""
+    streamed = {}
+    trace = _stream_trace(6, 8, 10, streamed)
+    engine_kw = {"speculative": dict(mode="ngram", k_buckets=(4,))}
+    oracle = _baseline_tokens(_plain(trace), engine_kw)
+    clock = FakeClock(auto_dt=0.001)
+    factory = _make_factory(clock, engine_kw=engine_kw)
+    router = FabricRouter([factory(n) for n in ("r0", "r1")],
+                          time_fn=clock.time, telemetry=False,
+                          retry_base_delay_s=0.0, retry_max_delay_s=0.0)
+    for r in trace:
+        router.submit(r, now=clock.time())
+    out = []
+    for _ in range(200):
+        out.extend(router.step(clock.time()))
+        victims = [tr for tr in router._inflight.values()
+                   if tr.replica == "r0" and len(tr.committed) >= 1]
+        if victims:
+            break
+    else:
+        raise AssertionError("never caught r0 mid-speculation with "
+                             "committed tokens")
+    committed_before = {tr.request.rid: list(tr.committed)
+                        for tr in victims}
+    router.remove_replica("r0", drain=True, drain_timeout_s=0.0)
+    assert "r0" not in router.replicas
+    assert router.drain_redispatches >= 1
+    _drain_all(router, clock, out)
+    assert len(out) == len(trace)
+    by_rid = {r.rid: r for r in out}
+    for rid, prefix in committed_before.items():
+        r = by_rid[rid]
+        # the resumed stream CONTINUES the committed prefix
+        assert r.tokens[:len(prefix)] == prefix
+        assert r.replica == "r1"
+    for r in out:
+        assert streamed[r.rid] == r.tokens == oracle[r.rid]
+    assert router.recompile_count() == 0
+
+
+# --------------------------------------------------------- alert fan-out
+def _alert(rule="fabric_queue:page:3x", severity="page", kind="fired",
+           t=1.0):
+    return SLOAlert(rule=rule, sli="fabric_queue", severity=severity,
+                    kind=kind, t=t, burn_short=9.0, burn_long=9.0,
+                    budget_consumed=0.5)
+
+
+def test_alert_fanout_broken_subscriber_immunity():
+    """One raising subscriber must not starve the others: the
+    supervisor and the recording callback both receive every alert
+    even with a poisoned callback registered FIRST in the list."""
+    reg = MetricsRegistry()
+    clock = FakeClock(auto_dt=0.001)
+    slo = SLOEngine(registry=reg, time_fn=clock.time)
+    sup = ReplicaSupervisor()
+    got = []
+
+    def poisoned(alert):
+        raise RuntimeError("subscriber bug")
+
+    slo.add_alert_callback(poisoned)
+    slo.add_alert_callback(got.append)
+    slo.add_alert_callback(sup.on_slo_alert)
+    slo.add_alert_callback(got.append)           # idempotent: no dup
+    assert len(slo._callbacks) == 3
+
+    slo.inject_alert(_alert())
+    slo.inject_alert(_alert(kind="resolved", t=2.0))
+    assert [a.kind for a in got] == ["fired", "resolved"]
+    assert [a.kind for a in sup.slo_alerts] == ["fired", "resolved"]
+
+    slo.remove_alert_callback(poisoned)
+    assert len(slo._callbacks) == 2
+    # legacy single-callback shim replaces the whole subscriber list
+    slo.set_alert_callback(got.append)
+    slo.inject_alert(_alert(t=3.0))
+    assert len(got) == 3 and len(sup.slo_alerts) == 2
+    slo.set_alert_callback(None)
+    slo.inject_alert(_alert(t=4.0))
+    assert len(got) == 3                         # nobody subscribed
+
+
+def test_router_autosubscribes_supervisor_and_autoscaler():
+    reg = MetricsRegistry()
+    clock = FakeClock(auto_dt=0.001)
+    slo = SLOEngine(registry=reg, time_fn=clock.time)
+    sup = ReplicaSupervisor()
+    factory = _make_factory(clock)
+    router = FabricRouter([factory("r0")], replica_factory=factory,
+                          time_fn=clock.time, telemetry=reg,
+                          supervisor=sup, slo=slo)
+    scaler = ElasticAutoscaler(router, max_replicas=2)
+    assert sup.on_slo_alert in slo._callbacks
+    assert scaler.on_slo_alert in slo._callbacks
+    slo.inject_alert(_alert())
+    assert len(sup.slo_alerts) == 1
+    assert scaler._firing_pages == {"fabric_queue:page:3x"}
+    slo.inject_alert(_alert(kind="resolved", t=2.0))
+    assert scaler._firing_pages == set()
+
+
+# ------------------------------------------------------- autoscaler policy
+def test_autoscaler_config_validation_typed():
+    clock = FakeClock(auto_dt=0.001)
+    factory = _make_factory(clock)
+    router = FabricRouter([factory("r0")], replica_factory=factory,
+                          time_fn=clock.time, telemetry=False)
+    from deepspeed_tpu.serving.errors import EngineConfigError
+    with pytest.raises(EngineConfigError):
+        ElasticAutoscaler(router, min_replicas=0)
+    with pytest.raises(EngineConfigError):
+        ElasticAutoscaler(router, min_replicas=4, max_replicas=2)
+    with pytest.raises(EngineConfigError):
+        ElasticAutoscaler(router, queue_high=4, queue_low=4)
+
+
+def test_autoscaler_cooldown_budget_and_hysteresis():
+    """Page pressure scales out at most once per cooldown and never
+    past the rolling budget; the idle side needs idle_stable_s of
+    CONTINUOUS calm before draining one member back in."""
+    clock = FakeClock(auto_dt=0.001)
+    factory = _make_factory(clock)
+    router = FabricRouter([factory("r0")], replica_factory=factory,
+                          time_fn=clock.time, telemetry=False)
+    scaler = ElasticAutoscaler(
+        router, min_replicas=1, max_replicas=4,
+        scale_out_cooldown_s=0.5, scale_in_cooldown_s=0.5,
+        idle_stable_s=1.0, max_scale_events=2, scale_window_s=100.0)
+    scaler.on_slo_alert(_alert())                # page burn firing
+    d0 = scaler.tick(0.0)
+    assert d0 is not None and d0.action == "scale_out" \
+        and d0.reason == "page_burn"
+    assert scaler.tick(0.1) is None              # cooldown
+    assert scaler.suppressed == 1
+    d1 = scaler.tick(0.6)
+    assert d1 is not None and router.pool_size() == 3
+    assert scaler.tick(1.2) is None              # budget (2 events) spent
+    assert scaler.suppressed == 2
+    # alert clears: calm must hold idle_stable_s before any scale-in
+    scaler.on_slo_alert(_alert(kind="resolved", t=2.0))
+    assert scaler.tick(200.0) is None            # starts the idle window
+    assert scaler.tick(200.5) is None            # not stable yet
+    d2 = scaler.tick(201.1)
+    assert d2 is not None and d2.action == "scale_in" \
+        and d2.reason == "idle"
+    assert router.pool_size() == 2
+    # evidence rides every decision
+    assert d0.evidence["firing_pages"] == ["fabric_queue:page:3x"]
+    assert "queue_depth" in d2.evidence and "budget_spent" in d2.evidence
+
+
+def test_twin_alert_storm_cannot_thrash_the_pool():
+    """An injected page-alert storm (20 flapping alerts in 2s) against
+    a NOMINAL trace: scale-outs stay inside the rolling budget, the
+    pool never exceeds max_replicas, every request still serves
+    bit-identically, and the storm run replays bit-identically."""
+    cfg, eng = _inference_engine()
+    tenants = [{"name": "web", "kind": "bimodal", "n": 10, "rate": 50.0}]
+    trace = synthetic_tenant_trace(3, cfg.vocab_size, tenants=tenants)
+    ak = dict(min_replicas=1, max_replicas=3, scale_out_cooldown_s=0.2,
+              scale_in_cooldown_s=1.0, idle_stable_s=0.5,
+              max_scale_events=3, scale_window_s=60.0)
+    storm = ({"kind": "alert_storm", "start_s": 0.02, "count": 20,
+              "period_s": 0.1, "severity": "page", "flap": True},)
+    rep = run_twin(eng, trace, initial_replicas=1,
+                   autoscaler_kw=ak, faults=storm)
+    oracle = run_twin(eng, trace, initial_replicas=3, autoscaler_kw=None)
+    outs = [d for d in rep.scale_timeline if d[1] == "scale_out"]
+    assert 1 <= len(outs) <= 3                  # budget-bounded, no churn
+    assert max(p for _, p in rep.pool_sizes) <= 3
+    assert rep.served == len(trace) and rep.failed == 0
+    assert rep.tokens == oracle.tokens
+    assert rep.recompiles == 0
+    rep2 = run_twin(eng, trace, initial_replicas=1,
+                    autoscaler_kw=ak, faults=storm)
+    assert rep.fingerprint() == rep2.fingerprint()
+
+
+# ----------------------------------------------------------- twin acceptance
+def _chaos_trace(cfg):
+    tenants = [
+        {"name": "bots", "kind": "bursty", "n": 60, "rate": 2000.0,
+         "burst_size": 60, "prompt_lens": (4, 12), "max_new": (6, 10)},
+        {"name": "web", "kind": "bimodal", "n": 12, "rate": 100.0,
+         "short_lens": (4, 8), "long_lens": (12, 16), "long_frac": 0.3,
+         "short_new": (4, 6), "long_new": (8, 12)},
+    ]
+    trace = synthetic_tenant_trace(7, cfg.vocab_size, tenants=tenants)
+    # two tail arrivals well past the burst: the idle gap is where the
+    # autoscaler proves it drains back in instead of holding capacity
+    tail_t = max(r.arrival_time for r in trace) + 6.0
+    rng = np.random.RandomState(99)
+    for k in range(2):
+        trace.append(Request(
+            rid=len(trace),
+            prompt=[int(v) for v in rng.randint(1, cfg.vocab_size, size=6)],
+            max_new_tokens=4, arrival_time=tail_t + 0.2 * k))
+    trace.sort(key=lambda r: (r.arrival_time, r.rid))
+    for i, r in enumerate(trace):
+        r.rid = i
+    return trace
+
+
+_CHAOS_AK = dict(min_replicas=1, max_replicas=6, scale_out_cooldown_s=0.3,
+                 scale_in_cooldown_s=1.5, idle_stable_s=0.5,
+                 queue_high=10_000, queue_low=0)
+_CHAOS_FAULTS = ({"kind": "crash", "replica": "r0", "at_step": 40},
+                 {"kind": "crash", "replica": "r1", "at_step": 55})
+
+
+def test_twin_nominal_zero_decisions_zero_alerts():
+    """A trace the static pool absorbs: the armed autoscaler must make
+    ZERO decisions and the SLO engine must fire ZERO alerts — elastic
+    machinery is free when nothing is wrong."""
+    cfg, eng = _inference_engine()
+    tenants = [
+        {"name": "web", "kind": "bimodal", "n": 10, "rate": 40.0},
+        {"name": "batch", "kind": "bursty", "n": 6, "rate": 30.0,
+         "burst_size": 2},
+    ]
+    trace = synthetic_tenant_trace(11, cfg.vocab_size, tenants=tenants)
+    rep = run_twin(eng, trace, initial_replicas=2,
+                   autoscaler_kw=dict(max_replicas=4))
+    assert rep.served == len(trace) and rep.shed == 0 and rep.failed == 0
+    assert rep.scale_timeline == []
+    assert rep.alert_timeline == []
+    assert rep.pool_sizes == [(0.0, 2)]
+    assert rep.recompiles == 0
+    rep2 = run_twin(eng, trace, initial_replicas=2,
+                    autoscaler_kw=dict(max_replicas=4))
+    assert rep.fingerprint() == rep2.fingerprint()
+
+
+def _report_module():
+    import importlib.util
+    import os
+
+    spec = importlib.util.spec_from_file_location(
+        "telemetry_report", os.path.join(
+            os.path.dirname(__file__), "..", "..", "..", "scripts",
+            "telemetry_report.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_twin_jsonl_pins_autoscaler_report_section(tmp_path):
+    """The twin's JSONL stream is the report's input: the autoscaler
+    section must carry the full decision timeline (with evidence), the
+    pool-size series, and drain-duration percentiles — and survive a
+    crash-torn line in the middle of the file."""
+    cfg, eng = _inference_engine()
+    path = str(tmp_path / "twin.jsonl")
+    rep = run_twin(eng, _chaos_trace(cfg), initial_replicas=2,
+                   autoscaler_kw=_CHAOS_AK, faults=_CHAOS_FAULTS,
+                   jsonl_path=path)
+    assert rep.scale_timeline, "scenario must actually scale"
+    with open(path, "ab") as f:                  # crash damage mid-file
+        f.write(b'{"kind": "event", "name": "fabric/auto')
+
+    mod = _report_module()
+    records, bad = mod.load_records(path)
+    assert bad == 1
+    agg = mod.aggregate(records, n_bad_lines=bad)
+    asc = agg["autoscaler"]
+    assert len(asc["decisions"]) == len(rep.scale_timeline)
+    first = asc["decisions"][0]
+    assert first["action"] == "scale_out" and first["reason"] == "page_burn"
+    assert "queue_depth" in first["evidence"] \
+        and "firing_pages" in first["evidence"]
+    # pool-size series reconstructs membership churn from the events
+    assert [n for _, n in asc["pool_size_series"]] \
+        == [p for _, p in rep.pool_sizes[1:]]
+    assert asc["drain_ms"]["count"] == len(rep.drain_durations_ms)
+    assert asc["drain_ms"]["p50"] <= asc["drain_ms"]["p95"]
+    assert asc["autoscale_out"] >= 1 and asc["replicas_removed"] >= 1
+    text = mod.render(agg)
+    assert "autoscaler decisions" in text and "page_burn" in text
+    # a fabric-less stream has no autoscaler section at all
+    assert mod._autoscaler_summary(
+        {"counters": {}, "gauges": {}, "histograms": {}}, []) == {}
+
+
+def test_twin_chaos_acceptance_elastic_fleet():
+    """THE acceptance scenario: overload burst + mid-scale crash storm.
+    Page-burn alert scales the pool out; both seed replicas crash and
+    fail over + restart under supervision; the idle tail drains the
+    extra capacity back in gracefully — and the whole fleet's token
+    streams are bit-identical to a fault-free FIXED large pool, with
+    zero recompiles at every pool size and a bit-identical replay."""
+    cfg, eng = _inference_engine()
+    rep = run_twin(eng, _chaos_trace(cfg), initial_replicas=2,
+                   autoscaler_kw=_CHAOS_AK, faults=_CHAOS_FAULTS)
+
+    # every request served: nothing shed, nothing dropped by drain
+    assert rep.served == len(_chaos_trace(cfg))
+    assert rep.shed == 0 and rep.failed == 0
+
+    # the burst fired a page alert and the scale-out cites it
+    assert any(sev == "page" and kind == "fired"
+               for _, _, sev, kind in rep.alert_timeline)
+    outs = [d for d in rep.scale_timeline if d[1] == "scale_out"]
+    ins = [d for d in rep.scale_timeline if d[1] == "scale_in"]
+    assert outs and outs[0][2] == "page_burn"
+    assert ins, "the idle tail must drain capacity back in"
+
+    # the crash storm really happened and was absorbed
+    assert rep.counters["replica_crashes"] == 2
+    assert rep.counters["replica_restarts"] >= 1
+    assert rep.counters["failovers"] >= 1
+    assert rep.counters["replicas_added"] == len(outs)
+    assert rep.counters["replicas_removed"] >= len(ins)
+    assert rep.drain_durations_ms, "graceful drains must be measured"
+
+    # fault-free fixed-large-pool oracle: bit-identical token streams
+    oracle = run_twin(eng, _chaos_trace(cfg),
+                      initial_replicas=_CHAOS_AK["max_replicas"],
+                      autoscaler_kw=None, faults=())
+    assert oracle.shed == 0 and oracle.failed == 0
+    assert rep.tokens == oracle.tokens
+
+    # zero recompiles across every pool size it passed through
+    assert rep.recompiles == 0 and oracle.recompiles == 0
+    assert {p for _, p in rep.pool_sizes} >= {2, 3}
+
+    # per-tenant accounting is complete and consistent
+    assert sum(t["served"] for t in rep.per_tenant.values()) == rep.served
+    assert sum(t["tokens"] for t in rep.per_tenant.values()) \
+        == sum(len(v) for v in rep.tokens.values())
+
+    # full replay is bit-identical, fingerprint included
+    rep2 = run_twin(eng, _chaos_trace(cfg), initial_replicas=2,
+                    autoscaler_kw=_CHAOS_AK, faults=_CHAOS_FAULTS)
+    assert rep.fingerprint() == rep2.fingerprint()
+    assert rep.scale_timeline == rep2.scale_timeline
+    assert rep.alert_timeline == rep2.alert_timeline

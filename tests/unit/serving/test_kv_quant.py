@@ -254,7 +254,11 @@ def _mixed_trace(cfg, seed=0):
     return shared + mixed
 
 
-@pytest.mark.parametrize("kv_dtype", ["int8", "fp8"])
+# tier-1 wall-clock relief (ISSUE 16): the fp8 twins of the two heavy
+# end-to-end gates ride the slow tier (~9-11s child wall each); int8
+# keeps the exact-match and swap-round-trip coverage in `-m 'not slow'`.
+@pytest.mark.parametrize("kv_dtype", [
+    "int8", pytest.param("fp8", marks=pytest.mark.slow)])
 def test_greedy_exact_match_rate_and_zero_recompiles(kv_dtype):
     cfg, _, srv_bf = _serving(None)
     base = _tokens_by_rid(srv_bf.run(_mixed_trace(cfg)))
@@ -267,6 +271,7 @@ def test_greedy_exact_match_rate_and_zero_recompiles(kv_dtype):
     assert srv_q.prefix.hit_tokens > 0
 
 
+@pytest.mark.slow  # ~14s child wall (speculative engine x quant pool)
 def test_speculative_quantized_lossless_and_zero_recompiles():
     cfg, _, srv_p = _serving("int8")
     plain = _tokens_by_rid(srv_p.run(_mixed_trace(cfg, seed=4)))
@@ -316,7 +321,8 @@ def test_cow_fork_copies_scales():
                           ks[:, src_blk, :, :, :8])
 
 
-@pytest.mark.parametrize("kv_dtype", ["int8", "fp8"])
+@pytest.mark.parametrize("kv_dtype", [
+    "int8", pytest.param("fp8", marks=pytest.mark.slow)])
 def test_quantized_swap_roundtrip_byte_identical(kv_dtype):
     """Preemption swap round trip (ISSUE 12 acceptance): quantized
     payload+scale bytes come back BIT-identical, the parked bytes are
@@ -480,6 +486,7 @@ def test_committed_artifact_beats_or_ties_hand_plan():
 
 
 # ------------------------------------------------------- tied embedding
+@pytest.mark.slow  # ~11s child wall
 def test_lm_head_quantization_logit_parity():
     cfg = _cfg(hidden=256, heads=4, vocab=640)
     groups.reset()

@@ -88,7 +88,11 @@ def _clone(reqs):
 
 
 # ------------------------------------------------------------- lossless
-@pytest.mark.parametrize("backend", ["ngram", "draft"])
+# tier-1 wall-clock relief (ISSUE 16): the draft-backend variant is the
+# slow twin (~13s child wall vs ~7s for ngram); ngram keeps the
+# bit-identity gate in `-m 'not slow'`, draft rides the slow tier.
+@pytest.mark.parametrize("backend", [
+    "ngram", pytest.param("draft", marks=pytest.mark.slow)])
 def test_greedy_spec_decode_bit_identical_to_baseline(backend):
     """The ISSUE-4 acceptance bar: greedy speculative decoding emits
     token-for-token identical output to plain slot decode, for both
@@ -119,6 +123,7 @@ def test_greedy_spec_decode_bit_identical_to_baseline(backend):
     assert srv.tokens_generated - srv.prefill_calls == decode_tokens
 
 
+@pytest.mark.slow  # ~7s child wall (second model family to compile)
 def test_llama_gqa_spec_decode_matches_baseline():
     """GQA + vector-RoPE verify path: the [B, k+1] block runs grouped-
     query attention with per-slot rotary offsets — still bit-identical
@@ -146,6 +151,7 @@ def test_llama_gqa_spec_decode_matches_baseline():
     assert srv.decode_steps <= base.decode_steps
 
 
+@pytest.mark.slow  # slowest test in the module (~24s child wall)
 def test_spec_decode_solo_matches_packed_batch():
     """A request's tokens are identical whether it runs alone or packed
     next to strangers — per-slot isolation survives the verify path's
@@ -311,6 +317,7 @@ def test_adaptive_k_tracks_acceptance():
 
 
 # ------------------------------------------------------------ eos + tpot
+@pytest.mark.slow  # ~10s child wall
 def test_eos_inside_accepted_block_truncates_like_baseline():
     """EOS appearing mid-block ends the request at the EOS token exactly
     as baseline decode would — tokens drafted behind it are dropped."""
